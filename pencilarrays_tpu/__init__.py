@@ -1,0 +1,37 @@
+"""pencilarrays_tpu — TPU-native distributed pencil-decomposition arrays.
+
+A ground-up JAX/XLA re-design of the capabilities of PencilArrays.jl
+(reference mounted read-only at /root/reference): MPI-style pencil (block)
+domain decomposition of N-dimensional arrays over an M-dimensional device
+mesh, zero-cost compile-time index permutations, a global-transpose
+(resharding) engine riding XLA collectives over ICI, distributed
+reductions/broadcast/grids, parallel I/O, and a PencilFFT layer on top.
+
+Quick start (mirrors reference ``README.md:60-120``; the array/transpose
+layers land in ``parallel.arrays`` / ``parallel.transpositions``)::
+
+    import pencilarrays_tpu as pa
+
+    pen = pa.make_pencil((42, 31, 29))        # decompose last 2 dims
+    u = pa.PencilArray.zeros(pen)
+    pen_y = pen.replace(decomp_dims=(0, 2))   # y-pencil configuration
+    v = pa.transpose(u, pen_y)                # all-to-all reshard over ICI
+"""
+
+from .utils.permutations import (  # noqa: F401
+    NO_PERMUTATION,
+    NoPermutation,
+    Permutation,
+)
+from .parallel import (  # noqa: F401
+    IndexOrder,
+    LogicalOrder,
+    MemoryOrder,
+    Pencil,
+    Topology,
+    dims_create,
+    local_data_range,
+    make_pencil,
+)
+
+__version__ = "0.1.0"

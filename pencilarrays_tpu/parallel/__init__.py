@@ -1,0 +1,23 @@
+from .topology import Topology, default_axis_names, dims_create
+from .pencil import (
+    IndexOrder,
+    LogicalOrder,
+    MemoryOrder,
+    Pencil,
+    complete_dims,
+    local_data_range,
+    make_pencil,
+)
+
+__all__ = [
+    "Topology",
+    "default_axis_names",
+    "dims_create",
+    "IndexOrder",
+    "LogicalOrder",
+    "MemoryOrder",
+    "Pencil",
+    "complete_dims",
+    "local_data_range",
+    "make_pencil",
+]

@@ -1,0 +1,207 @@
+"""Cartesian process/device topology over a TPU mesh.
+
+TPU-native re-design of ``src/Pencils/MPITopologies.jl`` (reference
+``MPITopologies.jl:72-136``).  The reference builds an M-dimensional
+Cartesian MPI communicator (``MPI.Cart_create``), one 1-D sub-communicator
+per decomposed axis (``MPI.Cart_sub``, ``MPITopologies.jl:244-251``) and
+rank lookup tables (``MPITopologies.jl:208-242``).
+
+On TPU the entire stack collapses onto :class:`jax.sharding.Mesh`:
+
+* the Cartesian communicator is the mesh itself — XLA partitions programs
+  over it and lays collectives onto the ICI torus;
+* each 1-D sub-communicator becomes a *named mesh axis*: a collective
+  issued with ``axis_name='p1'`` is exactly an exchange confined to that
+  axis's process columns (cf. ``Transpositions.jl:294-298`` where the
+  transpose picks ``topology.subcomms[R]``);
+* rank tables become the mesh's ``devices`` ndarray.
+
+``dims_create`` mirrors ``MPI.Dims_create`` (``MPITopologies.jl:138-144``):
+a balanced factorization of the device count over the topology dims.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import cached_property
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import AxisType, Mesh
+
+__all__ = ["Topology", "dims_create", "default_axis_names"]
+
+
+def default_axis_names(ndims: int) -> Tuple[str, ...]:
+    """Axis names ``('p1', ..., 'pN')`` — the sub-communicator handles."""
+    return tuple(f"p{i + 1}" for i in range(ndims))
+
+
+def dims_create(nprocs: int, ndims: int) -> Tuple[int, ...]:
+    """Balanced factorization of ``nprocs`` into ``ndims`` factors,
+    mimicking ``MPI_Dims_create`` (reference ``MPITopologies.jl:138-144``).
+
+    Returns dims sorted in non-increasing order, as MPI does.
+    """
+    if nprocs <= 0:
+        raise ValueError(f"nprocs must be positive, got {nprocs}")
+    if ndims <= 0:
+        raise ValueError(f"ndims must be positive, got {ndims}")
+    dims = [1] * ndims
+    # Greedy: repeatedly divide nprocs by its smallest prime factor and
+    # multiply it into the currently-smallest dim.
+    n = nprocs
+    factors = []
+    f = 2
+    while f * f <= n:
+        while n % f == 0:
+            factors.append(f)
+            n //= f
+        f += 1
+    if n > 1:
+        factors.append(n)
+    for f in sorted(factors, reverse=True):
+        i = int(np.argmin(dims))
+        dims[i] *= f
+    return tuple(sorted(dims, reverse=True))
+
+
+class Topology:
+    """An M-dimensional Cartesian topology of TPU devices.
+
+    Parity with reference ``MPITopology{N}`` (``MPITopologies.jl:72-92``):
+
+    ========================  ==========================================
+    reference                 here
+    ========================  ==========================================
+    ``get_comm(t)``           :attr:`mesh`
+    ``t.subcomms[i]``         :attr:`axis_names` ``[i]``
+    ``t.dims``                :attr:`dims`
+    ``t.coords_local``        :meth:`coords` (of any device)
+    ``t.ranks``               :attr:`ranks`
+    ``length(t)``             :meth:`__len__`
+    ``ndims(t)``              :attr:`ndims`
+    ========================  ==========================================
+    """
+
+    def __init__(
+        self,
+        dims: Sequence[int],
+        *,
+        devices: Optional[Sequence] = None,
+        axis_names: Optional[Sequence[str]] = None,
+    ):
+        dims = tuple(int(d) for d in dims)
+        if any(d <= 0 for d in dims):
+            raise ValueError(f"topology dims must be positive: {dims}")
+        if devices is None:
+            devices = jax.devices()
+        n = math.prod(dims)
+        if n != len(devices):
+            # Reference errors on a comm/topology size mismatch
+            # (``MPITopologies.jl:152-156``); silently using a subset would
+            # leave devices idle. Pass an explicit ``devices=`` subset to
+            # build a topology over fewer devices.
+            raise ValueError(
+                f"topology {dims} needs exactly {n} devices, got {len(devices)}"
+            )
+        devices = list(devices)
+        if axis_names is None:
+            axis_names = default_axis_names(len(dims))
+        axis_names = tuple(axis_names)
+        if len(axis_names) != len(dims):
+            raise ValueError("axis_names length must match dims length")
+        if len(set(axis_names)) != len(axis_names):
+            raise ValueError(f"duplicate axis names: {axis_names}")
+        dev_array = np.array(devices, dtype=object).reshape(dims)
+        # Auto axis types: classic GSPMD partitioning — sharding decisions
+        # may be refined by the compiler outside shard_map regions.
+        self._mesh = Mesh(
+            dev_array, axis_names, axis_types=(AxisType.Auto,) * len(dims)
+        )
+        self._dims = dims
+        self._axis_names = axis_names
+
+    # -- constructors -----------------------------------------------------
+    @classmethod
+    def auto(cls, ndims: int, *, devices=None, axis_names=None) -> "Topology":
+        """Balanced topology over all (or the given) devices — the analog of
+        ``MPITopology(comm, Val(M))`` (``MPITopologies.jl:133-136``)."""
+        if devices is None:
+            devices = jax.devices()
+        dims = dims_create(len(devices), ndims)
+        return cls(dims, devices=devices, axis_names=axis_names)
+
+    @classmethod
+    def from_mesh(cls, mesh: Mesh) -> "Topology":
+        t = cls.__new__(cls)
+        t._mesh = mesh
+        t._dims = tuple(mesh.devices.shape)
+        t._axis_names = tuple(mesh.axis_names)
+        return t
+
+    # -- accessors --------------------------------------------------------
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def dims(self) -> Tuple[int, ...]:
+        return self._dims
+
+    @property
+    def ndims(self) -> int:
+        return len(self._dims)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return self._axis_names
+
+    def __len__(self) -> int:
+        return math.prod(self._dims)
+
+    @cached_property
+    def ranks(self) -> np.ndarray:
+        """Linear rank of each coordinate (reference ``t.ranks``,
+        ``MPITopologies.jl:208-226``).  Ranks are row-major positions in the
+        device grid."""
+        return np.arange(len(self)).reshape(self._dims)
+
+    def coords(self, rank: int) -> Tuple[int, ...]:
+        """Cartesian coordinates of a linear rank."""
+        return tuple(int(c) for c in np.unravel_index(rank, self._dims))
+
+    def rank(self, coords: Sequence[int]) -> int:
+        """Linear rank of Cartesian coordinates (``MPI.Cart_rank``)."""
+        return int(np.ravel_multi_index(tuple(coords), self._dims))
+
+    def subcomm(self, i: int) -> str:
+        """The named mesh axis playing the role of ``subcomms[i]``."""
+        return self._axis_names[i]
+
+    def device(self, coords: Sequence[int]):
+        return self._mesh.devices[tuple(coords)]
+
+    # -- comparison -------------------------------------------------------
+    def __eq__(self, other) -> bool:
+        # Reference compares communicators with MPI.Comm_compare ∈
+        # {IDENT, CONGRUENT} (``MPITopologies.jl:121-123``): same process
+        # set and same Cartesian arrangement.
+        if not isinstance(other, Topology):
+            return NotImplemented
+        return (
+            self._dims == other._dims
+            and self._axis_names == other._axis_names
+            and self._mesh.devices.tolist() == other._mesh.devices.tolist()
+        )
+
+    def __hash__(self) -> int:
+        return hash((self._dims, self._axis_names,
+                     tuple(d.id for d in self._mesh.devices.flat)))
+
+    def __repr__(self) -> str:
+        return (
+            f"Topology(dims={self._dims}, axes={self._axis_names}, "
+            f"devices={len(self)})"
+        )

@@ -1,0 +1,17 @@
+from .permutations import (
+    AbstractPermutation,
+    NO_PERMUTATION,
+    NoPermutation,
+    Permutation,
+    as_permutation,
+    identity_permutation,
+)
+
+__all__ = [
+    "AbstractPermutation",
+    "NO_PERMUTATION",
+    "NoPermutation",
+    "Permutation",
+    "as_permutation",
+    "identity_permutation",
+]

@@ -1,0 +1,30 @@
+"""Test harness: simulate an 8-device TPU pod on CPU.
+
+Mirrors the reference's test strategy (``test/runtests.jl:48-53``) of
+simulating multi-node by multi-process on one box: here the analog is a
+single process with 8 virtual XLA host devices
+(``--xla_force_host_platform_device_count=8``), the JAX equivalent of the
+JLArray fake-GPU trick (``test/array_types.jl:13``).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (when present) re-forces its own platform; override.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,160 @@
+"""Pencil descriptor tests — parity with reference ``test/pencils.jl``
+semantics (ranges, sizes, orders, derivation), adapted to the ceil-block
+distribution rule (see ``pencil.py`` module docstring)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from pencilarrays_tpu import (
+    LogicalOrder,
+    MemoryOrder,
+    Pencil,
+    Permutation,
+    Topology,
+    local_data_range,
+    make_pencil,
+)
+from pencilarrays_tpu.parallel.pencil import complete_dims
+
+
+def test_local_data_range():
+    # ceil-block rule: contiguous, disjoint, covers 0..n-1
+    for n in (1, 5, 29, 31, 42, 64):
+        for P in (1, 2, 3, 4, 7, 8):
+            rs = [local_data_range(p, P, n) for p in range(P)]
+            flat = [i for r in rs for i in r]
+            assert flat == list(range(n))
+            b = -(-n // P)
+            assert all(len(r) <= b for r in rs)
+
+
+def test_complete_dims():
+    assert complete_dims(3, (1, 2), (4, 5)) == (1, 4, 5)
+    assert complete_dims(4, (0,), (7,), fill=2) == (7, 2, 2, 2)
+
+
+@pytest.fixture
+def topo(devices):
+    return Topology((2, 4))
+
+
+def test_pencil_basic(topo):
+    pen = Pencil(topo, (42, 31, 29), (1, 2))
+    assert pen.ndims == 3
+    assert pen.decomposition == (1, 2)
+    assert pen.size_global() == (42, 31, 29)
+    assert pen.size_global(MemoryOrder) == (42, 31, 29)
+    # dim1 over 2 devices: ceil(31/2)=16 -> padded 32; dim2 over 4: ceil(29/4)=8 -> 32
+    assert pen.padded_global_shape == (42, 32, 32)
+    assert pen.decomp_axis_name(0) is None
+    assert pen.decomp_axis_name(1) == "p1"
+    assert pen.decomp_axis_name(2) == "p2"
+    assert pen.proc_count(1) == 2 and pen.proc_count(2) == 4
+
+
+def test_default_decomposition(devices):
+    pen = make_pencil((42, 31, 29))
+    # reference default_decomposition decomposes the last N-1 dims
+    assert pen.decomposition == (1, 2)
+    assert sorted(pen.topology.dims, reverse=True) == [4, 2]
+
+
+def test_range_local(topo):
+    pen = Pencil(topo, (42, 31, 29), (1, 2))
+    r00 = pen.range_local((0, 0))
+    assert r00 == (range(0, 42), range(0, 16), range(0, 8))
+    r13 = pen.range_local((1, 3))
+    assert r13 == (range(0, 42), range(16, 31), range(24, 29))
+    # disjoint cover of the global domain per dim
+    covered = np.zeros((42, 31, 29), dtype=int)
+    for rank in range(8):
+        rr = pen.range_remote(rank)
+        covered[np.ix_(*[list(r) for r in rr])] += 1
+    assert (covered == 1).all()
+
+
+def test_size_local_and_to_local(topo):
+    pen = Pencil(topo, (42, 31, 29), (1, 2))
+    assert pen.size_local((0, 0)) == (42, 16, 8)
+    assert pen.size_local((1, 3)) == (42, 15, 5)
+    assert pen.padded_size_local() == (42, 16, 8)
+    assert pen.to_local((10, 20, 27), (1, 3)) == (10, 4, 3)
+    assert pen.length_global() == 42 * 31 * 29
+    total = sum(pen.length_local(pen.topology.coords(r)) for r in range(8))
+    assert total == pen.length_global()
+
+
+def test_permutation_orders(topo):
+    perm = Permutation(2, 0, 1)
+    pen = Pencil(topo, (42, 31, 29), (1, 2), permutation=perm)
+    assert pen.size_global(LogicalOrder) == (42, 31, 29)
+    assert pen.size_global(MemoryOrder) == (29, 42, 31)
+    assert pen.size_local((0, 0), MemoryOrder) == (8, 42, 16)
+    assert pen.padded_size_global(MemoryOrder) == (32, 42, 32)
+    assert pen.range_local((0, 0), MemoryOrder) == (
+        range(0, 8), range(0, 42), range(0, 16))
+
+
+def test_partition_spec(topo):
+    pen = Pencil(topo, (42, 31, 29), (1, 2))
+    assert tuple(pen.partition_spec()) == (None, "p1", "p2")
+    perm = Permutation(2, 0, 1)
+    pen_p = Pencil(topo, (42, 31, 29), (1, 2), permutation=perm)
+    assert tuple(pen_p.partition_spec()) == ("p2", None, "p1")
+    assert tuple(pen_p.partition_spec(extra_ndims=2)) == ("p2", None, "p1", None, None)
+    s = pen.sharding()
+    assert s.mesh.axis_names == ("p1", "p2")
+
+
+def test_replace_and_similar(topo):
+    pen = Pencil(topo, (42, 31, 29), (1, 2))
+    pen_y = pen.replace(decomp_dims=(0, 2))
+    assert pen_y.decomposition == (0, 2)
+    assert pen_y.topology is pen.topology
+    assert pen_y.size_global() == pen.size_global()
+    pen2 = pen.similar(global_shape=(16, 16, 16))
+    assert pen2.size_global() == (16, 16, 16)
+    assert pen2.decomposition == pen.decomposition
+    # permutation replacement
+    pen_p = pen.replace(permutation=Permutation(1, 2, 0))
+    assert pen_p.permutation == Permutation(1, 2, 0)
+    assert pen.permutation.is_identity()
+
+
+def test_validation(topo):
+    with pytest.raises(ValueError):
+        Pencil(topo, (8, 8, 8), (1,))  # M mismatch
+    with pytest.raises(ValueError):
+        Pencil(topo, (8, 8, 8), (1, 1))  # duplicate
+    with pytest.raises(ValueError):
+        Pencil(topo, (8, 8, 8), (1, 5))  # out of range
+
+
+def test_empty_rank_warning(topo):
+    # 2 rows over 4 devices on axis p2 -> empty blocks (Pencils.jl:193-218)
+    with pytest.warns(UserWarning, match="no data"):
+        Pencil(topo, (8, 8, 2), (1, 2))
+
+
+def test_eq_hash(topo):
+    a = Pencil(topo, (8, 8, 8), (1, 2))
+    b = Pencil(topo, (8, 8, 8), (1, 2))
+    assert a == b and hash(a) == hash(b)
+    assert a != a.replace(decomp_dims=(0, 2))
+    assert a != a.replace(permutation=Permutation(1, 0, 2))
+
+
+def test_full_decomposition(topo):
+    # M == N decomposition is allowed (test/pencils.jl:523-542)
+    pen = Pencil(topo, (8, 8), (0, 1))
+    assert pen.size_local((0, 0)) == (4, 2)
+    assert pen.padded_global_shape == (8, 8)
+
+
+def test_axes_all(topo):
+    pen = Pencil(topo, (42, 31, 29), (1, 2))
+    table = pen.axes_all
+    assert table.shape == (2, 4)
+    assert table[(1, 3)] == pen.range_local((1, 3))

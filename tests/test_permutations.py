@@ -1,0 +1,93 @@
+"""Permutation algebra tests — semantics parity with the reference's
+``test/permutations.jl`` (StaticPermutations behavior, 0-based here)."""
+
+import itertools
+
+import pytest
+
+from pencilarrays_tpu import NO_PERMUTATION, NoPermutation, Permutation
+from pencilarrays_tpu.utils.permutations import as_permutation, identity_permutation
+
+
+def test_apply_basic():
+    # Julia: Permutation(2,3,1) * (x1,x2,x3) == (x2,x3,x1); 0-based: (1,2,0)
+    p = Permutation(1, 2, 0)
+    assert p.apply(("a", "b", "c")) == ("b", "c", "a")
+    assert p.invapply(p.apply((1, 2, 3))) == (1, 2, 3)
+    assert p.apply(p.invapply((1, 2, 3))) == (1, 2, 3)
+
+
+def test_invalid():
+    with pytest.raises(ValueError):
+        Permutation(0, 0, 1)
+    with pytest.raises(ValueError):
+        Permutation(1, 2, 3)
+    with pytest.raises(ValueError):
+        Permutation(2, 0, 1).apply((1, 2))
+
+
+def test_identity_and_nopermutation():
+    np_ = NoPermutation()
+    assert np_ is NO_PERMUTATION  # singleton
+    assert np_.apply((3, 1, 2)) == (3, 1, 2)
+    assert np_.invapply((3, 1, 2)) == (3, 1, 2)
+    assert np_ == Permutation(0, 1, 2)
+    assert Permutation(0, 1, 2) == np_
+    assert Permutation(0, 1, 2).is_identity()
+    assert not Permutation(1, 0, 2).is_identity()
+    assert identity_permutation(4) == NO_PERMUTATION
+
+
+def test_compose_inverse_exhaustive():
+    # (p * q).apply(t) == p.apply(q.apply(t)) for every pair of 3-perms.
+    t = ("x", "y", "z")
+    for a in itertools.permutations(range(3)):
+        for b in itertools.permutations(range(3)):
+            p, q = Permutation(a), Permutation(b)
+            assert (p * q).apply(t) == p.apply(q.apply(t))
+            assert (p * p.inverse()).is_identity()
+            assert (p.inverse() * p).is_identity()
+            # relative permutation r = p / q satisfies r * q == p
+            r = p / q
+            assert (r * q) == p
+
+
+def test_compose_with_nopermutation():
+    p = Permutation(2, 0, 1)
+    assert (p * NO_PERMUTATION) == p
+    assert (NO_PERMUTATION * p) == p
+    assert (NO_PERMUTATION * NO_PERMUTATION) == NO_PERMUTATION
+    assert NO_PERMUTATION.inverse() is NO_PERMUTATION
+
+
+def test_append_prepend():
+    # Reference ``append`` identity-extends for extra dims (arrays.jl:34-47).
+    p = Permutation(1, 0)
+    assert p.append(2) == Permutation(1, 0, 2, 3)
+    assert p.prepend(2) == Permutation(0, 1, 3, 2)
+    assert NO_PERMUTATION.append(3) is NO_PERMUTATION
+
+
+def test_hash_eq():
+    assert hash(Permutation(1, 0)) == hash(Permutation(1, 0))
+    s = {Permutation(1, 0), Permutation(1, 0), NO_PERMUTATION}
+    assert len(s) == 2
+    # eq/hash contract: identity Permutation == NoPermutation
+    assert hash(Permutation(0, 1, 2)) == hash(NO_PERMUTATION)
+    assert len({Permutation(0, 1, 2), NO_PERMUTATION}) == 1
+
+
+def test_as_permutation():
+    assert as_permutation(None, 3) is NO_PERMUTATION
+    assert as_permutation((2, 0, 1), 3) == Permutation(2, 0, 1)
+    with pytest.raises(ValueError):
+        as_permutation((1, 0), 3)
+
+
+def test_axes_for_transpose():
+    import numpy as np
+
+    x = np.arange(24).reshape(2, 3, 4)
+    p = Permutation(2, 0, 1)
+    y = np.transpose(x, p.axes())
+    assert y.shape == p.apply(x.shape)

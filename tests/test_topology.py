@@ -1,0 +1,72 @@
+"""Topology tests — parity with reference ``MPITopologies.jl`` semantics."""
+
+import numpy as np
+import pytest
+
+from pencilarrays_tpu import Topology, dims_create
+
+
+def test_dims_create():
+    # MPI_Dims_create-style balanced factorizations (MPITopologies.jl:138-144)
+    assert dims_create(8, 2) in ((4, 2),)
+    assert dims_create(8, 3) == (2, 2, 2)
+    assert dims_create(6, 2) == (3, 2)
+    assert dims_create(7, 2) == (7, 1)
+    assert dims_create(1, 3) == (1, 1, 1)
+    assert dims_create(12, 2) == (4, 3)
+    with pytest.raises(ValueError):
+        dims_create(0, 2)
+
+
+def test_topology_basic(devices):
+    t = Topology((2, 4))
+    assert t.dims == (2, 4)
+    assert len(t) == 8
+    assert t.ndims == 2
+    assert t.axis_names == ("p1", "p2")
+    assert t.mesh.axis_names == ("p1", "p2")
+    assert t.subcomm(0) == "p1"
+    assert t.subcomm(1) == "p2"
+
+
+def test_topology_auto(devices):
+    t = Topology.auto(2)
+    assert sorted(t.dims, reverse=True) == [4, 2]
+    t3 = Topology.auto(3)
+    assert t3.dims == (2, 2, 2)
+
+
+def test_ranks_coords_roundtrip(devices):
+    t = Topology((2, 4))
+    assert t.ranks.shape == (2, 4)
+    for r in range(8):
+        assert t.rank(t.coords(r)) == r
+    assert t.coords(0) == (0, 0)
+    assert t.coords(7) == (1, 3)
+    # row-major like MPI Cartesian default
+    assert t.rank((1, 0)) == 4
+
+
+def test_topology_errors(devices):
+    with pytest.raises(ValueError):
+        Topology((3, 4))  # 12 != 8 devices
+    with pytest.raises(ValueError):
+        Topology((2, 2))  # 4 != 8: exact match required (MPITopologies.jl:152-156)
+    with pytest.raises(ValueError):
+        Topology((2, 2), devices=devices[:4], axis_names=("a",))
+    with pytest.raises(ValueError):
+        Topology((2, 2), devices=devices[:4], axis_names=("a", "a"))
+
+
+def test_topology_eq(devices):
+    a, b = Topology((2, 4)), Topology((2, 4))
+    assert a == b and hash(a) == hash(b)
+    assert a != Topology((4, 2))
+    # same dims, different axis names -> different (subcomm identity differs)
+    assert a != Topology((2, 4), axis_names=("x", "y"))
+
+
+def test_subset_of_devices(devices):
+    t = Topology((2, 2), devices=devices[:4])
+    assert len(t) == 4
+    assert t.device((0, 0)).id == devices[0].id
