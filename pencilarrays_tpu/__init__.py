@@ -24,14 +24,22 @@ from .utils.permutations import (  # noqa: F401
     Permutation,
 )
 from .parallel import (  # noqa: F401
+    AllToAll,
+    Gspmd,
     IndexOrder,
     LogicalOrder,
     MemoryOrder,
     Pencil,
+    PencilArray,
     Topology,
+    Transposition,
     dims_create,
+    gather,
+    global_view,
     local_data_range,
     make_pencil,
+    reshard,
+    transpose,
 )
 
 __version__ = "0.1.0"

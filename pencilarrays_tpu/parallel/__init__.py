@@ -8,8 +8,27 @@ from .pencil import (
     local_data_range,
     make_pencil,
 )
+from .arrays import PencilArray, global_view
+from .transpositions import (
+    AllToAll,
+    Gspmd,
+    Transposition,
+    assert_compatible,
+    reshard,
+    transpose,
+)
+from .gather import gather
 
 __all__ = [
+    "PencilArray",
+    "global_view",
+    "AllToAll",
+    "Gspmd",
+    "Transposition",
+    "assert_compatible",
+    "reshard",
+    "transpose",
+    "gather",
     "Topology",
     "default_axis_names",
     "dims_create",

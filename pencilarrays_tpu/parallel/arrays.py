@@ -1,0 +1,450 @@
+"""PencilArray — a distributed array wrapping a sharded global ``jax.Array``.
+
+TPU-native re-design of ``src/arrays.jl`` (struct at ``arrays.jl:81-122``).
+The reference wraps each rank's *local* block, stored in memory order and
+indexed in logical order; the global object only exists implicitly.  Under
+JAX's single-controller SPMD model the natural primary object is the
+**global** array: a :class:`PencilArray` holds one ``jax.Array`` whose
+``NamedSharding`` is derived from its :class:`Pencil`, letting GSPMD own the
+local-block bookkeeping the reference does by hand.
+
+Storage contract (checked at construction, cf. ``arrays.jl:108-114``):
+
+``data.shape == pencil.padded_size_global(MemoryOrder) + extra_dims``
+
+i.e. the backing array is stored in *memory order* (the pencil's
+permutation applied), with each decomposed dim padded to a multiple of its
+device count (JAX requires evenly divisible shards), plus trailing
+*extra dims* — non-spatial component axes that are never permuted nor
+decomposed (``arrays.jl:34-47``).  Padding lives at the tail of each
+decomposed dim and is kept zero-filled by constructors; reductions mask it
+(see ``ops/reductions.py``), transposes slice it off before re-padding.
+
+Indexing divergence: reference ``getindex`` takes *local* logical indices
+(``arrays.jl:327-337``); here ``__getitem__`` takes **global** logical
+indices, because the wrapper is the global array.  The reference's
+``GlobalPencilArray``/``global_view`` (``global_view.jl:20-26``) therefore
+collapses to the identity here, and local blocks are available via
+:meth:`local_block`.
+
+PencilArray is a registered pytree (data leaf; pencil/extra static), so it
+flows through ``jax.jit``/``grad``/``vmap`` unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.permutations import NO_PERMUTATION
+from .pencil import IndexOrder, LogicalOrder, MemoryOrder, Pencil
+
+__all__ = ["PencilArray", "global_view"]
+
+
+def _fwd_axes(pencil: Pencil, extra_ndims: int) -> Tuple[int, ...]:
+    """Axes tuple for ``jnp.transpose`` converting logical -> memory order:
+    ``transpose(u, perm.axes())`` has shape ``perm.apply(u.shape)`` and
+    satisfies ``mem[perm.apply(I)] == u[I]`` (extra dims ride along)."""
+    perm = pencil.permutation
+    if perm is NO_PERMUTATION or perm.is_identity():
+        return tuple(range(pencil.ndims + extra_ndims))
+    return perm.append(extra_ndims).axes()
+
+
+def _inv_axes(pencil: Pencil, extra_ndims: int) -> Tuple[int, ...]:
+    """Axes tuple converting memory order -> logical order (extra dims kept)."""
+    perm = pencil.permutation
+    if perm is NO_PERMUTATION or perm.is_identity():
+        return tuple(range(pencil.ndims + extra_ndims))
+    return perm.inverse().append(extra_ndims).axes()
+
+
+class PencilArray:
+    """Distributed N-dim array over a :class:`Pencil` decomposition."""
+
+    __slots__ = ("_pencil", "_data", "_extra_dims")
+
+    def __init__(self, pencil: Pencil, data, extra_dims: Optional[Tuple[int, ...]] = None):
+        expected_space = pencil.padded_size_global(MemoryOrder)
+        if extra_dims is None:
+            # Infer trailing extra dims (cf. reference ``arrays.jl:97-121``
+            # where extra dims are the axes beyond the pencil's N).
+            nspace = len(expected_space)
+            extra_dims = tuple(int(d) for d in data.shape[nspace:])
+        extra_dims = tuple(int(d) for d in extra_dims)
+        expected = expected_space + extra_dims
+        if tuple(data.shape) != expected:
+            raise ValueError(
+                f"data shape {tuple(data.shape)} does not match pencil's padded "
+                f"memory-order shape {expected_space} + extra dims {extra_dims} "
+                f"(= {expected})"
+            )
+        self._pencil = pencil
+        self._data = data
+        self._extra_dims = extra_dims
+
+    # -- constructors -----------------------------------------------------
+    @classmethod
+    def zeros(cls, pencil: Pencil, extra_dims: Tuple[int, ...] = (),
+              dtype=jnp.float32) -> "PencilArray":
+        shape = pencil.padded_size_global(MemoryOrder) + tuple(extra_dims)
+        data = jnp.zeros(shape, dtype=dtype, device=pencil.sharding(len(extra_dims)))
+        return cls(pencil, data, tuple(extra_dims))
+
+    @classmethod
+    def full(cls, pencil: Pencil, fill_value, extra_dims: Tuple[int, ...] = (),
+             dtype=None) -> "PencilArray":
+        # Note: padding is also filled; reductions mask it, but keep this in
+        # mind when reading raw .data.
+        shape = pencil.padded_size_global(MemoryOrder) + tuple(extra_dims)
+        data = jnp.full(shape, fill_value, dtype=dtype,
+                        device=pencil.sharding(len(extra_dims)))
+        return cls(pencil, data, tuple(extra_dims))
+
+    @classmethod
+    def from_global(cls, pencil: Pencil, array,
+                    extra_ndims: Optional[int] = None) -> "PencilArray":
+        """Build from a true-shape, *logical-order* global array (NumPy or
+        JAX), padding/permuting/sharding as the pencil dictates."""
+        arr = jnp.asarray(array)
+        N = pencil.ndims
+        if extra_ndims is None:
+            extra_ndims = arr.ndim - N
+        if extra_ndims != arr.ndim - N:
+            raise ValueError(
+                f"extra_ndims={extra_ndims} inconsistent with array rank "
+                f"{arr.ndim} and pencil rank {N}"
+            )
+        if extra_ndims < 0:
+            raise ValueError(
+                f"array rank {arr.ndim} below pencil rank {N}")
+        space_shape = tuple(arr.shape[:N])
+        extra_dims = tuple(arr.shape[N:])
+        if space_shape != pencil.size_global(LogicalOrder):
+            raise ValueError(
+                f"array spatial shape {space_shape} != pencil global shape "
+                f"{pencil.size_global(LogicalOrder)}"
+            )
+        padded = pencil.padded_global_shape
+        pad = [(0, p - n) for n, p in zip(space_shape, padded)]
+        pad += [(0, 0)] * extra_ndims
+        arr = jnp.pad(arr, pad)
+        arr = jnp.transpose(arr, _fwd_axes(pencil, extra_ndims))
+        arr = jax.device_put(arr, pencil.sharding(extra_ndims))
+        return cls(pencil, arr, extra_dims)
+
+    def similar(self, pencil: Optional[Pencil] = None, dtype=None,
+                extra_dims: Optional[Tuple[int, ...]] = None) -> "PencilArray":
+        """Uninitialized (zero) array, possibly over another pencil/type —
+        the cross-pencil ``similar`` of ``arrays.jl:287-303``."""
+        pen = self._pencil if pencil is None else pencil
+        dt = self._data.dtype if dtype is None else dtype
+        ed = self._extra_dims if extra_dims is None else tuple(extra_dims)
+        return PencilArray.zeros(pen, ed, dt)
+
+    # -- pytree -----------------------------------------------------------
+    def tree_flatten(self):
+        return (self._data,), (self._pencil, self._extra_dims)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        pencil, extra_dims = aux
+        (data,) = children
+        obj = cls.__new__(cls)
+        obj._pencil = pencil
+        obj._data = data
+        obj._extra_dims = extra_dims
+        return obj
+
+    # -- accessors --------------------------------------------------------
+    @property
+    def pencil(self) -> Pencil:
+        return self._pencil
+
+    @property
+    def data(self):
+        """Backing memory-order padded ``jax.Array`` (reference ``parent``)."""
+        return self._data
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def extra_dims(self) -> Tuple[int, ...]:
+        return self._extra_dims
+
+    @property
+    def ndims_extra(self) -> int:
+        """Reference ``ndims_extra`` (``arrays.jl:217-224``)."""
+        return len(self._extra_dims)
+
+    @property
+    def ndims_space(self) -> int:
+        """Reference ``ndims_space``."""
+        return self._pencil.ndims
+
+    @property
+    def ndim(self) -> int:
+        return self._pencil.ndims + len(self._extra_dims)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        """True global logical shape + extra dims.
+
+        Divergence from the reference, where ``size(x)`` is the *local*
+        shape (``size.jl:22-23``): under single-controller JAX the wrapper
+        is the global array, so the global shape is the primary one.  Use
+        :meth:`size_local` for the per-block shape.
+        """
+        return self.size_global()
+
+    def size_global(self, order: IndexOrder = LogicalOrder) -> Tuple[int, ...]:
+        return self._pencil.size_global(order) + self._extra_dims
+
+    def size_local(self, coords=None, order: IndexOrder = LogicalOrder):
+        return self._pencil.size_local(coords, order) + self._extra_dims
+
+    def range_local(self, coords=None, order: IndexOrder = LogicalOrder):
+        if coords is None:
+            coords = (0,) * self._pencil.topology.ndims
+        return self._pencil.range_local(coords, order) + tuple(
+            range(0, d) for d in self._extra_dims
+        )
+
+    def length_global(self) -> int:
+        return math.prod(self.size_global())
+
+    def __len__(self) -> int:
+        return self.shape[0] if self.shape else 0
+
+    @property
+    def sharding(self):
+        return self._data.sharding
+
+    def sizeof_global(self) -> int:
+        """Total global size in bytes (reference ``sizeof_global``,
+        ``arrays.jl:428``); excludes padding."""
+        return self.length_global() * self._data.dtype.itemsize
+
+    # -- views ------------------------------------------------------------
+    def logical(self):
+        """The true-shape global array in logical order (a traced value —
+        lazy under ``jit``, materializes when consumed eagerly)."""
+        nd = len(self._extra_dims)
+        arr = jnp.transpose(self._data, _inv_axes(self._pencil, nd))
+        slices = tuple(slice(0, n) for n in self._pencil.size_global(LogicalOrder))
+        return arr[slices]
+
+    def local_block(self, coords=None, order: IndexOrder = LogicalOrder):
+        """The true-size block owned by topology ``coords`` as a jnp value
+        (reference: the wrapped local array itself)."""
+        if coords is None:
+            coords = (0,) * self._pencil.topology.ndims
+        pen = self._pencil
+        block = pen.padded_size_local(LogicalOrder)
+        # Logical-order slices into the padded array: decomposed dim d at
+        # topology position i starts at coords[i] * padded_block_extent.
+        idx_logical = []
+        for d in range(pen.ndims):
+            try:
+                i = pen.decomposition.index(d)
+            except ValueError:
+                start = 0
+            else:
+                start = coords[i] * block[d]
+            extent = len(pen.range_local(tuple(coords), LogicalOrder)[d])
+            idx_logical.append(slice(start, start + extent))
+        idx = list(pen.permutation.apply(tuple(idx_logical)))
+        idx += [slice(None)] * len(self._extra_dims)
+        block = self._data[tuple(idx)]
+        if order is LogicalOrder:
+            block = jnp.transpose(block, _inv_axes(self._pencil, len(self._extra_dims)))
+        return block
+
+    # -- indexing ---------------------------------------------------------
+    def _normalize_index(self, key):
+        N = self._pencil.ndims
+        nd = self.ndim
+        if not isinstance(key, tuple):
+            key = (key,)
+        if any(k is Ellipsis for k in key):
+            n_missing = nd - (len(key) - 1)
+            out = []
+            for k in key:
+                if k is Ellipsis:
+                    out.extend([slice(None)] * n_missing)
+                else:
+                    out.append(k)
+            key = tuple(out)
+        if len(key) < nd:
+            key = key + (slice(None),) * (nd - len(key))
+        if len(key) != nd:
+            raise IndexError(f"too many indices ({len(key)}) for rank {nd}")
+        # Resolve against true sizes (negative wrap, slice clamping) so that
+        # padding is never addressed.
+        true = self.size_global()
+        resolved = []
+        for k, n in zip(key, true):
+            if isinstance(k, slice):
+                start, stop, step = k.indices(n)
+                # A reversed slice reaching index 0 normalizes to stop=-1,
+                # which must NOT be re-fed literally (it would wrap to the
+                # padded tail); use None ("past the beginning") instead.
+                resolved.append(slice(start, None if stop < 0 else stop, step))
+            elif isinstance(k, (int, np.integer)):
+                kk = int(k)
+                if kk < -n or kk >= n:
+                    raise IndexError(f"index {kk} out of bounds for size {n}")
+                resolved.append(kk % n if kk < 0 else kk)
+            else:
+                raise NotImplementedError(
+                    "PencilArray indexing supports int/slice/Ellipsis only; "
+                    "for fancy indexing use .logical()"
+                )
+        return tuple(resolved)
+
+    def __getitem__(self, key):
+        """Global *logical* basic indexing (see module docstring for the
+        divergence from reference local indexing).  The permutation is
+        applied to the index tuple at trace time — the analog of the
+        reference's ``parent[perm * I]`` (``arrays.jl:327-337``)."""
+        key = self._normalize_index(key)
+        N = self._pencil.ndims
+        space, extra = key[:N], key[N:]
+        mem_key = self._pencil.permutation.apply(space) + extra
+        out = self._data[mem_key]
+        # Result axes arrive in memory order of the kept (sliced) spatial
+        # dims; reorder them back to logical order.
+        mem_logical_ids = self._pencil.permutation.apply(tuple(range(N)))
+        kept = [d for d, k in zip(mem_logical_ids, mem_key[:N])
+                if isinstance(k, slice)]
+        ax = tuple(int(i) for i in np.argsort(kept, kind="stable"))
+        if ax != tuple(range(len(ax))):
+            n_extra_kept = sum(isinstance(k, slice) for k in extra)
+            out = jnp.transpose(
+                out, ax + tuple(range(len(ax), len(ax) + n_extra_kept))
+            )
+        return out
+
+    # -- conversion -------------------------------------------------------
+    def __array__(self, dtype=None):
+        arr = np.asarray(jax.device_get(self.logical()))
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __jax_array__(self):
+        return self.logical()
+
+    # -- arithmetic (memory-order, parent-level: broadcast.jl parity) -----
+    def _binop(self, other, op):
+        if isinstance(other, PencilArray):
+            if other._pencil != self._pencil:
+                raise ValueError(
+                    "operands live on different pencils; transpose first "
+                    "(cf. reference broadcast.jl which requires matching "
+                    "pencil configurations)"
+                )
+            if other._extra_dims != self._extra_dims:
+                raise ValueError(
+                    f"extra_dims mismatch: {self._extra_dims} vs "
+                    f"{other._extra_dims}"
+                )
+            return PencilArray(self._pencil, op(self._data, other._data),
+                               self._extra_dims)
+        if isinstance(other, (int, float, complex, jnp.ndarray, np.ndarray)) and (
+            not hasattr(other, "shape") or other.shape == ()
+        ):
+            return PencilArray(self._pencil, op(self._data, other),
+                               self._extra_dims)
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binop(o, lambda a, b: a + b)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, lambda a, b: a - b)
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda a, b: b - a)
+
+    def __mul__(self, o):
+        return self._binop(o, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, lambda a, b: a / b)
+
+    def __rtruediv__(self, o):
+        return self._binop(o, lambda a, b: b / a)
+
+    def __pow__(self, o):
+        return self._binop(o, lambda a, b: a**b)
+
+    def __neg__(self):
+        return PencilArray(self._pencil, -self._data, self._extra_dims)
+
+    def __abs__(self):
+        return PencilArray(self._pencil, jnp.abs(self._data), self._extra_dims)
+
+    def map(self, f, *others: "PencilArray") -> "PencilArray":
+        """Elementwise map in memory order over parents — the analog of the
+        reference's broadcasting, which unwraps every PencilArray and runs
+        on parents so no scalar indexing / no layout churn happens
+        (``broadcast.jl:31-57``)."""
+        for o in others:
+            if o._pencil != self._pencil:
+                raise ValueError("pencil mismatch in map")
+        out = f(self._data, *(o._data for o in others))
+        return PencilArray(self._pencil, out, self._extra_dims)
+
+    def fill(self, value) -> "PencilArray":
+        """Return a filled copy (reference ``fill!``, ``arrays.jl:494-526``)."""
+        return PencilArray(
+            self._pencil, jnp.full_like(self._data, value), self._extra_dims
+        )
+
+    # -- comparison -------------------------------------------------------
+    def __eq__(self, other):
+        # Compare logical (true-shape) views: tail padding is storage
+        # detail and may legitimately differ (e.g. after scalar arithmetic
+        # which also touches padding).
+        if isinstance(other, PencilArray):
+            if self._pencil != other._pencil or self._extra_dims != other._extra_dims:
+                return False
+            return bool((self.logical() == other.logical()).all())
+        return NotImplemented
+
+    __hash__ = None
+
+    def allclose(self, other: "PencilArray", **kw) -> bool:
+        if self._pencil != other._pencil:
+            raise ValueError("pencil mismatch")
+        return bool(jnp.allclose(self.logical(), other.logical(), **kw))
+
+    def __repr__(self) -> str:
+        return (
+            f"PencilArray(shape={self.shape}, dtype={self.dtype}, "
+            f"pencil={self._pencil!r}, extra_dims={self._extra_dims})"
+        )
+
+
+jax.tree_util.register_pytree_node(
+    PencilArray,
+    lambda x: x.tree_flatten(),
+    PencilArray.tree_unflatten,
+)
+
+
+def global_view(x: PencilArray) -> PencilArray:
+    """Reference ``global_view`` (``global_view.jl``): returns an object
+    indexed by global indices.  Here the PencilArray already *is* globally
+    indexed, so this is the identity (kept for API parity)."""
+    return x

@@ -1,0 +1,32 @@
+"""Gather a distributed array to the host — verification/debug path.
+
+Reference ``src/gather.jl``: every rank un-permutes its block, converts to
+a CPU array, and ``Isend``s it to the root, which assembles the global
+array (``gather.jl:17-100``).  Root-only return made sense per-rank; under
+single-controller JAX the analog is simply fetching the logical view to
+host memory (``jax.device_get`` of the unpermuted, unpadded global value)
+— one collective-free device->host copy per shard, assembled by the
+runtime.
+
+Like the reference (``docs/src/Transpositions.md:18-24``), this is meant
+for tests and debugging, not the hot path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .arrays import PencilArray
+
+__all__ = ["gather"]
+
+
+def gather(x: PencilArray, root: int = 0) -> np.ndarray:
+    """Return the full global array (logical order, true shape) as NumPy.
+
+    The ``root`` argument exists for signature parity with the reference
+    (``gather(x, root=0)``); in a single-controller program every caller
+    is "root", so the array is always returned.
+    """
+    del root
+    return np.asarray(x)

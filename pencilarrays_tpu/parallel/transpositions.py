@@ -1,0 +1,279 @@
+"""Global transpose (redistribution) engine — THE hot path.
+
+TPU-native re-design of ``src/Transpositions/Transpositions.jl``.  The
+reference implements decomposition-to-decomposition redistribution by hand:
+per-peer intersection ranges (``Transpositions.jl:383-388``), pack into
+shared byte buffers (``copy_range!``, ``:555-586``), a nonblocking
+``Isend/Irecv``/``Waitany`` pipeline or a single ``MPI.Alltoallv!``
+(``:61-68``), and a permuting unpack (``copy_permuted!``, ``:636-667``).
+
+On TPU none of that is hand-scheduled.  The whole exchange is expressed as
+a traced function XLA compiles onto the ICI fabric:
+
+* the per-peer send/recv sets collapse to one ``jax.lax.all_to_all`` on
+  the *single differing mesh axis* — exactly the reference's exchange
+  confined to ``topology.subcomms[R]`` (``Transpositions.jl:294-298``);
+* pack/unpack become ``jnp.transpose`` / pad / slice that XLA fuses with
+  neighbouring ops (the reference's Strided.jl lazy permuted copies,
+  ``:636-648``, are what the fusion replaces);
+* ragged (non-divisible) blocks are handled by the pencil's tail padding:
+  pad the to-be-split dim, exchange equal tiles, slice the now-local dim
+  back to its true size — padding is contiguous at the global tail because
+  of the ceil-block distribution, so a single slice removes it;
+* overlap (``waitall=false`` + ``MPI.Waitany`` unpack loop,
+  ``:142-158, 510-516``) is XLA's latency-hiding scheduler's job: the
+  collective is async at dispatch and the compiler interleaves it with
+  independent compute — by design there is no user-visible wait handle.
+
+Two methods (reference ``Transpositions.jl:17-24``):
+
+* :class:`AllToAll` (default) — explicit ``shard_map`` + ``lax.all_to_all``
+  on the differing axis.  Deterministic collective choice; the analog of
+  ``Alltoallv()``.  Restricted, like the reference, to configurations
+  whose decompositions differ in at most one slot (``:182-199``).
+* :class:`Gspmd` — express only the *layout change* and let the GSPMD
+  partitioner insert collectives (``with_sharding_constraint``).  The
+  analog of leaving scheduling to the runtime (``PointToPoint()``'s
+  spirit); also powers the unrestricted :func:`reshard`, which can change
+  any number of decomposed dims at once (beyond reference capability).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache, partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .arrays import PencilArray, _fwd_axes, _inv_axes
+from .pencil import LogicalOrder, MemoryOrder, Pencil
+
+__all__ = [
+    "AllToAll",
+    "Gspmd",
+    "Transposition",
+    "transpose",
+    "reshard",
+    "assert_compatible",
+]
+
+
+class AbstractTransposeMethod:
+    pass
+
+
+@dataclass(frozen=True)
+class AllToAll(AbstractTransposeMethod):
+    """Explicit single-axis ``lax.all_to_all`` under ``shard_map``."""
+
+
+@dataclass(frozen=True)
+class Gspmd(AbstractTransposeMethod):
+    """Compiler-scheduled resharding via ``with_sharding_constraint``."""
+
+
+def assert_compatible(pin: Pencil, pout: Pencil) -> Optional[int]:
+    """Check transposability and return the differing decomposition slot
+    ``R`` (or ``None`` if decompositions are identical).
+
+    Mirrors ``assert_compatible`` (``Transpositions.jl:182-199``): same
+    topology, same global size, decompositions differing in at most one
+    slot.
+    """
+    if pin.topology != pout.topology:
+        raise ValueError("transpose: pencil topologies differ")
+    if pin.size_global() != pout.size_global():
+        raise ValueError(
+            f"transpose: global shapes differ "
+            f"({pin.size_global()} vs {pout.size_global()})"
+        )
+    diff = [
+        i for i, (a, b) in enumerate(zip(pin.decomposition, pout.decomposition))
+        if a != b
+    ]
+    if len(diff) > 1:
+        raise ValueError(
+            f"transpose: decompositions {pin.decomposition} -> "
+            f"{pout.decomposition} differ in more than one slot; chain "
+            f"transposes (x->y->z) or use reshard()"
+        )
+    return diff[0] if diff else None
+
+
+# ---------------------------------------------------------------------------
+# explicit all-to-all path
+# ---------------------------------------------------------------------------
+
+def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
+                          extra_ndims: int):
+    """Exchange on topology axis ``R``: logical dim ``a = pin.decomposition[R]``
+    becomes local, logical dim ``b = pout.decomposition[R]`` becomes
+    decomposed.  ``data`` is the memory-order padded global array."""
+    mesh = pin.mesh
+    axis = pin.topology.axis_names[R]
+    P = pin.topology.dims[R]
+    a = pin.decomposition[R]  # decomposed in input, local in output
+    b = pout.decomposition[R]  # local in input, decomposed in output
+    n_a = pin.size_global()[a]
+    n_b = pin.size_global()[b]
+    b_pad = pout.padded_global_shape[b]  # post-exchange padded extent of dim b
+
+    in_spec = pin.partition_spec(extra_ndims)
+    out_spec = pout.partition_spec(extra_ndims)
+
+    inv_in = _inv_axes(pin, extra_ndims)     # memory -> logical
+    fwd_out = _fwd_axes(pout, extra_ndims)   # logical -> memory
+
+    def local_fn(block):
+        # block: local memory-order tile; go logical for the exchange.
+        x = jnp.transpose(block, inv_in)
+        # Pad dim b (fully local here) to its post-exchange padded extent.
+        if b_pad != n_b:
+            pad = [(0, 0)] * x.ndim
+            pad[b] = (0, b_pad - n_b)
+            x = jnp.pad(x, pad)
+        # The exchange: split dim b into P tiles, concat received tiles
+        # along dim a.  This is the reference's entire
+        # pack -> Alltoallv -> unpack pipeline in one op.
+        x = jax.lax.all_to_all(x, axis, split_axis=b, concat_axis=a, tiled=True)
+        # Dim a is now fully local with padded extent; drop tail padding.
+        if x.shape[a] != n_a:
+            x = jax.lax.slice_in_dim(x, 0, n_a, axis=a)
+        # Store in the output pencil's memory order.
+        return jnp.transpose(x, fwd_out)
+
+    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=in_spec,
+                       out_specs=out_spec)
+    return fn(data)
+
+
+def _transpose_local(data, pin: Pencil, pout: Pencil, extra_ndims: int):
+    """Same decomposition — only the permutation (storage order) changes;
+    a pure local permute (reference ``transpose_impl!`` local path,
+    ``Transpositions.jl:214-271``)."""
+    rel = pout.permutation / pin.permutation
+    if rel.is_identity():
+        return data
+    # memory(in) -> logical -> memory(out), as one transpose.
+    axes_logical_to_out = _fwd_axes(pout, extra_ndims)
+    axes_in_to_logical = _inv_axes(pin, extra_ndims)
+    axes = tuple(axes_in_to_logical[i] for i in axes_logical_to_out)
+    out = jnp.transpose(data, axes)
+    return jax.lax.with_sharding_constraint(out, pout.sharding(extra_ndims))
+
+
+# ---------------------------------------------------------------------------
+# GSPMD path
+# ---------------------------------------------------------------------------
+
+def _reshard_gspmd(data, pin: Pencil, pout: Pencil, extra_ndims: int):
+    """Express the layout change; let the partitioner insert collectives.
+
+    Handles arbitrary decomposition changes (not just single-slot)."""
+    # memory(in), padded(in) -> logical true shape
+    x = jnp.transpose(data, _inv_axes(pin, extra_ndims))
+    true = pin.size_global()
+    if x.shape[: pin.ndims] != true:
+        x = x[tuple(slice(0, n) for n in true) + (slice(None),) * extra_ndims]
+    # logical true -> padded(out)
+    padded = pout.padded_global_shape
+    if padded != true:
+        pad = [(0, p - n) for n, p in zip(true, padded)]
+        pad += [(0, 0)] * extra_ndims
+        x = jnp.pad(x, pad)
+    x = jnp.transpose(x, _fwd_axes(pout, extra_ndims))
+    return jax.lax.with_sharding_constraint(x, pout.sharding(extra_ndims))
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=512)
+def _compiled_transpose(pin: Pencil, pout: Pencil, R: Optional[int],
+                        extra_ndims: int,
+                        method: AbstractTransposeMethod):
+    """Compiled data->data transpose, cached on the static configuration.
+
+    Pencils are frozen/hashable, so (pin, pout, method) is a complete key.
+    Without this cache, eager callers would re-trace (and re-compile) the
+    shard_map closure on every call — the analog of the reference reusing
+    its preallocated send/recv buffers across transposes
+    (``Pencils.jl:151-192``), but for compiled executables.
+    """
+    if R is None:
+        fn = lambda data: _transpose_local(data, pin, pout, extra_ndims)
+    elif isinstance(method, AllToAll):
+        fn = lambda data: _transpose_all_to_all(data, pin, pout, R, extra_ndims)
+    elif isinstance(method, Gspmd):
+        fn = lambda data: _reshard_gspmd(data, pin, pout, extra_ndims)
+    else:
+        raise TypeError(f"unknown transpose method {method!r}")
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=512)
+def _compiled_reshard(pin: Pencil, pout: Pencil, extra_ndims: int):
+    return jax.jit(lambda data: _reshard_gspmd(data, pin, pout, extra_ndims))
+
+
+def transpose(src: PencilArray, dest: Pencil, *,
+              method: AbstractTransposeMethod = AllToAll()) -> PencilArray:
+    """Redistribute ``src`` into the ``dest`` pencil configuration
+    (reference ``transpose!``, ``Transpositions.jl:161-180``).
+
+    Traceable: call it inside ``jax.jit`` and the exchange fuses into the
+    surrounding program.  Pure (returns a new PencilArray); in-place reuse
+    is the compiler's job via buffer donation at the jit boundary (the
+    reference's shared send/recv buffers and ``ManyPencilArray`` aliasing,
+    re-specified for XLA — see ``parallel/multiarrays.py``).
+    """
+    pin = src.pencil
+    R = assert_compatible(pin, dest)
+    out = _compiled_transpose(pin, dest, R, src.ndims_extra, method)(src.data)
+    return PencilArray(dest, out, src.extra_dims)
+
+
+def reshard(src: PencilArray, dest: Pencil) -> PencilArray:
+    """Unrestricted redistribution between *any* two pencils sharing a
+    topology and global shape — capability beyond the reference's
+    single-slot transpose, via the GSPMD partitioner."""
+    pin = src.pencil
+    if pin.topology != dest.topology:
+        raise ValueError("reshard: pencil topologies differ")
+    if pin.size_global() != dest.size_global():
+        raise ValueError("reshard: global shapes differ")
+    out = _compiled_reshard(pin, dest, src.ndims_extra)(src.data)
+    return PencilArray(dest, out, src.extra_dims)
+
+
+class Transposition:
+    """Object API for parity with the reference's two-step
+    ``Transposition(Ao, Ai)`` + ``transpose!(t)`` + ``MPI.Waitall(t)``
+    (``Transpositions.jl:70-131``).
+
+    Under XLA there is nothing to wait on — collectives are scheduled by
+    the compiler — so :meth:`waitall` is a no-op kept for source parity,
+    and :meth:`execute` returns the destination array.
+    """
+
+    def __init__(self, dest: Pencil, src: PencilArray,
+                 method: AbstractTransposeMethod = AllToAll()):
+        self.dest_pencil = dest
+        self.src = src
+        self.method = method
+        self.dim = assert_compatible(src.pencil, dest)
+        self._result: Optional[PencilArray] = None
+
+    def execute(self) -> PencilArray:
+        if self._result is None:
+            self._result = transpose(self.src, self.dest_pencil,
+                                     method=self.method)
+        return self._result
+
+    def waitall(self) -> None:
+        """No-op (XLA latency-hiding scheduler owns completion)."""
+        self.execute()
