@@ -28,6 +28,7 @@ from .parallel import (  # noqa: F401
     Gspmd,
     IndexOrder,
     LogicalOrder,
+    ManyPencilArray,
     MemoryOrder,
     Pencil,
     PencilArray,
@@ -41,5 +42,7 @@ from .parallel import (  # noqa: F401
     reshard,
     transpose,
 )
+from .ops.localgrid import LocalRectilinearGrid, localgrid  # noqa: F401
+from . import ops  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,35 @@
+from . import reductions
+from .localgrid import LocalRectilinearGrid, localgrid
+from .random import normal, uniform
+from .reductions import (
+    all,
+    any,
+    count_nonzero,
+    dot,
+    maximum,
+    mean,
+    minimum,
+    norm,
+    prod,
+    sum,
+    mapreduce,
+)
+
+__all__ = [
+    "reductions",
+    "LocalRectilinearGrid",
+    "localgrid",
+    "normal",
+    "uniform",
+    "all",
+    "any",
+    "count_nonzero",
+    "dot",
+    "maximum",
+    "mean",
+    "minimum",
+    "norm",
+    "prod",
+    "sum",
+    "mapreduce",
+]

@@ -1,0 +1,137 @@
+"""Rectilinear grids aligned with a pencil decomposition.
+
+Reference ``src/LocalGrids/`` + the ``localgrid`` hook
+(``Pencils.jl:600-605``): per-rank views of global coordinate vectors,
+whose components broadcast against PencilArrays by reshaping to a permuted
+singleton shape (``rectilinear.jl:132-139``), so that
+``@. u = f(grid.x, grid.y, grid.z)`` fuses with zero allocation.
+
+TPU re-design: a component for logical dim ``d`` is the global coordinate
+vector padded to the pencil's padded extent, reshaped so its only
+non-singleton axis sits at dim ``d``'s *memory* position, and sharded along
+that dim's mesh axis.  Broadcasting such components against ``x.data``
+(memory-order padded storage) is then elementwise-aligned shard-by-shard —
+XLA fuses the whole expression into one kernel with no data movement,
+the analog of the reference's zero-allocation fused broadcast
+(``benchmarks/grids.jl`` is the perf baseline for exactly this).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.arrays import PencilArray
+from ..parallel.pencil import LogicalOrder, MemoryOrder, Pencil
+
+__all__ = ["LocalRectilinearGrid", "localgrid"]
+
+_COMPONENT_NAMES = "xyzw"
+
+
+class LocalRectilinearGrid:
+    """Grid of per-dimension coordinate vectors over a pencil
+    (reference ``LocalRectilinearGrid``, ``rectilinear.jl:8-15``).
+
+    Components are accessed as ``g[0]``/``g[1]``/... or ``g.x``/``g.y``/
+    ``g.z``/``g.w`` (``rectilinear.jl:159-169``) and come back as
+    broadcast-ready sharded arrays aligned with ``PencilArray.data``.
+    """
+
+    def __init__(self, pencil: Pencil, coords_global: Sequence):
+        if len(coords_global) != pencil.ndims:
+            raise ValueError(
+                f"need {pencil.ndims} coordinate vectors, got "
+                f"{len(coords_global)}"
+            )
+        self._pencil = pencil
+        self._coords = []
+        for d, c in enumerate(coords_global):
+            c = jnp.asarray(c)
+            if c.ndim != 1 or c.shape[0] != pencil.size_global()[d]:
+                raise ValueError(
+                    f"coordinate vector {d} must be 1-D of length "
+                    f"{pencil.size_global()[d]}, got shape {c.shape}"
+                )
+            self._coords.append(c)
+
+    @property
+    def pencil(self) -> Pencil:
+        return self._pencil
+
+    @property
+    def ndims(self) -> int:
+        return self._pencil.ndims
+
+    def coordinate(self, d: int):
+        """The raw (global, true-length) coordinate vector of dim ``d``."""
+        return self._coords[d]
+
+    def __getitem__(self, d: int):
+        """Broadcastable component for logical dim ``d``: padded, reshaped
+        into memory order, sharded along the dim's mesh axis (the analog of
+        ``rectilinear.jl:132-139``)."""
+        pen = self._pencil
+        N = pen.ndims
+        if not (0 <= d < N):
+            raise IndexError(f"component {d} out of range for {N} dims")
+        c = self._coords[d]
+        n_pad = pen.padded_global_shape[d]
+        if n_pad != c.shape[0]:
+            c = jnp.pad(c, (0, n_pad - c.shape[0]))
+        # memory position of logical dim d
+        mem_ids = pen.permutation.apply(tuple(range(N)))
+        pos = mem_ids.index(d)
+        shape = [1] * N
+        shape[pos] = n_pad
+        c = c.reshape(shape)
+        # shard along this dim's mesh axis (replicated over the others)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = [None] * N
+        spec[pos] = pen.decomp_axis_name(d)
+        c = jax.lax.with_sharding_constraint(
+            c, NamedSharding(pen.mesh, PartitionSpec(*spec))
+        )
+        return c
+
+    def __getattr__(self, name: str):
+        if len(name) == 1 and name in _COMPONENT_NAMES:
+            d = _COMPONENT_NAMES.index(name)
+            if d < self.ndims:
+                return self[d]
+        raise AttributeError(name)
+
+    def components(self) -> Tuple:
+        """All broadcastable components (reference ``components(g)``)."""
+        return tuple(self[d] for d in range(self.ndims))
+
+    def evaluate(self, f: Callable, extra_dims: Tuple[int, ...] = ()) -> PencilArray:
+        """``u = f(x, y, z, ...)`` broadcast over the grid, returned as a
+        PencilArray — the fused grid-broadcast pattern of
+        ``README.md:101`` / ``benchmarks/grids.jl``."""
+        val = f(*self.components())
+        pen = self._pencil
+        target = pen.padded_size_global(MemoryOrder) + tuple(extra_dims)
+        if extra_dims:
+            # keep spatial dims left-aligned: extras are trailing singletons
+            val = val.reshape(val.shape + (1,) * len(extra_dims))
+        val = jnp.broadcast_to(val, target)
+        val = jax.lax.with_sharding_constraint(
+            val, pen.sharding(len(extra_dims)))
+        return PencilArray(pen, val, tuple(extra_dims))
+
+    def __repr__(self) -> str:
+        return (
+            f"LocalRectilinearGrid(ndims={self.ndims}, "
+            f"pencil={self._pencil!r})"
+        )
+
+
+def localgrid(pencil: Pencil, coords_global: Sequence) -> LocalRectilinearGrid:
+    """Build a grid over a pencil from global coordinate vectors
+    (reference ``localgrid``, ``Pencils.jl:600-605``)."""
+    return LocalRectilinearGrid(pencil, coords_global)

@@ -1,0 +1,43 @@
+"""Random fills for PencilArrays.
+
+Reference ``src/random.jl``: ``rand!``/``randn!`` forward to the parent
+array so GPU backends fill without scalar indexing (``random.jl:3-16``).
+Here the analog generates directly into the sharded padded parent with
+``jax.random`` (counter-based, so sharded generation is deterministic
+given the key, independent of device count).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.arrays import PencilArray
+from ..parallel.pencil import MemoryOrder, Pencil
+
+__all__ = ["uniform", "normal"]
+
+
+def _filled(pencil: Pencil, key, extra_dims: Tuple[int, ...], dtype, sampler):
+    shape = pencil.padded_size_global(MemoryOrder) + tuple(extra_dims)
+    data = sampler(key, shape, dtype)
+    data = jax.device_put(data, pencil.sharding(len(extra_dims)))
+    return PencilArray(pencil, data, tuple(extra_dims))
+
+
+def uniform(pencil: Pencil, key, extra_dims: Tuple[int, ...] = (),
+            dtype=jnp.float32) -> PencilArray:
+    """U[0,1) fill (reference ``rand!``)."""
+    return _filled(pencil, key, extra_dims, dtype,
+                   lambda k, s, d: jax.random.uniform(k, s, dtype=d))
+
+
+def normal(pencil: Pencil, key, extra_dims: Tuple[int, ...] = (),
+           dtype=jnp.float32) -> PencilArray:
+    """Standard-normal fill (reference ``randn!``).  Complex dtypes are
+    supported natively by ``jax.random.normal`` with the standard complex
+    normal's variance 1 (0.5 per component), matching Julia ``randn``."""
+    return _filled(pencil, key, extra_dims, dtype,
+                   lambda k, s, d: jax.random.normal(k, s, dtype=d))

@@ -1,0 +1,153 @@
+"""Distributed reductions over PencilArrays.
+
+Reference ``src/reductions.jl``: local reduce followed by ``MPI.Allreduce``
+with a custom operator (``reductions.jl:9-28``), giving ``sum``/``minimum``/
+``maximum``/``any``/``all`` and friends globally-consistent values on every
+rank — the property that makes distributed ODE time-stepping agree across
+ranks (``ext/PencilArraysDiffEqExt.jl``).
+
+Under single-controller JAX a reduction over the sharded global array *is*
+the Allreduce: ``jnp.sum`` on a sharded operand compiles to local reduce +
+``psum`` over the mesh, scheduled by XLA onto ICI.  What this module adds
+is **padding masking**: the backing array carries tail padding on
+decomposed dims (see ``parallel/arrays.py``), which must not contaminate
+reductions.  Masking (rather than slicing to the true shape) keeps shards
+even, so no resharding is triggered — the mask is an iota comparison XLA
+fuses into the reduction kernel.
+
+All functions reduce in *memory order* over the parent array, like the
+reference's parent-level reductions — valid because the reductions exposed
+here are order-insensitive (the reference makes the same argument for its
+Allreduce ops, ``reductions.jl:17``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.arrays import PencilArray
+from ..parallel.pencil import MemoryOrder, Pencil
+
+__all__ = [
+    "mapreduce",
+    "sum",
+    "mean",
+    "prod",
+    "minimum",
+    "maximum",
+    "any",
+    "all",
+    "norm",
+    "dot",
+    "count_nonzero",
+]
+
+def _order_identity(dtype, kind: str):
+    """Neutral element for min/max over ``dtype`` (written into padding)."""
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        raise TypeError(f"no ordering for complex dtype {dtype}")
+    if dtype == jnp.bool_:
+        return kind == "min"  # True for min, False for max
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf if kind == "min" else -jnp.inf
+    info = jnp.iinfo(dtype)
+    return info.max if kind == "min" else info.min
+
+
+def _valid_mask(pencil: Pencil, extra_ndims: int):
+    """Boolean mask over the padded memory-order array: True on true data,
+    False on tail padding.  Cheap: per-dim iota comparisons, broadcast."""
+    padded = pencil.padded_size_global(MemoryOrder)
+    true = pencil.size_global(MemoryOrder)
+    mask = None
+    for d, (np_, nt) in enumerate(zip(padded, true)):
+        if np_ == nt:
+            continue
+        shape = [1] * (len(padded) + extra_ndims)
+        shape[d] = np_
+        m = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), d) < nt
+        mask = m if mask is None else mask & m
+    return mask  # None when nothing is padded
+
+
+def mapreduce(f: Callable, op: Callable, *arrays: PencilArray,
+              identity) -> jax.Array:
+    """``op``-reduce of ``f`` applied elementwise over one or more aligned
+    PencilArrays (reference zipped mapreduce, ``reductions.jl:21-27``).
+
+    ``op`` must be an associative jnp reduction like ``jnp.sum`` taking the
+    array; ``identity`` is its neutral element, written into padding.
+    """
+    x0 = arrays[0]
+    for a in arrays[1:]:
+        if a.pencil != x0.pencil or a.extra_dims != x0.extra_dims:
+            raise ValueError("mapreduce operands must share pencil/extra dims")
+    val = f(*(a.data for a in arrays))
+    mask = _valid_mask(x0.pencil, x0.ndims_extra)
+    if mask is not None:
+        val = jnp.where(mask, val, identity)
+    return op(val)
+
+
+def sum(x: PencilArray, *, dtype=None) -> jax.Array:
+    return mapreduce(lambda d: d if dtype is None else d.astype(dtype),
+                     jnp.sum, x, identity=0)
+
+
+def prod(x: PencilArray) -> jax.Array:
+    return mapreduce(lambda d: d, jnp.prod, x, identity=1)
+
+
+def mean(x: PencilArray) -> jax.Array:
+    return sum(x) / x.length_global()
+
+
+def minimum(x: PencilArray) -> jax.Array:
+    return mapreduce(lambda d: d, jnp.min, x,
+                     identity=_order_identity(x.dtype, "min"))
+
+
+def maximum(x: PencilArray) -> jax.Array:
+    return mapreduce(lambda d: d, jnp.max, x,
+                     identity=_order_identity(x.dtype, "max"))
+
+
+def any(x: PencilArray, pred: Optional[Callable] = None) -> jax.Array:
+    """Global ``any`` (reference ``reductions.jl:30-38``: Allreduce with
+    ``|``).  With ``pred``, tests ``pred(x)`` elementwise first."""
+    f = (lambda d: pred(d).astype(bool)) if pred else (lambda d: d.astype(bool))
+    return mapreduce(f, jnp.any, x, identity=False)
+
+
+def all(x: PencilArray, pred: Optional[Callable] = None) -> jax.Array:
+    f = (lambda d: pred(d).astype(bool)) if pred else (lambda d: d.astype(bool))
+    return mapreduce(f, jnp.all, x, identity=True)
+
+
+def count_nonzero(x: PencilArray) -> jax.Array:
+    return mapreduce(lambda d: (d != 0).astype(jnp.int32), jnp.sum, x,
+                     identity=0)
+
+
+def norm(x: PencilArray, ord: int = 2) -> jax.Array:
+    """Global p-norm (what DiffEq-style error control needs to be
+    decomposition-independent, cf. ``ext/PencilArraysDiffEqExt.jl:5-9``)."""
+    if ord == 2:
+        return jnp.sqrt(mapreduce(lambda d: jnp.abs(d) ** 2, jnp.sum, x,
+                                  identity=0))
+    if ord == 1:
+        return mapreduce(lambda d: jnp.abs(d), jnp.sum, x, identity=0)
+    if ord == jnp.inf or ord == math.inf:
+        return mapreduce(lambda d: jnp.abs(d), jnp.max, x, identity=0)
+    return mapreduce(lambda d: jnp.abs(d) ** ord, jnp.sum, x,
+                     identity=0) ** (1.0 / ord)
+
+
+def dot(x: PencilArray, y: PencilArray) -> jax.Array:
+    """Global inner product ``<x, y>`` (conjugating the first argument for
+    complex dtypes)."""
+    return mapreduce(lambda a, b: jnp.conj(a) * b, jnp.sum, x, y, identity=0)

@@ -18,8 +18,10 @@ from .transpositions import (
     transpose,
 )
 from .gather import gather
+from .multiarrays import ManyPencilArray
 
 __all__ = [
+    "ManyPencilArray",
     "PencilArray",
     "global_view",
     "AllToAll",

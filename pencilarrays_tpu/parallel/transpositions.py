@@ -195,7 +195,8 @@ def _reshard_gspmd(data, pin: Pencil, pout: Pencil, extra_ndims: int):
 @lru_cache(maxsize=512)
 def _compiled_transpose(pin: Pencil, pout: Pencil, R: Optional[int],
                         extra_ndims: int,
-                        method: AbstractTransposeMethod):
+                        method: AbstractTransposeMethod,
+                        donate: bool = False):
     """Compiled data->data transpose, cached on the static configuration.
 
     Pencils are frozen/hashable, so (pin, pout, method) is a complete key.
@@ -212,7 +213,7 @@ def _compiled_transpose(pin: Pencil, pout: Pencil, R: Optional[int],
         fn = lambda data: _reshard_gspmd(data, pin, pout, extra_ndims)
     else:
         raise TypeError(f"unknown transpose method {method!r}")
-    return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 @lru_cache(maxsize=512)
@@ -221,19 +222,23 @@ def _compiled_reshard(pin: Pencil, pout: Pencil, extra_ndims: int):
 
 
 def transpose(src: PencilArray, dest: Pencil, *,
-              method: AbstractTransposeMethod = AllToAll()) -> PencilArray:
+              method: AbstractTransposeMethod = AllToAll(),
+              donate: bool = False) -> PencilArray:
     """Redistribute ``src`` into the ``dest`` pencil configuration
     (reference ``transpose!``, ``Transpositions.jl:161-180``).
 
     Traceable: call it inside ``jax.jit`` and the exchange fuses into the
-    surrounding program.  Pure (returns a new PencilArray); in-place reuse
-    is the compiler's job via buffer donation at the jit boundary (the
-    reference's shared send/recv buffers and ``ManyPencilArray`` aliasing,
-    re-specified for XLA — see ``parallel/multiarrays.py``).
+    surrounding program.  Pure (returns a new PencilArray); with
+    ``donate=True`` the source buffer is donated to XLA for reuse — the
+    re-specification of the reference's shared send/recv buffers and
+    in-place ``ManyPencilArray`` transposes (see
+    ``parallel/multiarrays.py``).  After a donating call the source array
+    is invalid.
     """
     pin = src.pencil
     R = assert_compatible(pin, dest)
-    out = _compiled_transpose(pin, dest, R, src.ndims_extra, method)(src.data)
+    out = _compiled_transpose(pin, dest, R, src.ndims_extra, method,
+                              donate)(src.data)
     return PencilArray(dest, out, src.extra_dims)
 
 

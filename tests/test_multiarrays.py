@@ -1,0 +1,100 @@
+"""ManyPencilArray tests — the re-specified shared-storage transpose chain
+(reference ``src/multiarrays.jl`` + in-place transposes,
+``test/pencils.jl:224-239``)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from pencilarrays_tpu import (
+    ManyPencilArray,
+    Pencil,
+    PencilArray,
+    Permutation,
+    Topology,
+    gather,
+)
+from pencilarrays_tpu import ops
+
+
+@pytest.fixture
+def pencils(devices):
+    topo = Topology((2, 4))
+    shape = (14, 21, 19)
+    pen_x = Pencil(topo, shape, (1, 2))
+    pen_y = Pencil(topo, shape, (0, 2), permutation=Permutation(1, 0, 2))
+    pen_z = Pencil(topo, shape, (0, 1), permutation=Permutation(2, 1, 0))
+    return pen_x, pen_y, pen_z
+
+
+def test_construction_and_access(pencils):
+    A = ManyPencilArray(*pencils, dtype=jnp.float64)
+    assert len(A) == 3
+    assert A.index == 0
+    assert A.first.pencil == pencils[0]
+    with pytest.raises(RuntimeError, match="not live"):
+        A[1]
+    with pytest.raises(RuntimeError):
+        _ = A.last
+
+
+def test_chain_roundtrip_bit_identity(pencils):
+    pen_x, pen_y, pen_z = pencils
+    shape = pen_x.size_global()
+    u = np.random.default_rng(7).standard_normal(shape)
+    A = ManyPencilArray(pen_x, pen_y, pen_z, dtype=jnp.float64)
+    A.set(PencilArray.from_global(pen_x, u))
+    orig = A.current.data
+    A.transpose_to(1)
+    assert A.index == 1 and A.current.pencil == pen_y
+    np.testing.assert_array_equal(gather(A.current), u)
+    A.transpose_to(2)
+    np.testing.assert_array_equal(gather(A.current), u)
+    # back down the chain
+    A.transpose_to(1)
+    A.transpose_to(0)
+    assert bool((A.current.data == orig).all())
+
+
+def test_cycle_generator(pencils):
+    shape = pencils[0].size_global()
+    u = np.random.default_rng(8).standard_normal(shape)
+    A = ManyPencilArray(*pencils, dtype=jnp.float64)
+    A.set(PencilArray.from_global(pencils[0], u))
+    seen = []
+    for arr in A.cycle():
+        seen.append(arr.pencil.decomposition)
+        np.testing.assert_array_equal(gather(arr), u)
+    assert seen == [(1, 2), (0, 2), (0, 1)]
+    # a second sweep (the next "timestep") must chain back through the
+    # intermediate configuration transparently
+    for arr in A.cycle():
+        np.testing.assert_array_equal(gather(arr), u)
+
+
+def test_donation_invalidates_source(pencils):
+    """After a donating hop the old buffer must not be reachable through
+    the chain (stale views are structurally invalid)."""
+    A = ManyPencilArray(*pencils, dtype=jnp.float32)
+    a0 = A.current
+    A.transpose_to(1)  # donate=True default
+    with pytest.raises(RuntimeError):
+        A[0]
+    # The donated buffer is deleted on backends that honour donation (TPU);
+    # the CPU test backend ignores donation, so only the structural guard
+    # above is asserted unconditionally.
+    assert isinstance(a0.data.is_deleted(), bool)
+
+
+def test_validation(pencils, devices):
+    pen_x, pen_y, _ = pencils
+    with pytest.raises(ValueError):
+        ManyPencilArray()
+    other_topo = Topology((4, 2))
+    with pytest.raises(ValueError, match="topology"):
+        ManyPencilArray(pen_x, Pencil(other_topo, pen_x.size_global(), (0, 2)))
+    with pytest.raises(ValueError, match="global shape"):
+        ManyPencilArray(pen_x, Pencil(pen_x.topology, (8, 8, 8), (0, 2)))
+    with pytest.raises(ValueError, match="not part"):
+        A = ManyPencilArray(pen_x, pen_y)
+        A.set(PencilArray.zeros(Pencil(pen_x.topology, pen_x.size_global(), (2, 1))))
