@@ -1,0 +1,73 @@
+"""Headline benchmark — prints exactly ONE JSON line to stdout.
+
+Metric: the reference's only published absolute number — the fused grid
+broadcast ``v = f(u, x, y, z)`` on a 60x110x21 grid
+(``/root/reference/benchmarks/grids.jl:100-118``: 212.889 us at 0
+allocations, 1 MPI rank, Julia 1.7.2).  Same workload here: localgrid
+components broadcast in memory order against a PencilArray, fused by XLA
+into one kernel on the TPU chip.
+
+``vs_baseline`` is reference_time / our_time (>1 means faster than the
+reference).  Details for other configs (transpose cycle bandwidth, 3-D
+FFT) are written to BENCH_DETAILS.json — see benchmarks/suite.py.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+REF_US = 212.889  # benchmarks/grids.jl:115 (NoPermutation broadcast)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pencilarrays_tpu import PencilArray, Permutation, Pencil, Topology, localgrid
+
+    # single chip, slab topology of 1 (matches "1 MPI rank")
+    topo = Topology((1,), devices=jax.devices()[:1])
+    shape = (60, 110, 21)
+    # float64 to match the reference benchmark's Float64 arrays
+    dtype = jnp.float64
+    jax.config.update("jax_enable_x64", True)
+    pen = Pencil(topo, shape, (1,))
+    rng = np.random.default_rng(0)
+    u = PencilArray.from_global(pen, rng.standard_normal(shape))
+    g = localgrid(pen, [np.linspace(0, 1, n) for n in shape])
+    gx, gy, gz = g.components()
+
+    # Measurement protocol: K iterations inside one jit + a scalar
+    # readback (block_until_ready does NOT synchronize through remote TPU
+    # tunnels), differencing two K values to cancel dispatch/transfer
+    # overhead — the like-for-like comparison with the reference's
+    # BenchmarkTools kernel minimum.
+    def timed(K):
+        @jax.jit
+        def run(d):
+            def body(i, a):
+                # grids.jl ftest-shaped expression: u + x + 2 y cos z
+                return a + gx + 2.0 * gy * jnp.cos(gz)
+            out = jax.lax.fori_loop(0, K, body, d)
+            return jnp.sum(out).astype(jnp.float32)
+        float(run(u.data))  # compile + warm
+        t0 = time.perf_counter()
+        float(run(u.data))
+        return time.perf_counter() - t0
+
+    k0, k1 = 10, 1010
+    dt_us = max(t := (timed(k1) - timed(k0)) / (k1 - k0) * 1e6, 1e-3)
+
+    print(json.dumps({
+        "metric": "grid_broadcast_60x110x21_f64",
+        "value": round(dt_us, 3),
+        "unit": "us",
+        "vs_baseline": round(REF_US / dt_us, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

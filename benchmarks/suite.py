@@ -1,0 +1,134 @@
+"""Extended benchmark suite — writes BENCH_DETAILS.json.
+
+Covers the BASELINE.md configs runnable on the available hardware:
+
+1. grid broadcast 60x110x21 (published reference number, also bench.py);
+2. 256^3 f32 x->y->z transpose cycle (single chip: local permute path;
+   multi-chip: all_to_all over ICI);
+3. 3-D r2c FFT round trip, 256^3;
+4. Navier-Stokes step throughput, 128^3.
+
+Usage: ``python benchmarks/suite.py [--devices N]`` (N>1 uses the CPU
+virtual-mesh backend for collective-path validation timing; real-chip
+numbers come from N=1 on TPU).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _timeit(body, x0, k0=1, k1=6):
+    """Device time per iteration of ``body`` (a data->data jittable):
+    K iterations inside one jit + scalar readback, K-differenced to cancel
+    dispatch/transfer overhead (block_until_ready does not synchronize
+    through remote TPU tunnels)."""
+    import jax
+    import jax.numpy as jnp
+
+    def timed(K):
+        @jax.jit
+        def run(d):
+            out = jax.lax.fori_loop(0, K, lambda i, a: body(a), d)
+            return jnp.sum(jnp.abs(out)).astype(jnp.float32)
+
+        float(run(x0))  # compile + warm
+        t0 = time.perf_counter()
+        float(run(x0))
+        return time.perf_counter() - t0
+
+    return max((timed(k1) - timed(k0)) / (k1 - k0), 1e-9)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--devices", type=int, default=1)
+    parser.add_argument("--out", default="BENCH_DETAILS.json")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from pencilarrays_tpu import (
+        PencilArray, Pencil, Topology, dims_create, transpose,
+    )
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+    from pencilarrays_tpu.models import NavierStokesSpectral, taylor_green
+
+    devs = jax.devices()[: args.devices]
+    results = {"platform": devs[0].platform, "n_devices": len(devs)}
+
+    # -- 2. transpose cycle 256^3 f32 ------------------------------------
+    n = 256
+    dims = dims_create(len(devs), 2) if len(devs) > 1 else (1,)
+    topo = Topology(dims, devices=devs) if len(dims) > 1 else Topology(
+        (1,), devices=devs)
+    from pencilarrays_tpu import Permutation
+
+    # Permuted layouts so the single-device path measures the real local
+    # permute copies (the reference's copy_permuted! on 1 rank), and the
+    # multi-device path measures all_to_all + permute.
+    p_x, p_y, p_z = Permutation(1, 2, 0), Permutation(2, 0, 1), None
+    if len(dims) == 1:
+        pen_x = Pencil(topo, (n, n, n), (1,), permutation=p_x)
+        pen_y = Pencil(topo, (n, n, n), (0,), permutation=p_y)
+        pen_z = Pencil(topo, (n, n, n), (2,), permutation=p_z)
+    else:
+        pen_x = Pencil(topo, (n, n, n), (1, 2), permutation=p_x)
+        pen_y = Pencil(topo, (n, n, n), (0, 2), permutation=p_y)
+        pen_z = Pencil(topo, (n, n, n), (0, 1), permutation=p_z)
+    x = PencilArray.zeros(pen_x, dtype=jnp.float32)
+
+    def cycle(d):
+        a = PencilArray(pen_x, d)
+        b = transpose(a, pen_y)
+        c = transpose(b, pen_z)
+        cc = transpose(c, pen_y)
+        aa = transpose(cc, pen_x)
+        return aa.data
+
+    dt = _timeit(cycle, x.data) / 4  # per transpose hop
+    nbytes = n ** 3 * 4
+    results["transpose_hop_256"] = {
+        "seconds": dt,
+        "gb_per_s_per_chip": nbytes * 2 / dt / 1e9 / len(devs),
+    }
+
+    # -- 3. 3-D r2c FFT 256^3 --------------------------------------------
+    plan = PencilFFTPlan(topo, (n, n, n), real=True, dtype=jnp.float32)
+    u = plan.allocate_input()
+
+    def fft_roundtrip(d):
+        a = PencilArray(plan.input_pencil, d)
+        return plan.backward(plan.forward(a)).data
+
+    dt = _timeit(fft_roundtrip, u.data, k0=1, k1=4)
+    # 2 transforms x 5 N^3 log2(N^3) real flops (rough FFT flop model)
+    flops = 2 * 5 * n ** 3 * np.log2(float(n) ** 3)
+    results["fft_r2c_roundtrip_256"] = {
+        "seconds": dt,
+        "gflops_per_chip": flops / dt / 1e9 / len(devs),
+    }
+
+    # -- 4. NS step 128^3 -------------------------------------------------
+    model = NavierStokesSpectral(topo, 128, viscosity=1e-3, dtype=jnp.float32)
+    uh = taylor_green(model)
+
+    def step(d):
+        return model.step(PencilArray(uh.pencil, d, (3,)), 1e-3).data
+
+    dt = _timeit(step, uh.data, k0=1, k1=9)
+    results["navier_stokes_step_128"] = {"seconds": dt,
+                                         "steps_per_s": 1.0 / dt}
+
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,5 +44,7 @@ from .parallel import (  # noqa: F401
 )
 from .ops.localgrid import LocalRectilinearGrid, localgrid  # noqa: F401
 from . import ops  # noqa: F401
+from . import io  # noqa: F401
+from .ops.fft import PencilFFTPlan  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,14 @@
+from .core import ParallelIODriver, metadata, open_file
+from .binary import BinaryDriver, BinaryFile
+from .orbax_driver import OrbaxDriver, OrbaxFile, has_orbax
+
+__all__ = [
+    "ParallelIODriver",
+    "metadata",
+    "open_file",
+    "BinaryDriver",
+    "BinaryFile",
+    "OrbaxDriver",
+    "OrbaxFile",
+    "has_orbax",
+]

@@ -1,0 +1,58 @@
+"""Parallel I/O driver abstraction.
+
+Reference ``src/PencilIO/PencilIO.jl``: a ``ParallelIODriver`` interface
+with ``open(f, driver, filename, comm; keywords...)`` (``PencilIO.jl:18-51``)
+and a ``metadata(x)`` helper recording decomposition facts next to the data
+(``PencilIO.jl:53-65``) so files are self-describing and re-readable under
+a different process configuration.
+
+TPU re-design: drivers write from the sharded global array (per-block
+streaming, no full replica in host memory) and read back into *any* pencil
+configuration — decomposition-independent restart is the defining feature,
+as in the reference (``mpi_io.jl:159-167``).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict
+
+from ..parallel.arrays import PencilArray
+from ..parallel.pencil import LogicalOrder, MemoryOrder
+from ..utils.permutations import NO_PERMUTATION
+
+__all__ = ["ParallelIODriver", "open_file", "metadata"]
+
+
+class ParallelIODriver:
+    """Base class for I/O drivers (reference ``ParallelIODriver``)."""
+
+    def open(self, filename: str, *, write: bool = False, read: bool = False,
+             create: bool = False, append: bool = False,
+             truncate: bool = False):
+        raise NotImplementedError
+
+
+@contextmanager
+def open_file(driver: ParallelIODriver, filename: str, **mode):
+    """``open(f, driver, filename; mode...)`` of the reference
+    (``PencilIO.jl:18-51``) as a context manager."""
+    f = driver.open(filename, **mode)
+    try:
+        yield f
+    finally:
+        f.close()
+
+
+def metadata(x: PencilArray) -> Dict:
+    """Decomposition metadata stored next to each dataset
+    (reference ``PencilIO.metadata``, ``PencilIO.jl:53-65``)."""
+    pen = x.pencil
+    perm = pen.permutation
+    return {
+        "permutation": None if perm is NO_PERMUTATION or perm.is_identity()
+        else list(perm.axes()),
+        "extra_dims": list(x.extra_dims),
+        "decomposed_dims": list(pen.decomposition),
+        "process_dims": list(pen.topology.dims),
+    }

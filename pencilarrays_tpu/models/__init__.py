@@ -1,0 +1,9 @@
+from .spectral import NavierStokesSpectral, taylor_green
+from .ode import integrate, rk23_step
+
+__all__ = [
+    "NavierStokesSpectral",
+    "taylor_green",
+    "integrate",
+    "rk23_step",
+]

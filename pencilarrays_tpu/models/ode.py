@@ -1,0 +1,116 @@
+"""Adaptive ODE time integration over PencilArrays.
+
+Reference: the DiffEq extension (``ext/PencilArraysDiffEqExt.jl``) makes
+``recursive_length`` return the *global* length so adaptive error norms are
+identical on every rank — "without it each rank picks a different dt"
+(``ext:5-9``) — and ``test/ode.jl`` integrates a distributed heat/advection
+problem asserting all ranks choose the same adaptive step and that NaNs
+are detected globally (``test/ode.jl:41-74``).
+
+TPU re-design: the integrator below uses the padding-masked *global*
+reductions of :mod:`pencilarrays_tpu.ops.reductions` for its error norm,
+so the step-size decision is by construction a single global value —
+the single-controller analog of rank-consistent dt.  The controller is a
+standard embedded Bogacki–Shampine RK3(2) with a PI-less accept/reject
+loop expressed with ``lax.while_loop`` so the whole integration can jit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import reductions
+from ..parallel.arrays import PencilArray
+
+__all__ = ["rk23_step", "integrate", "error_norm"]
+
+
+def error_norm(err: PencilArray, u0: PencilArray, u1: PencilArray,
+               rtol: float, atol: float):
+    """WRMS error norm, global by construction (the property the reference
+    delegates to ``recursive_length`` + Allreduce)."""
+    scale = atol + rtol * jnp.maximum(jnp.abs(u0.data), jnp.abs(u1.data))
+    ratio = err.map(lambda e: (e / scale) ** 2)
+    return jnp.sqrt(reductions.mean(ratio))
+
+
+def rk23_step(f: Callable, u: PencilArray, t, dt):
+    """One Bogacki-Shampine 3(2) step; returns (u3, err, k4)."""
+    k1 = f(t, u)
+    k2 = f(t + 0.5 * dt, u.map(lambda d, a: d + 0.5 * dt * a, k1))
+    k3 = f(t + 0.75 * dt, u.map(lambda d, b: d + 0.75 * dt * b, k2))
+    u3 = u.map(
+        lambda d, a, b, c: d + dt * (2 / 9 * a + 1 / 3 * b + 4 / 9 * c),
+        k1, k2, k3,
+    )
+    k4 = f(t + dt, u3)
+    err = u.map(
+        lambda d, a, b, c, e: dt * (
+            (2 / 9 - 7 / 24) * a + (1 / 3 - 1 / 4) * b
+            + (4 / 9 - 1 / 3) * c - 1 / 8 * e
+        ),
+        k1, k2, k3, k4,
+    )
+    return u3, err
+
+
+def integrate(f: Callable, u0: PencilArray, t_span: Tuple[float, float], *,
+              rtol: float = 1e-5, atol: float = 1e-8, dt0: float = None,
+              max_steps: int = 10_000, check_nan: bool = True):
+    """Adaptive RK23 integration ``du/dt = f(t, u)`` from ``t0`` to ``t1``.
+
+    Returns ``(u_final, stats)`` where stats holds ``(t, dt, n_accepted,
+    n_rejected, nan_detected)``.  NaN blow-up detection is a *global*
+    ``any(isnan)`` (``test/ode.jl:41-57`` parity).
+    """
+    t0, t1 = float(t_span[0]), float(t_span[1])
+    if dt0 is None:
+        dt0 = (t1 - t0) / 100.0
+
+    def cond(state):
+        u, t, dt, na, nr, nan = state
+        return (t < t1) & (na + nr < max_steps) & (~nan)
+
+    def body(state):
+        u, t, dt, na, nr, nan = state
+        dt = jnp.minimum(dt, t1 - t)
+        u_new, err = rk23_step(f, u, t, dt)
+        enorm = error_norm(err, u, u_new, rtol, atol)
+        # A non-finite trial (overflowing step) is a rejection with maximal
+        # dt shrink — NOT a blow-up of the integration itself.
+        bad = ~jnp.isfinite(enorm)
+        accept = (enorm <= 1.0) & ~bad
+        if check_nan:
+            # blow-up detection applies to the state we carry forward
+            nan_now = accept & reductions.any(u_new, pred=jnp.isnan)
+        else:
+            nan_now = jnp.array(False)
+        # PI-less controller: dt *= clip(0.9 * enorm^(-1/3)); shrink hard
+        # on non-finite trials
+        fac = jnp.where(
+            bad, 0.2,
+            jnp.clip(0.9 * jnp.maximum(enorm, 1e-10) ** (-1 / 3), 0.2, 5.0))
+        u_next = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(accept, new, old), u_new, u)
+        return (
+            u_next,
+            jnp.where(accept, t + dt, t),
+            dt * fac,
+            na + accept.astype(jnp.int32),
+            nr + (~accept).astype(jnp.int32),
+            nan | nan_now,
+        )
+
+    state0 = (u0, jnp.asarray(t0, dtype=jnp.float64
+                              if jax.config.jax_enable_x64 else jnp.float32),
+              jnp.asarray(dt0, dtype=jnp.float64
+                          if jax.config.jax_enable_x64 else jnp.float32),
+              jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+              jnp.asarray(False))
+    u, t, dt, na, nr, nan = jax.lax.while_loop(cond, body, state0)
+    return u, {"t": t, "dt": dt, "n_accepted": na, "n_rejected": nr,
+               "nan_detected": nan}

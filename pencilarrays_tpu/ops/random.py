@@ -22,9 +22,12 @@ __all__ = ["uniform", "normal"]
 
 def _filled(pencil: Pencil, key, extra_dims: Tuple[int, ...], dtype, sampler):
     shape = pencil.padded_size_global(MemoryOrder) + tuple(extra_dims)
-    data = sampler(key, shape, dtype)
-    data = jax.device_put(data, pencil.sharding(len(extra_dims)))
-    return PencilArray(pencil, data, tuple(extra_dims))
+    # Generate directly into the sharded layout (counter-based PRNG makes
+    # this deterministic per global position): never a full single-device
+    # replica, so fills scale to arrays that only fit distributed.
+    fill = jax.jit(lambda k: sampler(k, shape, dtype),
+                   out_shardings=pencil.sharding(len(extra_dims)))
+    return PencilArray(pencil, fill(key), tuple(extra_dims))
 
 
 def uniform(pencil: Pencil, key, extra_dims: Tuple[int, ...] = (),
