@@ -1,0 +1,134 @@
+// Native strided-subarray file I/O for pencilarrays_tpu.
+//
+// TPU-native re-design of the reference's MPI-IO derived-datatype path:
+// the discontiguous file layout is written there with
+// MPI.Types.create_subarray + File.set_view! + write_all (collective) —
+// reference src/PencilIO/mpi_io.jl:335-380.  Here the same on-disk layout
+// (each block scattered to its strided row-major positions in the global
+// array) is produced by direct pread/pwrite of the block's contiguous
+// runs, one call per run, with no whole-file mmap and no Python-side
+// loop.  Python drives one call per block and parallelizes blocks across
+// threads (these functions hold no global state and release the GIL via
+// ctypes).
+//
+// Layout contract: the file region at base_offset holds the global array
+// in row-major LOGICAL order; a block is a contiguous row-major array of
+// shape bdims placed at corner `start` of the global shape gdims.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int kMaxDims = 32;
+
+struct Strides {
+  int64_t s[kMaxDims];
+};
+
+static Strides row_major_strides(int32_t ndims, const int64_t* gdims) {
+  Strides st;
+  st.s[ndims - 1] = 1;
+  for (int d = ndims - 2; d >= 0; --d) st.s[d] = st.s[d + 1] * gdims[d + 1];
+  return st;
+}
+
+// Iterate the block's rows (a row = the contiguous run along the last
+// dim), calling io(file_offset_bytes, row_ptr, run_bytes) for each.
+template <typename IO>
+static int for_each_run(int64_t base_offset, int64_t itemsize, int32_t ndims,
+                        const int64_t* gdims, const int64_t* start,
+                        const int64_t* bdims, char* buf, IO&& io) {
+  if (ndims <= 0 || ndims > kMaxDims) return -EINVAL;
+  for (int d = 0; d < ndims; ++d) {
+    if (bdims[d] < 0 || start[d] < 0 || start[d] + bdims[d] > gdims[d])
+      return -EDOM;
+    if (bdims[d] == 0) return 0;  // empty block (empty-rank case)
+  }
+  Strides st = row_major_strides(ndims, gdims);
+  const int64_t run = bdims[ndims - 1] * itemsize;
+  int64_t nrows = 1;
+  for (int d = 0; d + 1 < ndims; ++d) nrows *= bdims[d];
+  int64_t idx[kMaxDims] = {0};
+  char* p = buf;
+  for (int64_t r = 0; r < nrows; ++r) {
+    int64_t elem_off = start[ndims - 1];
+    for (int d = 0; d + 1 < ndims; ++d)
+      elem_off += (start[d] + idx[d]) * st.s[d];
+    const int rc = io(base_offset + elem_off * itemsize, p, run);
+    if (rc != 0) return rc;
+    p += run;
+    for (int d = ndims - 2; d >= 0; --d) {
+      if (++idx[d] < bdims[d]) break;
+      idx[d] = 0;
+    }
+  }
+  return 0;
+}
+
+static int full_pwrite(int fd, int64_t off, const char* p, int64_t n) {
+  while (n > 0) {
+    ssize_t w = pwrite(fd, p, static_cast<size_t>(n), off);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    p += w;
+    off += w;
+    n -= w;
+  }
+  return 0;
+}
+
+static int full_pread(int fd, int64_t off, char* p, int64_t n) {
+  while (n > 0) {
+    ssize_t r = pread(fd, p, static_cast<size_t>(n), off);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (r == 0) return -EIO;  // unexpected EOF
+    p += r;
+    off += r;
+    n -= r;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Write a contiguous row-major block into its strided positions.
+// Returns 0 on success, negative errno on failure.
+int pa_scatter_write(const char* path, int64_t base_offset, int64_t itemsize,
+                     int32_t ndims, const int64_t* gdims, const int64_t* start,
+                     const int64_t* bdims, const void* src) {
+  int fd = open(path, O_WRONLY);
+  if (fd < 0) return -errno;
+  int rc = for_each_run(
+      base_offset, itemsize, ndims, gdims, start, bdims,
+      const_cast<char*>(static_cast<const char*>(src)),
+      [fd](int64_t off, char* p, int64_t n) { return full_pwrite(fd, off, p, n); });
+  close(fd);
+  return rc;
+}
+
+// Read a block's strided positions into a contiguous row-major buffer.
+int pa_gather_read(const char* path, int64_t base_offset, int64_t itemsize,
+                   int32_t ndims, const int64_t* gdims, const int64_t* start,
+                   const int64_t* bdims, void* dst) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  int rc = for_each_run(
+      base_offset, itemsize, ndims, gdims, start, bdims,
+      static_cast<char*>(dst),
+      [fd](int64_t off, char* p, int64_t n) { return full_pread(fd, off, p, n); });
+  close(fd);
+  return rc;
+}
+
+}  // extern "C"

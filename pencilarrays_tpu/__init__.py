@@ -23,6 +23,15 @@ from .utils.permutations import (  # noqa: F401
     NoPermutation,
     Permutation,
 )
+from .utils.timers import (  # noqa: F401
+    TimerOutput,
+    disable_debug_timings,
+    enable_debug_timings,
+)
+from .utils.permuted_indices import (  # noqa: F401
+    PermutedCartesianIndices,
+    PermutedLinearIndices,
+)
 from .parallel import (  # noqa: F401
     AllToAll,
     Gspmd,
@@ -45,6 +54,7 @@ from .parallel import (  # noqa: F401
 from .ops.localgrid import LocalRectilinearGrid, localgrid  # noqa: F401
 from . import ops  # noqa: F401
 from . import io  # noqa: F401
+from .parallel import distributed  # noqa: F401
 from .ops.fft import PencilFFTPlan  # noqa: F401
 
 __version__ = "0.1.0"

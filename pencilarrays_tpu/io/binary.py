@@ -32,14 +32,16 @@ import json
 import math
 import os
 import sys
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..parallel.arrays import PencilArray
 from ..parallel.pencil import LogicalOrder, MemoryOrder, Pencil
 from .core import ParallelIODriver, metadata
+from . import native
 
 __all__ = ["BinaryDriver", "BinaryFile"]
 
@@ -48,6 +50,45 @@ FORMAT_VERSION = "1.0"
 
 def _endianness() -> str:
     return sys.byteorder  # "little" on TPU hosts
+
+
+def _assemble_sharded(pencil: Pencil, extra_dims: Tuple[int, ...], dtype,
+                      block_reader: Callable) -> PencilArray:
+    """Build a sharded PencilArray by streaming one true-size logical-order
+    block per device through ``block_reader(ranges)`` — never a full global
+    replica in host memory (the collective-read analog).  Each block is
+    tail-padded, permuted to memory order, and placed on its device;
+    ``jax.make_array_from_single_device_arrays`` assembles the global
+    array."""
+    import jax
+
+    from ..parallel.arrays import _fwd_axes
+
+    topo = pencil.topology
+    nd_extra = len(extra_dims)
+    padded_local = pencil.padded_size_local(LogicalOrder)
+    global_mem = pencil.padded_size_global(MemoryOrder) + extra_dims
+    fwd = _fwd_axes(pencil, nd_extra)
+    shards = []
+    proc = jax.process_index()
+    for rank in range(len(topo)):
+        coords = topo.coords(rank)
+        if topo.device(coords).process_index != proc:
+            continue  # multi-host: each process materializes its shards only
+        rr = pencil.range_local(coords, LogicalOrder)
+        if all(len(r) > 0 for r in rr):
+            block = np.asarray(block_reader(rr)).astype(dtype, copy=False)
+        else:
+            block = np.zeros(tuple(len(r) for r in rr) + extra_dims, dtype)
+        pad = [(0, p - len(r)) for p, r in zip(padded_local, rr)]
+        pad += [(0, 0)] * nd_extra
+        if any(p != (0, 0) for p in pad):
+            block = np.pad(block, pad)
+        block = np.transpose(block, fwd)
+        shards.append(jax.device_put(block, topo.device(coords)))
+    arr = jax.make_array_from_single_device_arrays(
+        global_mem, pencil.sharding(nd_extra), shards)
+    return PencilArray(pencil, arr, extra_dims)
 
 
 @dataclass(frozen=True)
@@ -72,19 +113,32 @@ class BinaryFile:
         self.meta_filename = filename + ".json"
         self.writable = write or append or create or truncate
         self.readable = read or not self.writable
+        import jax
+
+        self._is_proc0 = jax.process_index() == 0
         exists = os.path.exists(filename)
         # append (like Julia open flags, where append implies create) and
         # any write mode create a missing file; truncate always resets.
         if truncate or (not exists and self.writable):
-            with open(self.filename, "wb"):
-                pass
+            if self._is_proc0:
+                with open(self.filename, "wb"):
+                    pass
             self._meta = {"driver": "BinaryDriver", "version": FORMAT_VERSION,
                           "endianness": _endianness(), "datasets": []}
-            self._flush_meta()
+            if self._is_proc0:
+                self._flush_meta()
         elif exists:
             self._meta = self._load_meta()
         else:
             raise FileNotFoundError(filename)
+        # Base offset captured once at open: end offsets during writes are
+        # derived deterministically from (base, metadata) on EVERY process,
+        # never from getsize() mid-write — the analog of the reference
+        # synchronizing the shared file position across ranks
+        # (``mpi_io.jl:70-75``).
+        self._base_offset = (
+            os.path.getsize(self.filename) if os.path.exists(self.filename)
+            else 0)
         self._closed = False
 
     # -- metadata ---------------------------------------------------------
@@ -110,7 +164,10 @@ class BinaryFile:
         raise KeyError(f"dataset {name!r} not in {self.meta_filename}")
 
     def _end_offset(self) -> int:
-        return os.path.getsize(self.filename)
+        end = self._base_offset
+        for d in self._meta["datasets"]:
+            end = max(end, d["offset_bytes"] + d["size_bytes"])
+        return end
 
     def close(self):
         self._closed = True
@@ -126,6 +183,12 @@ class BinaryFile:
         """``file[name] = x`` of the reference (``mpi_io.jl:170-189``)."""
         if not self.writable:
             raise PermissionError("file not opened for writing")
+        from ..utils.timers import timeit
+
+        with timeit(x.pencil.timer, "write parallel"):
+            self._write_dataset(name, x, chunks)
+
+    def _write_dataset(self, name: str, x: PencilArray, chunks: bool):
         offset = self._end_offset()
         dtype = np.dtype(x.dtype)
         entry = {
@@ -145,26 +208,71 @@ class BinaryFile:
         self._meta["datasets"] = [
             d for d in self._meta["datasets"] if d["name"] != name
         ] + [entry]
-        self._flush_meta()
+        # Every process tracks metadata (offsets stay deterministic), but
+        # only process 0 touches the sidecar file; a cross-host barrier
+        # orders the data writes before any subsequent reader.
+        if self._is_proc0:
+            self._flush_meta()
+        from ..parallel.distributed import sync_global_devices
+
+        sync_global_devices("pa_io_write")
 
     def _write_discontiguous(self, x: PencilArray, offset: int, dtype):
         shape = x.pencil.size_global(LogicalOrder) + x.extra_dims
-        # extend the file to hold the dataset, then scatter blocks
-        with open(self.filename, "r+b") as f:
-            f.truncate(offset + int(np.prod(shape, dtype=np.int64)) * dtype.itemsize)
-        mm = np.memmap(self.filename, dtype=dtype, mode="r+", offset=offset,
-                       shape=shape)
+        total = offset + int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if self._is_proc0:
+            # extend the file to hold the dataset (pwrite would extend
+            # sparsely anyway; this makes short datasets well-formed)
+            with open(self.filename, "r+b") as f:
+                f.truncate(total)
         topo = x.pencil.topology
-        for rank in range(len(topo)):
-            coords = topo.coords(rank)
+        nd_extra = x.ndims_extra
+        # Walk THIS process's addressable shards (a host-local device->host
+        # copy each, no device compute) so that under multi-host SPMD every
+        # process writes exactly its own blocks into the shared file — the
+        # collective write_all of mpi_io.jl:335-380.  Each block is
+        # materialized inside its task so only in-flight blocks occupy
+        # host memory.
+        from ..parallel.arrays import _inv_axes
+
+        inv = _inv_axes(x.pencil, nd_extra)
+        use_native = native.available()
+        mm = None
+        if not use_native:
+            mm = np.memmap(self.filename, dtype=dtype, mode="r+",
+                           offset=offset, shape=shape)
+
+        def write_shard(shard):
+            coords = topo.coords_of_device(shard.device)
             rr = x.pencil.range_local(coords, LogicalOrder)
             if any(len(r) == 0 for r in rr):
-                continue
-            block = np.asarray(x.local_block(coords, LogicalOrder))
-            sl = tuple(slice(r.start, r.stop) for r in rr)
-            mm[sl] = block
-        mm.flush()
-        del mm
+                return
+            rr_mem = x.pencil.range_local(coords, MemoryOrder)
+            raw = np.asarray(shard.data)
+            # valid data is a prefix of each padded local dim
+            sl = tuple(slice(0, len(r)) for r in rr_mem)
+            sl += (slice(None),) * nd_extra
+            block = np.transpose(raw[sl], inv)  # memory -> logical order
+            start = tuple(r.start for r in rr) + (0,) * nd_extra
+            if use_native:
+                # native strided scatter (the MPI create_subarray+write_all
+                # analog): GIL-released pwrite runs
+                native.scatter_write(self.filename, offset,
+                                     np.ascontiguousarray(block), shape, start)
+            else:
+                dst = tuple(slice(s, s + e)
+                            for s, e in zip(start, block.shape))
+                mm[dst] = block
+
+        shards = list(x.data.addressable_shards)
+        if use_native:
+            with ThreadPoolExecutor(max_workers=min(8, len(shards) or 1)) as ex:
+                list(ex.map(write_shard, shards))
+        else:
+            for shard in shards:
+                write_shard(shard)
+            mm.flush()
+            del mm
 
     def _write_chunks(self, x: PencilArray, offset: int, dtype) -> List[Dict]:
         chunk_map = []
@@ -210,9 +318,25 @@ class BinaryFile:
             extra_dims = tuple(d["metadata"]["extra_dims"])
         full_shape = dims + tuple(extra_dims)
         if d["layout"] == "discontiguous":
-            arr = np.memmap(self.filename, dtype=dtype, mode="r",
-                            offset=d["offset_bytes"], shape=full_shape)
-            return PencilArray.from_global(pencil, np.ascontiguousarray(arr))
+            offset = d["offset_bytes"]
+            nd_extra = len(extra_dims)
+
+            if native.available():
+                def block_reader(ranges):
+                    start = tuple(r.start for r in ranges) + (0,) * nd_extra
+                    bdims = tuple(len(r) for r in ranges) + tuple(extra_dims)
+                    return native.gather_read(self.filename, offset, dtype,
+                                              full_shape, start, bdims)
+            else:
+                mm = np.memmap(self.filename, dtype=dtype, mode="r",
+                               offset=offset, shape=full_shape)
+
+                def block_reader(ranges):
+                    sl = tuple(slice(r.start, r.stop) for r in ranges)
+                    return np.ascontiguousarray(mm[sl])
+
+            return _assemble_sharded(pencil, tuple(extra_dims), dtype,
+                                     block_reader)
         # chunks: reassemble via the stored chunk map — works under ANY
         # target decomposition (slower than the matching-layout fast path
         # the reference also distinguishes).

@@ -1,0 +1,121 @@
+"""ctypes bridge to the native strided-subarray file I/O library.
+
+Builds ``native/pa_io.cpp`` on demand with the system C++ toolchain (the
+runtime analog of the reference binding ``libmpi``'s derived-datatype I/O,
+``mpi_io.jl:372-380``) and exposes block scatter/gather as GIL-releasing
+calls, so the binary driver can stream blocks through a thread pool.
+
+Falls back gracefully: :func:`available` returns False when no compiler
+or the build fails, and callers use the pure-NumPy memmap path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["available", "scatter_write", "gather_read"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "pa_io.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libpa_io.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # Compile to a process-unique temp path and rename atomically so that
+    # concurrent processes (multi-host shared FS, parallel test workers)
+    # never dlopen a half-written .so.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _failed
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        if not os.path.exists(_SRC):
+            _failed = True
+            return None
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            if not _build():
+                _failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _failed = True
+            return None
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        for fn in (lib.pa_scatter_write, lib.pa_gather_read):
+            fn.restype = ctypes.c_int
+            fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int32, i64p, i64p, i64p, ctypes.c_void_p,
+            ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_i64(seq: Sequence[int]):
+    return (ctypes.c_int64 * len(seq))(*[int(v) for v in seq])
+
+
+def scatter_write(path: str, base_offset: int, block: np.ndarray,
+                  gdims: Sequence[int], start: Sequence[int]) -> None:
+    """Write a contiguous row-major ``block`` at corner ``start`` of the
+    global row-major array of shape ``gdims`` stored at ``base_offset``."""
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    block = np.ascontiguousarray(block)
+    rc = lib.pa_scatter_write(
+        path.encode(), base_offset, block.dtype.itemsize, block.ndim,
+        _as_i64(gdims), _as_i64(start), _as_i64(block.shape),
+        block.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        raise OSError(-rc, f"pa_scatter_write failed ({os.strerror(-rc)})")
+
+
+def gather_read(path: str, base_offset: int, dtype, gdims: Sequence[int],
+                start: Sequence[int], bdims: Sequence[int]) -> np.ndarray:
+    """Read the block at corner ``start`` of shape ``bdims`` into a
+    contiguous array."""
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    out = np.empty(tuple(int(b) for b in bdims), dtype=np.dtype(dtype))
+    rc = lib.pa_gather_read(
+        path.encode(), base_offset, out.dtype.itemsize, out.ndim,
+        _as_i64(gdims), _as_i64(start), _as_i64(bdims),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        raise OSError(-rc, f"pa_gather_read failed ({os.strerror(-rc)})")
+    return out

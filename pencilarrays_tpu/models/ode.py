@@ -70,22 +70,28 @@ def integrate(f: Callable, u0: PencilArray, t_span: Tuple[float, float], *,
     t0, t1 = float(t_span[0]), float(t_span[1])
     if dt0 is None:
         dt0 = (t1 - t0) / 100.0
+    # dt underflow threshold: once rejections have shrunk dt below this,
+    # the solution is blowing up (or the tolerances are unreachable) — the
+    # adaptive-controller analog of the reference's NaN divergence test
+    # (``test/ode.jl:41-57``), where a diverging field eventually defeats
+    # any step size.
+    dt_min = 1e-12 * max(t1 - t0, 1.0)
 
     def cond(state):
-        u, t, dt, na, nr, nan = state
-        return (t < t1) & (na + nr < max_steps) & (~nan)
+        u, t, dt, na, nr, diverged = state
+        return (t < t1) & (na + nr < max_steps) & (~diverged)
 
     def body(state):
-        u, t, dt, na, nr, nan = state
+        u, t, dt, na, nr, diverged = state
         dt = jnp.minimum(dt, t1 - t)
         u_new, err = rk23_step(f, u, t, dt)
         enorm = error_norm(err, u, u_new, rtol, atol)
         # A non-finite trial (overflowing step) is a rejection with maximal
-        # dt shrink — NOT a blow-up of the integration itself.
+        # dt shrink — only *persistent* failure (dt underflow) or a NaN
+        # that sneaks through error control counts as divergence.
         bad = ~jnp.isfinite(enorm)
         accept = (enorm <= 1.0) & ~bad
         if check_nan:
-            # blow-up detection applies to the state we carry forward
             nan_now = accept & reductions.any(u_new, pred=jnp.isnan)
         else:
             nan_now = jnp.array(False)
@@ -96,21 +102,23 @@ def integrate(f: Callable, u0: PencilArray, t_span: Tuple[float, float], *,
             jnp.clip(0.9 * jnp.maximum(enorm, 1e-10) ** (-1 / 3), 0.2, 5.0))
         u_next = jax.tree_util.tree_map(
             lambda new, old: jnp.where(accept, new, old), u_new, u)
+        # dt underflow — whether through rejections or through accepted
+        # steps shrinking towards a blow-up time — defeats progress
+        underflow = dt * fac < dt_min
         return (
             u_next,
             jnp.where(accept, t + dt, t),
             dt * fac,
             na + accept.astype(jnp.int32),
             nr + (~accept).astype(jnp.int32),
-            nan | nan_now,
+            diverged | nan_now | underflow,
         )
 
-    state0 = (u0, jnp.asarray(t0, dtype=jnp.float64
-                              if jax.config.jax_enable_x64 else jnp.float32),
-              jnp.asarray(dt0, dtype=jnp.float64
-                          if jax.config.jax_enable_x64 else jnp.float32),
+    tdtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    state0 = (u0, jnp.asarray(t0, dtype=tdtype),
+              jnp.asarray(dt0, dtype=tdtype),
               jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
               jnp.asarray(False))
-    u, t, dt, na, nr, nan = jax.lax.while_loop(cond, body, state0)
+    u, t, dt, na, nr, diverged = jax.lax.while_loop(cond, body, state0)
     return u, {"t": t, "dt": dt, "n_accepted": na, "n_rejected": nr,
-               "nan_detected": nan}
+               "nan_detected": diverged}

@@ -2,6 +2,7 @@ from . import reductions
 from .localgrid import LocalRectilinearGrid, localgrid
 from .random import normal, uniform
 from .reductions import (
+    extrema,
     all,
     any,
     count_nonzero,
@@ -17,6 +18,7 @@ from .reductions import (
 
 __all__ = [
     "reductions",
+    "extrema",
     "LocalRectilinearGrid",
     "localgrid",
     "normal",

@@ -133,6 +133,11 @@ def count_nonzero(x: PencilArray) -> jax.Array:
                      identity=0)
 
 
+def extrema(x: PencilArray):
+    """Global ``(min, max)`` pair (Julia ``extrema`` parity)."""
+    return minimum(x), maximum(x)
+
+
 def norm(x: PencilArray, ord: int = 2) -> jax.Array:
     """Global p-norm (what DiffEq-style error control needs to be
     decomposition-independent, cf. ``ext/PencilArraysDiffEqExt.jl:5-9``)."""

@@ -19,9 +19,11 @@ from .transpositions import (
 )
 from .gather import gather
 from .multiarrays import ManyPencilArray
+from . import distributed
 
 __all__ = [
     "ManyPencilArray",
+    "distributed",
     "PencilArray",
     "global_view",
     "AllToAll",

@@ -405,6 +405,28 @@ class PencilArray:
         out = f(self._data, *(o._data for o in others))
         return PencilArray(self._pencil, out, self._extra_dims)
 
+    def astype(self, dtype) -> "PencilArray":
+        """Backend/dtype adaptation — the role of ``Adapt.adapt_structure``
+        (``arrays.jl:142-146``) for element types."""
+        return PencilArray(self._pencil, self._data.astype(dtype),
+                           self._extra_dims)
+
+    @property
+    def real(self) -> "PencilArray":
+        return PencilArray(self._pencil, self._data.real, self._extra_dims)
+
+    @property
+    def imag(self) -> "PencilArray":
+        return PencilArray(self._pencil, self._data.imag, self._extra_dims)
+
+    def conj(self) -> "PencilArray":
+        return PencilArray(self._pencil, jnp.conj(self._data),
+                           self._extra_dims)
+
+    def copy(self) -> "PencilArray":
+        return PencilArray(self._pencil, jnp.copy(self._data),
+                           self._extra_dims)
+
     def fill(self, value) -> "PencilArray":
         """Return a filled copy (reference ``fill!``, ``arrays.jl:494-526``)."""
         return PencilArray(

@@ -1,0 +1,75 @@
+"""Multi-host (multi-process) support — the ``mpiexec`` analog.
+
+The reference runs SPMD under ``mpiexec`` with MPI as the wire
+(``test/runtests.jl:48-53``); scaling past one host is free because every
+rank is its own process.  JAX is single-controller *per process* but
+multi-process capable: each host runs the same program, connected through
+:func:`jax.distributed.initialize`, and ``jax.devices()`` then spans all
+hosts, so a :class:`~pencilarrays_tpu.parallel.topology.Topology` built
+from it covers the full pod slice and XLA lays collectives across
+ICI *and* DCN automatically.
+
+This module wraps the bootstrap and the few host-aware queries the rest
+of the framework needs.  Single-process use (including the CPU test mesh)
+needs none of this — every function degrades to the trivial answer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "initialize",
+    "is_initialized",
+    "process_index",
+    "process_count",
+    "is_multiprocess",
+    "local_devices",
+    "sync_global_devices",
+]
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None, **kw) -> None:
+    """Connect this process to the multi-host job
+    (``jax.distributed.initialize``; on Cloud TPU all arguments are
+    auto-detected from the metadata server).  Call before any jax API,
+    exactly once per process — the moral equivalent of ``MPI.Init``."""
+    global _initialized
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id, **kw)
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_index() -> int:
+    """This host's index (the reference's ``MPI.Comm_rank`` over hosts)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def sync_global_devices(name: str = "pa_barrier") -> None:
+    """Cross-host barrier (``MPI.Barrier`` analog)."""
+    if is_multiprocess():
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

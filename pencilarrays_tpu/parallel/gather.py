@@ -29,4 +29,17 @@ def gather(x: PencilArray, root: int = 0) -> np.ndarray:
     is "root", so the array is always returned.
     """
     del root
-    return np.asarray(x)
+    import jax
+
+    from ..utils.timers import timeit
+
+    with timeit(x.pencil.timer, "gather"):
+        if jax.process_count() > 1:
+            # multi-host: the logical view is not fully addressable here;
+            # all-gather it across hosts (the Isend-to-root of gather.jl,
+            # except every host receives — single-controller semantics)
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(x.logical(), tiled=True))
+        return np.asarray(x)

@@ -183,6 +183,17 @@ class Topology:
     def device(self, coords: Sequence[int]):
         return self._mesh.devices[tuple(coords)]
 
+    @cached_property
+    def _device_coords(self):
+        return {
+            dev.id: tuple(int(c) for c in coords)
+            for coords, dev in np.ndenumerate(self._mesh.devices)
+        }
+
+    def coords_of_device(self, device) -> Tuple[int, ...]:
+        """Cartesian coordinates of a device in this topology."""
+        return self._device_coords[device.id]
+
     # -- comparison -------------------------------------------------------
     def __eq__(self, other) -> bool:
         # Reference compares communicators with MPI.Comm_compare ∈

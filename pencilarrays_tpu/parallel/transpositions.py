@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..utils.timers import timeit
 from .arrays import PencilArray, _fwd_axes, _inv_axes
 from .pencil import LogicalOrder, MemoryOrder, Pencil
 
@@ -128,22 +129,28 @@ def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
     fwd_out = _fwd_axes(pout, extra_ndims)   # logical -> memory
 
     def local_fn(block):
-        # block: local memory-order tile; go logical for the exchange.
-        x = jnp.transpose(block, inv_in)
-        # Pad dim b (fully local here) to its post-exchange padded extent.
-        if b_pad != n_b:
-            pad = [(0, 0)] * x.ndim
-            pad[b] = (0, b_pad - n_b)
-            x = jnp.pad(x, pad)
-        # The exchange: split dim b into P tiles, concat received tiles
-        # along dim a.  This is the reference's entire
-        # pack -> Alltoallv -> unpack pipeline in one op.
-        x = jax.lax.all_to_all(x, axis, split_axis=b, concat_axis=a, tiled=True)
-        # Dim a is now fully local with padded extent; drop tail padding.
-        if x.shape[a] != n_a:
-            x = jax.lax.slice_in_dim(x, 0, n_a, axis=a)
-        # Store in the output pencil's memory order.
-        return jnp.transpose(x, fwd_out)
+        # Phase labels mirror the reference's timer sections
+        # (``Transpositions.jl:173-177``) and show up in device profiles.
+        with jax.named_scope("pack_data"):
+            # block: local memory-order tile; go logical for the exchange.
+            x = jnp.transpose(block, inv_in)
+            # Pad dim b (fully local here) to its post-exchange padded extent.
+            if b_pad != n_b:
+                pad = [(0, 0)] * x.ndim
+                pad[b] = (0, b_pad - n_b)
+                x = jnp.pad(x, pad)
+        with jax.named_scope("exchange"):
+            # The exchange: split dim b into P tiles, concat received tiles
+            # along dim a.  This is the reference's entire
+            # pack -> Alltoallv -> unpack pipeline in one op.
+            x = jax.lax.all_to_all(x, axis, split_axis=b, concat_axis=a,
+                                   tiled=True)
+        with jax.named_scope("unpack_data"):
+            # Dim a is now fully local with padded extent; drop tail padding.
+            if x.shape[a] != n_a:
+                x = jax.lax.slice_in_dim(x, 0, n_a, axis=a)
+            # Store in the output pencil's memory order.
+            return jnp.transpose(x, fwd_out)
 
     fn = jax.shard_map(local_fn, mesh=mesh, in_specs=in_spec,
                        out_specs=out_spec)
@@ -237,8 +244,9 @@ def transpose(src: PencilArray, dest: Pencil, *,
     """
     pin = src.pencil
     R = assert_compatible(pin, dest)
-    out = _compiled_transpose(pin, dest, R, src.ndims_extra, method,
-                              donate)(src.data)
+    with timeit(pin.timer, "transpose!"):
+        out = _compiled_transpose(pin, dest, R, src.ndims_extra, method,
+                                  donate)(src.data)
     return PencilArray(dest, out, src.extra_dims)
 
 

@@ -6,8 +6,20 @@ from .permutations import (
     as_permutation,
     identity_permutation,
 )
+from .timers import (
+    TimerOutput,
+    disable_debug_timings,
+    enable_debug_timings,
+    timeit,
+    timings_enabled,
+)
 
 __all__ = [
+    "TimerOutput",
+    "disable_debug_timings",
+    "enable_debug_timings",
+    "timeit",
+    "timings_enabled",
     "AbstractPermutation",
     "NO_PERMUTATION",
     "NoPermutation",

@@ -1,0 +1,118 @@
+"""Hierarchical timers + profiler annotation — the TimerOutputs subsystem.
+
+Reference: every ``Pencil`` owns (or shares) a ``TimerOutput``
+(``Pencils.jl:191,434``) and the hot sections are wrapped in
+``@timeit_debug timer "label"`` — "transpose!", "pack data", "unpack data",
+I/O ops (``Transpositions.jl:173-177``, ``mpi_io.jl:338-424``).  Timings
+are compiled out by default and enabled with
+``TimerOutputs.enable_debug_timings`` (``docs/src/PencilArrays_timers.md``).
+
+TPU re-design, two complementary channels:
+
+* :func:`jax.named_scope` annotations are ALWAYS emitted inside traced
+  code — they are free at runtime (trace-time metadata) and make the
+  transpose/FFT phases visible in XLA/jax profiler traces, which is where
+  on-device time must be read (host wall-clocks cannot see into an XLA
+  program, and dispatch is async).
+* A host-side hierarchical :class:`TimerOutput` measuring *dispatch+trace*
+  wall time, attached to pencils via ``Pencil(timer=...)`` and disabled by
+  default exactly like the reference's ``@timeit_debug``; enable with
+  :func:`enable_debug_timings`.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager, nullcontext
+from typing import Dict, Optional
+
+import jax
+
+__all__ = [
+    "TimerOutput",
+    "timeit",
+    "enable_debug_timings",
+    "disable_debug_timings",
+    "timings_enabled",
+]
+
+_ENABLED = False
+
+
+def enable_debug_timings() -> None:
+    """Reference ``TimerOutputs.enable_debug_timings(PencilArrays)``."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable_debug_timings() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def timings_enabled() -> bool:
+    return _ENABLED
+
+
+class _Node:
+    __slots__ = ("ncalls", "total", "children")
+
+    def __init__(self):
+        self.ncalls = 0
+        self.total = 0.0
+        self.children: Dict[str, _Node] = {}
+
+
+class TimerOutput:
+    """Hierarchical wall timer (host-side dispatch/trace time)."""
+
+    def __init__(self, name: str = "root"):
+        self.name = name
+        self._root = _Node()
+        self._stack = [self._root]
+
+    @contextmanager
+    def __call__(self, label: str):
+        node = self._stack[-1].children.setdefault(label, _Node())
+        self._stack.append(node)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            node.total += time.perf_counter() - t0
+            node.ncalls += 1
+            self._stack.pop()
+
+    def reset(self) -> None:
+        self._root = _Node()
+        self._stack = [self._root]
+
+    # -- reporting ---------------------------------------------------------
+    def _lines(self, node: _Node, depth: int, out):
+        for label, child in sorted(node.children.items(),
+                                   key=lambda kv: -kv[1].total):
+            out.append(
+                f"{'  ' * depth}{label:<{40 - 2 * depth}} "
+                f"{child.ncalls:>8} {child.total * 1e3:>12.3f} ms"
+            )
+            self._lines(child, depth + 1, out)
+
+    def report(self) -> str:
+        out = [f"TimerOutput({self.name})  —  host dispatch/trace wall time",
+               f"{'section':<40} {'ncalls':>8} {'time':>15}"]
+        self._lines(self._root, 0, out)
+        return "\n".join(out)
+
+    def __repr__(self) -> str:
+        return self.report()
+
+
+@contextmanager
+def timeit(timer: Optional[TimerOutput], label: str):
+    """``@timeit_debug timer label`` analog: always emits a
+    ``jax.named_scope`` (visible in device profiles); additionally records
+    host wall time when debug timings are enabled and a timer is present."""
+    ctx = timer(label) if (_ENABLED and timer is not None) else nullcontext()
+    with jax.named_scope(label.replace(" ", "_")):
+        with ctx:
+            yield

@@ -177,6 +177,49 @@ def test_read_validation(tmp_path, pen, topo):
             f.write("w", x)
 
 
+def test_native_strided_io_direct(tmp_path):
+    """Unit test of the C++ scatter/gather against numpy ground truth."""
+    from pencilarrays_tpu.io import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    gdims = (7, 9, 5)
+    full = np.zeros(gdims, dtype=np.float64)
+    path = str(tmp_path / "raw.bin")
+    with open(path, "wb") as f:
+        f.write(full.tobytes())
+    rng = np.random.default_rng(0)
+    # scatter two blocks, then compare with numpy assembling
+    blocks = [((1, 2, 0), rng.standard_normal((3, 4, 5))),
+              ((4, 6, 1), rng.standard_normal((3, 3, 4)))]
+    for start, b in blocks:
+        native.scatter_write(path, 0, b, gdims, start)
+        sl = tuple(slice(s, s + e) for s, e in zip(start, b.shape))
+        full[sl] = b
+    raw = np.fromfile(path, dtype=np.float64).reshape(gdims)
+    np.testing.assert_array_equal(raw, full)
+    # gather back a sub-block
+    got = native.gather_read(path, 0, np.float64, gdims, (2, 3, 1), (4, 5, 3))
+    np.testing.assert_array_equal(got, full[2:6, 3:8, 1:4])
+    # out-of-bounds block rejected
+    with pytest.raises(OSError):
+        native.gather_read(path, 0, np.float64, gdims, (5, 0, 0), (4, 1, 1))
+
+
+def test_roundtrip_without_native(tmp_path, pen, monkeypatch):
+    """The pure-NumPy fallback path must behave identically."""
+    from pencilarrays_tpu.io import native
+
+    monkeypatch.setattr(native, "available", lambda: False)
+    u, x = make_data(pen)
+    path = str(tmp_path / "fallback.bin")
+    with open_file(BinaryDriver(), path, write=True, create=True) as f:
+        f.write("u", x)
+    with open_file(BinaryDriver(), path, read=True) as f:
+        y = f.read("u", pen)
+    np.testing.assert_array_equal(gather(y), u)
+
+
 @pytest.mark.skipif(not has_orbax(), reason="orbax not installed")
 def test_orbax_roundtrip(tmp_path, pen, topo):
     u, x = make_data(pen)

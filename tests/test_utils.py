@@ -1,0 +1,117 @@
+"""Timer subsystem and permuted-index iterator tests (reference:
+TimerOutputs integration, ``Pencils.jl:191``; PermutedIndices semantics,
+``PermutedIndices.jl:17-93``; iteration-order invariants,
+``test/pencils.jl:244-278``)."""
+
+import numpy as np
+import pytest
+
+from pencilarrays_tpu import (
+    NO_PERMUTATION,
+    Pencil,
+    PencilArray,
+    PermutedCartesianIndices,
+    PermutedLinearIndices,
+    Permutation,
+    TimerOutput,
+    Topology,
+    disable_debug_timings,
+    enable_debug_timings,
+    transpose,
+)
+
+
+def test_permuted_cartesian_walks_memory_order():
+    shape = (2, 3, 4)
+    perm = Permutation(2, 0, 1)  # memory dims = (d2, d0, d1)
+    it = PermutedCartesianIndices(shape, perm)
+    assert len(it) == 24
+    seen = list(it)
+    # every logical index exactly once
+    assert sorted(seen) == sorted(np.ndindex(*shape))
+    # memory-contiguity: consecutive elements advance the LAST memory dim
+    # (logical dim 1) fastest
+    assert seen[0] == (0, 0, 0)
+    assert seen[1] == (0, 1, 0)  # memory dims (d2,d0,d1): d1 fastest
+    # indexing matches iteration
+    assert it[1] == seen[1]
+    assert it[23] == seen[23]
+
+
+def test_permuted_linear_roundtrip():
+    shape = (3, 4, 5)
+    perm = Permutation(1, 2, 0)
+    lin = PermutedLinearIndices(shape, perm)
+    cart = PermutedCartesianIndices(shape, perm)
+    for n in (0, 7, 59):
+        assert lin[cart[n]] == n
+    # agreement with raw memory-order array walking
+    arr = np.arange(np.prod(shape)).reshape(perm.apply(shape))
+    for n, logical in enumerate(cart):
+        assert arr[perm.apply(logical)] == n
+
+
+def test_identity_permutation_iteration():
+    it = PermutedCartesianIndices((2, 2), NO_PERMUTATION)
+    assert list(it) == [(0, 0), (0, 1), (1, 0), (1, 1)]
+
+
+def test_timer_hierarchy():
+    t = TimerOutput("test")
+    enable_debug_timings()
+    try:
+        with t("outer"):
+            with t("inner"):
+                pass
+            with t("inner"):
+                pass
+        rep = t.report()
+        assert "outer" in rep and "inner" in rep
+        assert t._root.children["outer"].ncalls == 1
+        assert t._root.children["outer"].children["inner"].ncalls == 2
+    finally:
+        disable_debug_timings()
+
+
+def test_timer_attached_to_pencil(devices):
+    topo = Topology((2, 4))
+    timer = TimerOutput("pencil")
+    pen_x = Pencil(topo, (8, 8, 8), (1, 2), timer=timer)
+    pen_y = Pencil(topo, (8, 8, 8), (0, 2), timer=timer)
+    x = PencilArray.zeros(pen_x)
+    enable_debug_timings()
+    try:
+        transpose(x, pen_y)
+    finally:
+        disable_debug_timings()
+    assert timer._root.children["transpose!"].ncalls == 1
+    # disabled by default: no recording
+    timer.reset()
+    transpose(x, pen_y)
+    assert "transpose!" not in timer._root.children
+
+
+def test_astype_real_imag(devices):
+    import jax.numpy as jnp
+
+    topo = Topology((2, 4))
+    pen = Pencil(topo, (8, 8, 8), (1, 2))
+    x = PencilArray.zeros(pen, dtype=jnp.complex64)
+    assert x.astype(jnp.complex128).dtype == jnp.complex128
+    assert x.real.dtype == jnp.float32
+    assert x.imag.dtype == jnp.float32
+    assert x.conj().dtype == jnp.complex64
+    y = x.copy()
+    assert y.pencil == x.pencil
+
+
+def test_extrema(devices):
+    from pencilarrays_tpu import ops
+
+    topo = Topology((2, 4))
+    pen = Pencil(topo, (9, 11, 13), (1, 2))
+    u = np.random.default_rng(0).standard_normal((9, 11, 13))
+    x = PencilArray.from_global(pen, u)
+    lo, hi = ops.extrema(x)
+    assert float(lo) == pytest.approx(u.min())
+    assert float(hi) == pytest.approx(u.max())
