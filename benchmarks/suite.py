@@ -125,6 +125,27 @@ def main():
     results["navier_stokes_step_128"] = {"seconds": dt,
                                          "steps_per_s": 1.0 / dt}
 
+    # -- 5. pallas tiled permute vs XLA transpose (local path) ------------
+    from pencilarrays_tpu.ops import pallas_kernels as pk
+
+    n_p = 256
+    # TPU only: interpret-mode numbers would be meaningless as bandwidth
+    if (len(devs) == 1 and devs[0].platform == "tpu"
+            and pk.supported((n_p,) * 3, (2, 0, 1), jnp.float32)):
+        xp = jnp.zeros((n_p,) * 3, jnp.float32)
+        interp = devs[0].platform != "tpu"
+        t_pal = _timeit(lambda a: pk.pallas_permute(a, (2, 0, 1),
+                                                    interpret=interp), xp,
+                        k0=2, k1=12)
+        t_xla = _timeit(lambda a: jnp.transpose(a, (2, 0, 1)) + 0.0, xp,
+                        k0=2, k1=12)
+        nb = xp.size * 4 * 2
+        results["pallas_permute_256"] = {
+            "pallas_gb_per_s": nb / t_pal / 1e9,
+            "xla_gb_per_s": nb / t_xla / 1e9,
+            "speedup": t_xla / t_pal,
+        }
+
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps(results, indent=1))

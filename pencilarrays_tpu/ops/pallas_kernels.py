@@ -1,0 +1,112 @@
+"""Pallas TPU kernels — hand-tiled local data movement.
+
+The reference leans on Strided.jl for cache-friendly strided
+``permutedims!`` in the transpose unpack (``Transpositions.jl:13,
+636-648``): the one place where a naive loop order wrecks memory
+bandwidth.  The XLA analog is usually automatic, but the local permute
+(memory-order change without communication, ``Transpositions.jl:214-271``)
+is exactly the kind of bandwidth-bound op where a VMEM-tiled Pallas
+kernel can control tiling explicitly.
+
+:func:`pallas_permute` implements N-D ``jnp.transpose`` as a Pallas grid
+over VMEM tiles, choosing tile extents so that BOTH the input's and the
+output's minor (lane) dimension run at 128 elements — the in-VMEM
+transpose then happens at register granularity instead of strided HBM
+access.  Used as an opt-in fast path by the transpose engine (set the
+``PENCILARRAYS_TPU_PALLAS=1`` environment variable); anything the kernel
+does not support falls back to ``jnp.transpose`` transparently.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["pallas_permute", "pallas_enabled", "supported"]
+
+_LANE = 128
+_SUBLANE = 8
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("PENCILARRAYS_TPU_PALLAS", "0") == "1"
+
+
+def _tile_shape(shape_out: Tuple[int, ...], axes: Tuple[int, ...]):
+    """Choose an output tile: 128 along the output minor dim AND along the
+    output dim that is the *input's* minor dim; 8 elsewhere (sublane
+    granularity).  Returns None if the shape doesn't tile evenly."""
+    nd = len(shape_out)
+    # output dim k reads input dim axes[k]; input minor dim = nd-1
+    k_in_minor = axes.index(nd - 1)
+    tile = []
+    for k in range(nd):
+        want = _LANE if (k == nd - 1 or k == k_in_minor) else _SUBLANE
+        want = min(want, shape_out[k])
+        if shape_out[k] % want != 0:
+            return None
+        tile.append(want)
+    return tuple(tile)
+
+
+def supported(shape: Sequence[int], axes: Sequence[int], dtype) -> bool:
+    """Whether :func:`pallas_permute` handles this case natively."""
+    shape, axes = tuple(shape), tuple(axes)
+    if len(shape) < 2 or len(shape) > 4:
+        return False
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16),
+                                jnp.dtype(jnp.int32)):
+        return False
+    shape_out = tuple(shape[a] for a in axes)
+    return _tile_shape(shape_out, axes) is not None
+
+
+def _permute_kernel(axes, in_ref, out_ref):
+    out_ref[:] = jnp.transpose(in_ref[:], axes)
+
+
+def pallas_permute(x: jax.Array, axes: Sequence[int], *,
+                   interpret: bool = False) -> jax.Array:
+    """``jnp.transpose(x, axes)`` as a tiled Pallas kernel.
+
+    Requires :func:`supported`; callers fall back to ``jnp.transpose``
+    otherwise.
+    """
+    from jax.experimental import pallas as pl
+
+    axes = tuple(int(a) for a in axes)
+    nd = x.ndim
+    shape_out = tuple(x.shape[a] for a in axes)
+    tile_out = _tile_shape(shape_out, axes)
+    if tile_out is None:
+        raise ValueError(f"unsupported permute {x.shape} axes={axes}")
+    # input tile: B_in[axes[k]] = B_out[k]
+    tile_in = [0] * nd
+    for k in range(nd):
+        tile_in[axes[k]] = tile_out[k]
+    tile_in = tuple(tile_in)
+    grid = tuple(s // t for s, t in zip(shape_out, tile_out))
+
+    def in_index(*bidx):
+        # out block (b_0..b_{n-1}) reads in block J with J[axes[k]] = b_k
+        J = [0] * nd
+        for k in range(nd):
+            J[axes[k]] = bidx[k]
+        return tuple(J)
+
+    def out_index(*bidx):
+        return tuple(bidx)
+
+    return pl.pallas_call(
+        partial(_permute_kernel, axes),
+        out_shape=jax.ShapeDtypeStruct(shape_out, x.dtype),
+        grid=grid,
+        in_specs=[pl.BlockSpec(tile_in, in_index)],
+        out_specs=pl.BlockSpec(tile_out, out_index),
+        interpret=interpret,
+    )(x)

@@ -52,6 +52,22 @@ from ..utils.timers import timeit
 from .arrays import PencilArray, _fwd_axes, _inv_axes
 from .pencil import LogicalOrder, MemoryOrder, Pencil
 
+
+def _maybe_pallas_transpose(a, axes, platform: str):
+    """Local permute: VMEM-tiled Pallas kernel when enabled & supported
+    (6x+ over XLA's strided transpose for the hard layouts on TPU —
+    the Strided.jl role, ``Transpositions.jl:636-648``), else
+    ``jnp.transpose``.  On CPU the kernel runs in interpret mode so the
+    virtual-mesh tests exercise the same code path."""
+    axes = tuple(axes)
+    if axes == tuple(range(a.ndim)):
+        return a
+    from ..ops import pallas_kernels as pk
+
+    if pk.pallas_enabled() and pk.supported(a.shape, axes, a.dtype):
+        return pk.pallas_permute(a, axes, interpret=(platform != "tpu"))
+    return jnp.transpose(a, axes)
+
 __all__ = [
     "AllToAll",
     "Gspmd",
@@ -127,6 +143,7 @@ def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
 
     inv_in = _inv_axes(pin, extra_ndims)     # memory -> logical
     fwd_out = _fwd_axes(pout, extra_ndims)   # logical -> memory
+    platform = mesh.devices.flat[0].platform
 
     def local_fn(block):
         # Phase labels mirror the reference's timer sections
@@ -150,10 +167,16 @@ def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
             if x.shape[a] != n_a:
                 x = jax.lax.slice_in_dim(x, 0, n_a, axis=a)
             # Store in the output pencil's memory order.
-            return jnp.transpose(x, fwd_out)
+            return _maybe_pallas_transpose(x, fwd_out, platform)
+
+    # check_vma=False only when pallas may run: pallas_call outputs carry
+    # no varying-mesh-axes metadata, which the static check rejects; on
+    # the default path the check stays on.
+    from ..ops.pallas_kernels import pallas_enabled
 
     fn = jax.shard_map(local_fn, mesh=mesh, in_specs=in_spec,
-                       out_specs=out_spec)
+                       out_specs=out_spec,
+                       check_vma=not pallas_enabled())
     return fn(data)
 
 
@@ -168,6 +191,21 @@ def _transpose_local(data, pin: Pencil, pout: Pencil, extra_ndims: int):
     axes_logical_to_out = _fwd_axes(pout, extra_ndims)
     axes_in_to_logical = _inv_axes(pin, extra_ndims)
     axes = tuple(axes_in_to_logical[i] for i in axes_logical_to_out)
+    mesh = pin.mesh
+    platform = mesh.devices.flat[0].platform
+    from ..ops import pallas_kernels as pk
+
+    local_shape = pin.padded_size_local(MemoryOrder) + data.shape[
+        pin.ndims:]
+    if pk.pallas_enabled() and pk.supported(local_shape, axes, data.dtype):
+        # per-block tiled permute under shard_map (block layouts are
+        # identical across devices, so one kernel serves all); gating and
+        # interpret policy live in _maybe_pallas_transpose
+        fn = jax.shard_map(
+            lambda blk: _maybe_pallas_transpose(blk, axes, platform),
+            mesh=mesh, in_specs=pin.partition_spec(extra_ndims),
+            out_specs=pout.partition_spec(extra_ndims), check_vma=False)
+        return fn(data)
     out = jnp.transpose(data, axes)
     return jax.lax.with_sharding_constraint(out, pout.sharding(extra_ndims))
 
@@ -203,7 +241,11 @@ def _reshard_gspmd(data, pin: Pencil, pout: Pencil, extra_ndims: int):
 def _compiled_transpose(pin: Pencil, pout: Pencil, R: Optional[int],
                         extra_ndims: int,
                         method: AbstractTransposeMethod,
-                        donate: bool = False):
+                        donate: bool = False,
+                        _pallas: bool = False):
+    # _pallas participates only as a cache key: the kernels read the env
+    # flag themselves, and keying on it prevents a stale cached executable
+    # after the flag is toggled mid-process.
     """Compiled data->data transpose, cached on the static configuration.
 
     Pencils are frozen/hashable, so (pin, pout, method) is a complete key.
@@ -244,9 +286,11 @@ def transpose(src: PencilArray, dest: Pencil, *,
     """
     pin = src.pencil
     R = assert_compatible(pin, dest)
+    from ..ops.pallas_kernels import pallas_enabled
+
     with timeit(pin.timer, "transpose!"):
         out = _compiled_transpose(pin, dest, R, src.ndims_extra, method,
-                                  donate)(src.data)
+                                  donate, pallas_enabled())(src.data)
     return PencilArray(dest, out, src.extra_dims)
 
 
