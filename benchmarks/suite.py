@@ -133,9 +133,7 @@ def main():
     if (len(devs) == 1 and devs[0].platform == "tpu"
             and pk.supported((n_p,) * 3, (2, 0, 1), jnp.float32)):
         xp = jnp.zeros((n_p,) * 3, jnp.float32)
-        interp = devs[0].platform != "tpu"
-        t_pal = _timeit(lambda a: pk.pallas_permute(a, (2, 0, 1),
-                                                    interpret=interp), xp,
+        t_pal = _timeit(lambda a: pk.pallas_permute(a, (2, 0, 1)), xp,
                         k0=2, k1=12)
         t_xla = _timeit(lambda a: jnp.transpose(a, (2, 0, 1)) + 0.0, xp,
                         k0=2, k1=12)

@@ -1,0 +1,53 @@
+"""Taylor-Green vortex with the pseudo-spectral Navier-Stokes model:
+simulate, checkpoint, restart under a different topology, continue.
+
+Run anywhere:  python examples/navier_stokes.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import tempfile
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+
+try:
+    on_tpu = jax.default_backend() == "tpu" and len(jax.devices()) >= 8
+except RuntimeError:
+    on_tpu = False
+if not on_tpu:
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu.io import BinaryDriver, open_file
+from pencilarrays_tpu.models import NavierStokesSpectral, taylor_green
+
+topo = pa.Topology.auto(2)
+model = NavierStokesSpectral(topo, 32, viscosity=5e-3, dtype=jnp.float32)
+uh = taylor_green(model)
+step = jax.jit(lambda s: model.step(s, 5e-3))
+
+print("step 0: E =", float(model.energy(uh)))
+for i in range(10):
+    uh = step(uh)
+print("step 10: E =", float(model.energy(uh)))
+
+# checkpoint the physical velocity, restart on a slab topology
+tmp = tempfile.mkdtemp()
+with open_file(BinaryDriver(), f"{tmp}/tg.bin", write=True, create=True) as f:
+    f.write("velocity", model.to_physical(uh))
+
+topo2 = pa.Topology.auto(1)
+model2 = NavierStokesSpectral(topo2, 32, viscosity=5e-3, dtype=jnp.float32)
+with open_file(BinaryDriver(), f"{tmp}/tg.bin", read=True) as f:
+    u2 = f.read("velocity", model2.plan.input_pencil)
+uh2 = model2.from_physical(u2)
+print("restarted on", topo2, ": E =", float(model2.energy(uh2)))
+uh2 = jax.jit(lambda s: model2.step(s, 5e-3))(uh2)
+print("continued: E =", float(model2.energy(uh2)))

@@ -1,8 +1,12 @@
 from .core import ParallelIODriver, metadata, open_file
 from .binary import BinaryDriver, BinaryFile
 from .orbax_driver import OrbaxDriver, OrbaxFile, has_orbax
+from .hdf5 import HDF5Driver, HDF5File, has_hdf5
 
 __all__ = [
+    "HDF5Driver",
+    "HDF5File",
+    "has_hdf5",
     "ParallelIODriver",
     "metadata",
     "open_file",

@@ -52,6 +52,32 @@ def _endianness() -> str:
     return sys.byteorder  # "little" on TPU hosts
 
 
+def iter_local_blocks(x: PencilArray):
+    """Yield ``(start, block)`` for THIS process's shards: ``start`` the
+    logical-order global corner (extra dims zero), ``block`` the true-size
+    logical-order data.  One host copy per shard, no device compute —
+    shared by every driver's write path."""
+    from ..parallel.arrays import _inv_axes
+
+    pen = x.pencil
+    topo = pen.topology
+    nd_extra = x.ndims_extra
+    inv = _inv_axes(pen, nd_extra)
+    for shard in x.data.addressable_shards:
+        coords = topo.coords_of_device(shard.device)
+        rr = pen.range_local(coords, LogicalOrder)
+        if any(len(r) == 0 for r in rr):
+            continue
+        rr_mem = pen.range_local(coords, MemoryOrder)
+        raw = np.asarray(shard.data)
+        # valid data is a prefix of each padded local dim
+        sl = tuple(slice(0, len(r)) for r in rr_mem)
+        sl += (slice(None),) * nd_extra
+        block = np.transpose(raw[sl], inv)  # memory -> logical order
+        start = tuple(r.start for r in rr) + (0,) * nd_extra
+        yield start, block
+
+
 def _assemble_sharded(pencil: Pencil, extra_dims: Tuple[int, ...], dtype,
                       block_reader: Callable) -> PencilArray:
     """Build a sharded PencilArray by streaming one true-size logical-order
@@ -225,52 +251,30 @@ class BinaryFile:
             # sparsely anyway; this makes short datasets well-formed)
             with open(self.filename, "r+b") as f:
                 f.truncate(total)
-        topo = x.pencil.topology
-        nd_extra = x.ndims_extra
-        # Walk THIS process's addressable shards (a host-local device->host
-        # copy each, no device compute) so that under multi-host SPMD every
-        # process writes exactly its own blocks into the shared file — the
-        # collective write_all of mpi_io.jl:335-380.  Each block is
-        # materialized inside its task so only in-flight blocks occupy
+        # Walk THIS process's blocks (iter_local_blocks) so that under
+        # multi-host SPMD every process writes exactly its own blocks into
+        # the shared file — the collective write_all of mpi_io.jl:335-380.
+        # Blocks are materialized lazily so only in-flight ones occupy
         # host memory.
-        from ..parallel.arrays import _inv_axes
-
-        inv = _inv_axes(x.pencil, nd_extra)
         use_native = native.available()
-        mm = None
-        if not use_native:
-            mm = np.memmap(self.filename, dtype=dtype, mode="r+",
-                           offset=offset, shape=shape)
-
-        def write_shard(shard):
-            coords = topo.coords_of_device(shard.device)
-            rr = x.pencil.range_local(coords, LogicalOrder)
-            if any(len(r) == 0 for r in rr):
-                return
-            rr_mem = x.pencil.range_local(coords, MemoryOrder)
-            raw = np.asarray(shard.data)
-            # valid data is a prefix of each padded local dim
-            sl = tuple(slice(0, len(r)) for r in rr_mem)
-            sl += (slice(None),) * nd_extra
-            block = np.transpose(raw[sl], inv)  # memory -> logical order
-            start = tuple(r.start for r in rr) + (0,) * nd_extra
-            if use_native:
+        if use_native:
+            def write_block(start_block):
+                start, block = start_block
                 # native strided scatter (the MPI create_subarray+write_all
                 # analog): GIL-released pwrite runs
                 native.scatter_write(self.filename, offset,
-                                     np.ascontiguousarray(block), shape, start)
-            else:
+                                     np.ascontiguousarray(block), shape,
+                                     start)
+
+            with ThreadPoolExecutor(max_workers=8) as ex:
+                list(ex.map(write_block, iter_local_blocks(x)))
+        else:
+            mm = np.memmap(self.filename, dtype=dtype, mode="r+",
+                           offset=offset, shape=shape)
+            for start, block in iter_local_blocks(x):
                 dst = tuple(slice(s, s + e)
                             for s, e in zip(start, block.shape))
                 mm[dst] = block
-
-        shards = list(x.data.addressable_shards)
-        if use_native:
-            with ThreadPoolExecutor(max_workers=min(8, len(shards) or 1)) as ex:
-                list(ex.map(write_shard, shards))
-        else:
-            for shard in shards:
-                write_shard(shard)
             mm.flush()
             del mm
 

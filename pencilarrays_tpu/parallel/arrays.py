@@ -110,8 +110,22 @@ class PencilArray:
     def from_global(cls, pencil: Pencil, array,
                     extra_ndims: Optional[int] = None) -> "PencilArray":
         """Build from a true-shape, *logical-order* global array (NumPy or
-        JAX), padding/permuting/sharding as the pencil dictates."""
+        JAX), padding/permuting/sharding as the pencil dictates.
+
+        Note: under JAX's default ``jax_enable_x64=False``, 64-bit NumPy
+        input is downcast to 32 bits; a warning is emitted because the
+        reference (Julia) world preserves Float64 silently and the
+        precision loss has bitten real users.
+        """
+        import warnings
+
         arr = jnp.asarray(array)
+        if hasattr(array, "dtype") and arr.dtype != array.dtype:
+            warnings.warn(
+                f"from_global: input dtype {array.dtype} stored as "
+                f"{arr.dtype} (enable jax_enable_x64 for 64-bit arrays)",
+                stacklevel=2,
+            )
         N = pencil.ndims
         if extra_ndims is None:
             extra_ndims = arr.ndim - N
