@@ -52,11 +52,13 @@ def _endianness() -> str:
     return sys.byteorder  # "little" on TPU hosts
 
 
-def iter_local_blocks(x: PencilArray):
-    """Yield ``(start, block)`` for THIS process's shards: ``start`` the
-    logical-order global corner (extra dims zero), ``block`` the true-size
-    logical-order data.  One host copy per shard, no device compute —
-    shared by every driver's write path."""
+def iter_local_blocks(x: PencilArray, order=LogicalOrder):
+    """Yield per-shard tuples for THIS process: with ``order=LogicalOrder``
+    (default) ``(start, block)`` where ``start`` is the logical-order
+    global corner and ``block`` the true-size logical-order data; with
+    ``order=MemoryOrder`` ``(coords, block)`` with the block left in
+    memory order (no transpose).  One host copy per shard, no device
+    compute — shared by every driver's write path."""
     from ..parallel.arrays import _inv_axes
 
     pen = x.pencil
@@ -73,6 +75,9 @@ def iter_local_blocks(x: PencilArray):
         # valid data is a prefix of each padded local dim
         sl = tuple(slice(0, len(r)) for r in rr_mem)
         sl += (slice(None),) * nd_extra
+        if order is MemoryOrder:
+            yield coords, raw[sl]
+            continue
         block = np.transpose(raw[sl], inv)  # memory -> logical order
         start = tuple(r.start for r in rr) + (0,) * nd_extra
         yield start, block
@@ -279,24 +284,34 @@ class BinaryFile:
             del mm
 
     def _write_chunks(self, x: PencilArray, offset: int, dtype) -> List[Dict]:
+        pen = x.pencil
+        topo = pen.topology
+        nd_extra = x.ndims_extra
+        # The chunk map is pure pencil math — every process derives the
+        # identical table, so no cross-host coordination is needed for
+        # offsets (mpi_io.jl:382-424 rank-order layout).
         chunk_map = []
-        topo = x.pencil.topology
         pos = offset
+        for rank in range(len(topo)):
+            coords = topo.coords(rank)
+            rr = pen.range_local(coords, LogicalOrder)
+            shape_mem = pen.size_local(coords, MemoryOrder) + x.extra_dims
+            chunk_map.append({
+                "rank": rank,
+                "offset_bytes": pos,
+                "dims_memory": list(shape_mem),
+                "ranges_logical": [[r.start, r.stop] for r in rr],
+            })
+            pos += int(np.prod(shape_mem, dtype=np.int64)) * dtype.itemsize
+        if self._is_proc0:
+            with open(self.filename, "r+b") as f:
+                f.truncate(pos)
+        # each process writes its own addressable shards' chunks
         with open(self.filename, "r+b") as f:
-            f.seek(offset)
-            for rank in range(len(topo)):
-                coords = topo.coords(rank)
-                rr = x.pencil.range_local(coords, LogicalOrder)
-                block = np.asarray(x.local_block(coords, MemoryOrder))
-                raw = block.tobytes()  # memory-order contiguous
-                f.write(raw)
-                chunk_map.append({
-                    "rank": rank,
-                    "offset_bytes": pos,
-                    "dims_memory": list(block.shape),
-                    "ranges_logical": [[r.start, r.stop] for r in rr],
-                })
-                pos += len(raw)
+            for coords, block in iter_local_blocks(x, MemoryOrder):
+                rank = topo.rank(coords)
+                f.seek(chunk_map[rank]["offset_bytes"])
+                f.write(np.ascontiguousarray(block).tobytes())
         return chunk_map
 
     # -- read -------------------------------------------------------------
