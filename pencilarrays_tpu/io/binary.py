@@ -286,7 +286,6 @@ class BinaryFile:
     def _write_chunks(self, x: PencilArray, offset: int, dtype) -> List[Dict]:
         pen = x.pencil
         topo = pen.topology
-        nd_extra = x.ndims_extra
         # The chunk map is pure pencil math — every process derives the
         # identical table, so no cross-host coordination is needed for
         # offsets (mpi_io.jl:382-424 rank-order layout).

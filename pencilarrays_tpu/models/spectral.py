@@ -184,6 +184,21 @@ class NavierStokesSpectral:
         out = (uh.data + 0.5 * dt * n1.data) * e + 0.5 * dt * n2.data
         return PencilArray(uh.pencil, out, uh.extra_dims)
 
+    def simulate(self, uh: PencilArray, dt: float, n_steps: int,
+                 *, record_energy: bool = False):
+        """Run ``n_steps`` RK2 steps as one ``lax.scan`` — a single XLA
+        program for the whole trajectory (no per-step dispatch), the
+        idiomatic TPU time loop.  Returns ``(state, energies)`` where
+        ``energies`` is a per-step array when ``record_energy`` else None.
+        """
+        def body(state, _):
+            new = self.step(state, dt)
+            out = self.energy(new) if record_energy else jnp.zeros(())
+            return new, out
+
+        final, energies = jax.lax.scan(body, uh, None, length=n_steps)
+        return final, (energies if record_energy else None)
+
     def energy(self, uh: PencilArray):
         """Mean kinetic energy ``<|u|^2>/2`` over the box (computed in
         physical space; padding masked by the global reduction)."""

@@ -44,11 +44,17 @@ __all__ = ["PencilFFTPlan"]
 @lru_cache(maxsize=512)
 def _stage_fn(pen: Pencil, extra_ndims: int, kind: str, axis: int, n: int):
     """Cached per-stage local-transform callable (see _local_fft)."""
+    from jax.scipy import fft as jsfft
+
     ops = {
         "fft": lambda blk: jnp.fft.fft(blk, axis=axis),
         "ifft": lambda blk: jnp.fft.ifft(blk, axis=axis),
         "rfft": lambda blk: jnp.fft.rfft(blk, axis=axis),
         "irfft": lambda blk: jnp.fft.irfft(blk, n=n, axis=axis),
+        # R2R cosine transforms (PencilFFTs Transforms.R2R parity);
+        # DCT-II with ortho norm so idct is the exact inverse
+        "dct": lambda blk: jsfft.dct(blk, axis=axis, norm="ortho"),
+        "idct": lambda blk: jsfft.idct(blk, axis=axis, norm="ortho"),
     }
     op = ops[kind]
     if math.prod(pen.mesh.devices.shape) == 1:
@@ -81,7 +87,14 @@ class PencilFFTPlan:
 
     def __init__(self, topology: Topology, global_shape: Sequence[int], *,
                  real: bool = False, dtype=None, permute: bool = True,
+                 transform: str = "fft",
                  method: AbstractTransposeMethod = AllToAll()):
+        if transform not in ("fft", "dct"):
+            raise ValueError(f"transform must be 'fft' or 'dct', got "
+                             f"{transform!r}")
+        self.transform = transform
+        if transform == "dct" and real:
+            raise ValueError("real=True is implicit for transform='dct'")
         global_shape = tuple(int(n) for n in global_shape)
         N = len(global_shape)
         M = topology.ndims
@@ -94,16 +107,23 @@ class PencilFFTPlan:
         self.shape_physical = global_shape
         self.real = real
         if dtype is None:
-            dtype = jnp.float32 if real else jnp.complex64
+            dtype = (jnp.float32 if (real or transform == "dct")
+                     else jnp.complex64)
         self.dtype_physical = jnp.dtype(dtype)
         if real and jnp.issubdtype(self.dtype_physical, jnp.complexfloating):
             raise ValueError("real=True requires a real input dtype")
-        self.dtype_spectral = jnp.dtype(
-            jnp.result_type(self.dtype_physical, jnp.complex64))
+        if transform == "dct":
+            if jnp.issubdtype(self.dtype_physical, jnp.complexfloating):
+                raise ValueError("transform='dct' requires a real dtype")
+            self.dtype_spectral = self.dtype_physical  # R2R: real throughout
+        else:
+            self.dtype_spectral = jnp.dtype(
+                jnp.result_type(self.dtype_physical, jnp.complex64))
         self.method = method
         self.permute = permute
 
-        # spectral global shape: r2c halves dim 0 (first transform dim)
+        # spectral global shape: r2c halves dim 0 (first transform dim);
+        # R2R transforms preserve every extent
         if real:
             self.shape_spectral = (global_shape[0] // 2 + 1,) + global_shape[1:]
         else:
@@ -204,19 +224,20 @@ class PencilFFTPlan:
         pen = self._pencils[0]
         axis = self._mem_axis(pen, 0)
         nd_extra = u.ndims_extra
+        fwd_kind = "dct" if self.transform == "dct" else "fft"
         if self.real:
             data = self._local_fft(pen, u.data, nd_extra, "rfft", axis)
             pen = self._pencil0_spec
         else:
             data = self._local_fft(
-                pen, u.data.astype(self.dtype_spectral), nd_extra, "fft",
+                pen, u.data.astype(self.dtype_spectral), nd_extra, fwd_kind,
                 axis)
         x = PencilArray(pen, data.astype(self.dtype_spectral), u.extra_dims)
         for d in range(1, N):
             target = self._spectral_pencil_for(self._pencils[d])
             x = transpose(x, target, method=self.method)
             axis = self._mem_axis(target, d)
-            data = self._local_fft(target, x.data, nd_extra, "fft", axis)
+            data = self._local_fft(target, x.data, nd_extra, fwd_kind, axis)
             x = PencilArray(target, data, x.extra_dims)
         return x
 
@@ -229,10 +250,11 @@ class PencilFFTPlan:
             )
         N = len(self.shape_physical)
         nd_extra = uh.ndims_extra
+        inv_kind = "idct" if self.transform == "dct" else "ifft"
         x = uh
         for d in range(N - 1, 0, -1):
             axis = self._mem_axis(x.pencil, d)
-            data = self._local_fft(x.pencil, x.data, nd_extra, "ifft",
+            data = self._local_fft(x.pencil, x.data, nd_extra, inv_kind,
                                    axis)
             x = PencilArray(x.pencil, data, x.extra_dims)
             target = self._spectral_pencil_for(self._pencils[d - 1])
@@ -247,20 +269,26 @@ class PencilFFTPlan:
             # shape is exact.
             data = data.astype(self.dtype_physical)
             return PencilArray(self._pencils[0], data, x.extra_dims)
-        data = self._local_fft(x.pencil, x.data, nd_extra, "ifft", axis)
+        data = self._local_fft(x.pencil, x.data, nd_extra, inv_kind, axis)
         return PencilArray(self._pencils[0], data, x.extra_dims)
 
     # -- spectral helpers -------------------------------------------------
     def frequencies(self, d: int, *, spacing: float = 1.0):
-        """Global frequency vector of logical dim ``d`` (``fftfreq`` /
-        ``rfftfreq`` for the r2c dim), scaled to angular form by caller."""
+        """Global frequency vector of logical dim ``d``: ``fftfreq`` /
+        ``rfftfreq`` for Fourier plans (caller scales to angular form);
+        for ``transform='dct'`` the DCT-II mode wavenumbers
+        ``pi * j / (n * spacing)`` (mode ``j`` represents
+        ``cos(pi j (x+1/2)/n)``)."""
         n = self.shape_physical[d]
+        if self.transform == "dct":
+            return jnp.pi * jnp.arange(n) / (n * spacing)
         if self.real and d == 0:
             return jnp.fft.rfftfreq(n, d=spacing)
         return jnp.fft.fftfreq(n, d=spacing)
 
     def __repr__(self) -> str:
-        kind = "rfft" if self.real else "fft"
+        kind = self.transform if self.transform != "fft" else (
+            "rfft" if self.real else "fft")
         return (
             f"PencilFFTPlan({kind}, shape={self.shape_physical}, "
             f"topo={self.topology.dims}, permute={self.permute})"

@@ -69,6 +69,26 @@ def test_decomposition_independence(topo, devices):
     np.testing.assert_allclose(u8, u1, rtol=1e-9, atol=1e-11)
 
 
+def test_simulate_scan(topo):
+    """Whole-trajectory lax.scan: must agree with the step-by-step loop
+    and record monotone-decaying energies."""
+    model = NavierStokesSpectral(topo, 16, viscosity=0.05, dtype=jnp.float64)
+    uh0 = taylor_green(model)
+    final, energies = jax.jit(
+        lambda s: model.simulate(s, 0.01, 5, record_energy=True))(uh0)
+    # equivalent to explicit stepping
+    uh = uh0
+    for _ in range(5):
+        uh = model.step(uh, 0.01)
+    # scan-compiled vs per-step-compiled programs fuse differently; allow
+    # rounding-level drift (absolute, for near-zero spectral coefficients)
+    np.testing.assert_allclose(np.asarray(final.data), np.asarray(uh.data),
+                               rtol=1e-9, atol=1e-13)
+    e = np.asarray(energies)
+    assert e.shape == (5,)
+    assert (np.diff(e) < 0).all()  # viscous decay
+
+
 def test_ode_exponential_decay(topo):
     shape = (9, 11, 13)  # ragged: padding-masked norms matter
     pen = Pencil(topo, shape, (1, 2))
