@@ -147,29 +147,54 @@ class BinaryFile:
         import jax
 
         self._is_proc0 = jax.process_index() == 0
-        exists = os.path.exists(filename)
+        multiproc = jax.process_count() > 1
         # append (like Julia open flags, where append implies create) and
         # any write mode create a missing file; truncate always resets.
-        if truncate or (not exists and self.writable):
-            if self._is_proc0:
+        if self.writable and multiproc:
+            # COLLECTIVE open (like MPI_File_open): process 0 creates or
+            # resets the file and flushes a fresh sidecar BEFORE the
+            # barrier; peers only look at the filesystem after it, so they
+            # can never observe a half-created file or mid-dump sidecar.
+            if self._is_proc0 and (truncate or not os.path.exists(filename)):
                 with open(self.filename, "wb"):
                     pass
+                self._meta = {"driver": "BinaryDriver",
+                              "version": FORMAT_VERSION,
+                              "endianness": _endianness(), "datasets": []}
+                self._flush_meta()
+            from ..parallel.distributed import sync_global_devices
+
+            sync_global_devices("pa_io_open")
+            if not os.path.exists(filename):
+                raise FileNotFoundError(filename)
+            self._meta = self._load_meta()
+        elif truncate or (not os.path.exists(filename) and self.writable):
+            with open(self.filename, "wb"):
+                pass
             self._meta = {"driver": "BinaryDriver", "version": FORMAT_VERSION,
                           "endianness": _endianness(), "datasets": []}
-            if self._is_proc0:
-                self._flush_meta()
-        elif exists:
+            self._flush_meta()
+        elif os.path.exists(filename):
             self._meta = self._load_meta()
         else:
             raise FileNotFoundError(filename)
-        # Base offset captured once at open: end offsets during writes are
-        # derived deterministically from (base, metadata) on EVERY process,
-        # never from getsize() mid-write — the analog of the reference
-        # synchronizing the shared file position across ranks
-        # (``mpi_io.jl:70-75``).
-        self._base_offset = (
-            os.path.getsize(self.filename) if os.path.exists(self.filename)
-            else 0)
+        # Base offset: dataset offsets must be identical on every process.
+        # Under multi-process, file size is a RACING shared variable (a
+        # peer's truncate/pwrite can land between barrier exit and a
+        # getsize call), so the base comes from the sidecar metadata only
+        # — the analog of the reference synchronizing the shared file
+        # position across ranks (``mpi_io.jl:70-75``).  Single-process
+        # opens may additionally append after sidecar-less raw content,
+        # where getsize is authoritative.
+        meta_end = max(
+            (d["offset_bytes"] + d["size_bytes"]
+             for d in self._meta["datasets"]), default=0)
+        if multiproc:
+            self._base_offset = meta_end
+        else:
+            self._base_offset = max(meta_end, (
+                os.path.getsize(self.filename)
+                if os.path.exists(self.filename) else 0))
         self._closed = False
 
     # -- metadata ---------------------------------------------------------

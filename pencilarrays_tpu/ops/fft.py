@@ -274,14 +274,15 @@ class PencilFFTPlan:
 
     # -- spectral helpers -------------------------------------------------
     def frequencies(self, d: int, *, spacing: float = 1.0):
-        """Global frequency vector of logical dim ``d``: ``fftfreq`` /
-        ``rfftfreq`` for Fourier plans (caller scales to angular form);
-        for ``transform='dct'`` the DCT-II mode wavenumbers
-        ``pi * j / (n * spacing)`` (mode ``j`` represents
-        ``cos(pi j (x+1/2)/n)``)."""
+        """Global frequency vector of logical dim ``d`` in CYCLES per
+        unit for every transform kind (scale by ``2*pi`` for angular
+        wavenumbers, as with ``fftfreq``): ``fftfreq``/``rfftfreq`` for
+        Fourier plans; for ``transform='dct'`` mode ``j`` (the basis
+        function ``cos(pi j (x+1/2)/n)``) has angular wavenumber
+        ``pi j/(n spacing)``, i.e. ``j/(2 n spacing)`` cycles."""
         n = self.shape_physical[d]
         if self.transform == "dct":
-            return jnp.pi * jnp.arange(n) / (n * spacing)
+            return jnp.arange(n) / (2.0 * n * spacing)
         if self.real and d == 0:
             return jnp.fft.rfftfreq(n, d=spacing)
         return jnp.fft.fftfreq(n, d=spacing)

@@ -1,0 +1,77 @@
+"""Worker for the multi-process (multi-host analog) integration test.
+
+The reference runs every functional test under ``mpiexec -n N``
+(``test/runtests.jl:48-53``); the JAX analog is N OS processes joined by
+``jax.distributed.initialize``, each owning a slice of the device pool.
+This worker is launched by ``test_multiprocess.py`` with::
+
+    python multiprocess_worker.py <coordinator> <nprocs> <pid> <tmpdir>
+
+and exercises the cross-process surface: a topology spanning all
+processes' devices, sharded fills, transpose, padding-masked global
+reductions, multihost gather, and per-process collective binary IO with
+a cross-process barrier.
+"""
+
+import os
+import sys
+
+
+def main():
+    coordinator, nprocs, pid, tmpdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.distributed.initialize(coordinator, num_processes=nprocs,
+                               process_id=pid)
+    import jax.numpy as jnp
+    import numpy as np
+
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.io import BinaryDriver, open_file
+
+    assert jax.process_count() == nprocs
+    assert len(jax.devices()) == 4 * nprocs
+    assert len(jax.local_devices()) == 4
+
+    topo = pa.Topology((2, 4))
+    shape = (11, 9, 13)  # ragged on purpose
+    pen_x = pa.Pencil(topo, shape, (1, 2), permutation=pa.Permutation(2, 0, 1))
+    pen_y = pa.Pencil(topo, shape, (0, 2))
+
+    # sharded fill spans both processes; reductions are global
+    u = pa.ops.normal(pen_x, jax.random.key(7), dtype=jnp.float64)
+    total = float(pa.ops.sum(u))
+    mx = float(pa.ops.maximum(u))
+
+    # gather returns the full array on EVERY process (process_allgather)
+    g = pa.gather(u)
+    assert g.shape == shape
+    assert np.isclose(g.sum(), total, rtol=1e-10)
+    assert np.isclose(g.max(), mx, rtol=1e-12)
+
+    # transpose across the pod; ground truth agreement on every process
+    v = pa.transpose(u, pen_y)
+    gv = pa.gather(v)
+    assert np.array_equal(gv, g), "transpose mismatch across processes"
+
+    # collective binary write: each process writes only its shards;
+    # deterministic offsets + barrier make the file complete
+    path = os.path.join(tmpdir, "mp.bin")
+    with open_file(BinaryDriver(), path, write=True, create=True) as f:
+        f.write("u", u)
+    with open_file(BinaryDriver(), path, read=True) as f:
+        back = f.read("u", pen_y)  # different decomposition on re-read
+    assert np.array_equal(pa.gather(back), g), "IO round trip mismatch"
+
+    pa.distributed.sync_global_devices("done")
+    print(f"WORKER_OK pid={pid} sum={total:.6f}")
+
+
+if __name__ == "__main__":
+    main()
