@@ -40,41 +40,21 @@ def main():
     g = localgrid(pen, [np.linspace(0, 1, n) for n in shape])
     gx, gy, gz = g.components()
 
-    # Measurement protocol: K iterations inside one jit + a scalar
-    # readback (block_until_ready does NOT synchronize through remote TPU
-    # tunnels), differencing two K values to cancel dispatch/transfer
-    # overhead — the like-for-like comparison with the reference's
-    # BenchmarkTools kernel minimum.
-    def timed(K):
-        @jax.jit
-        def run(d):
-            def body(i, a):
-                # grids.jl ftest-shaped expression: u + x + 2 y cos z.
-                # eps is 0 at runtime but data-dependent on the carry, so
-                # XLA cannot hoist the grid subexpression out of the loop
-                # (the reference evaluates the FULL expression every time).
-                eps = a[0, 0, 0] * 0.0
-                return a + gx + 2.0 * gy * jnp.cos(gz + eps)
-            out = jax.lax.fori_loop(0, K, body, d)
-            return jnp.sum(out).astype(jnp.float32)
-        float(run(u.data))  # compile + warm
-        best = float("inf")
-        for _ in range(5):
-            t0 = time.perf_counter()
-            float(run(u.data))
-            best = min(best, time.perf_counter() - t0)
-        return best
+    # Shared hardened protocol (see utils/benchtime.py): in-jit loop,
+    # min-of-repeats, K-differencing with plausibility guard — the
+    # like-for-like comparison with the reference's BenchmarkTools kernel
+    # minimum.
+    from pencilarrays_tpu.utils.benchtime import device_seconds_per_iter
 
-    # minimum over repeats (BenchmarkTools-style) to suppress tunnel
-    # noise; wide K spread so the loop dwarfs dispatch jitter
-    k0, k1 = 10, 10010
-    slope = (timed(k1) - timed(k0)) / (k1 - k0)
-    if slope <= 0:
-        # pathological stall during the k0 arm: fall back to the
-        # conservative per-iteration upper bound (includes dispatch)
-        # instead of printing an absurd clamped value
-        slope = timed(k1) / k1
-    dt_us = slope * 1e6
+    def body(a):
+        # grids.jl ftest-shaped expression: u + x + 2 y cos z.
+        # eps is 0 at runtime but data-dependent on the carry, so XLA
+        # cannot hoist the grid subexpression out of the timing loop
+        # (the reference evaluates the FULL expression every time).
+        eps = a[0, 0, 0] * 0.0
+        return a + gx + 2.0 * gy * jnp.cos(gz + eps)
+
+    dt_us = device_seconds_per_iter(body, u.data, k0=10, k1=10010) * 1e6
 
     print(json.dumps({
         "metric": "grid_broadcast_60x110x21_f64",

@@ -22,26 +22,15 @@ import time
 import numpy as np
 
 
-def _timeit(body, x0, k0=1, k1=6):
-    """Device time per iteration of ``body`` (a data->data jittable):
-    K iterations inside one jit + scalar readback, K-differenced to cancel
-    dispatch/transfer overhead (block_until_ready does not synchronize
-    through remote TPU tunnels)."""
-    import jax
-    import jax.numpy as jnp
+def _timeit(body, x0, k0=1, k1=6, repeats=5):
+    """Shared hardened device-timing protocol — see
+    ``pencilarrays_tpu.utils.benchtime``."""
+    import sys
 
-    def timed(K):
-        @jax.jit
-        def run(d):
-            out = jax.lax.fori_loop(0, K, lambda i, a: body(a), d)
-            return jnp.sum(jnp.abs(out)).astype(jnp.float32)
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from pencilarrays_tpu.utils.benchtime import device_seconds_per_iter
 
-        float(run(x0))  # compile + warm
-        t0 = time.perf_counter()
-        float(run(x0))
-        return time.perf_counter() - t0
-
-    return max((timed(k1) - timed(k0)) / (k1 - k0), 1e-9)
+    return device_seconds_per_iter(body, x0, k0=k0, k1=k1, repeats=repeats)
 
 
 def main():

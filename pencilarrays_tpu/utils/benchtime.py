@@ -1,0 +1,54 @@
+"""Device-time measurement for benchmarks — the one shared protocol.
+
+Remote TPU tunnels do not synchronize on ``block_until_ready``, so naive
+wall-clock timing measures dispatch, not kernels.  The protocol here:
+
+1. run K iterations of the body inside ONE jitted ``lax.fori_loop`` with
+   a scalar readback (forces real completion);
+2. take the MINIMUM over several repeats per K arm (BenchmarkTools-style,
+   suppresses tunnel jitter);
+3. difference two K values to cancel dispatch/compile overhead;
+4. guard the slope: non-positive or implausibly small slopes (noise
+   swamping the difference) fall back to the conservative per-iteration
+   upper bound ``t(k1)/k1`` instead of reporting absurd throughput.
+
+Used by ``bench.py`` and ``benchmarks/suite.py``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+__all__ = ["device_seconds_per_iter"]
+
+
+def device_seconds_per_iter(body: Callable, x0, *, k0: int, k1: int,
+                            repeats: int = 5) -> float:
+    """Seconds per iteration of ``body`` (a data->data traceable fn)."""
+    import jax
+    import jax.numpy as jnp
+
+    def timed(K):
+        @jax.jit
+        def run(d):
+            out = jax.lax.fori_loop(0, K, lambda i, a: body(a), d)
+            return jnp.sum(jnp.abs(out)).astype(jnp.float32)
+
+        float(run(x0))  # compile + warm
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            float(run(x0))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_k0 = timed(k0)
+    t_k1 = timed(k1)
+    slope = (t_k1 - t_k0) / (k1 - k0)
+    upper = t_k1 / k1  # includes amortized dispatch: always >= true slope
+    if slope <= 0 or slope < 1e-3 * upper:
+        # noise swamped the difference (a stalled k0 arm, or jitter larger
+        # than the loop): report the upper bound rather than an absurdity
+        slope = upper
+    return slope

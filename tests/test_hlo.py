@@ -101,9 +101,10 @@ def test_fft_plan_exchange_budget(topo):
 
 
 def test_ns_step_collective_budget(topo):
-    """One RK2 NS step = 2 nonlinear evals x 3 FFT chains x 2 transposes
-    = 12 all-to-alls, and crucially ZERO all-gathers (each would be a
-    full-array replication across the pod)."""
+    """One RK2 NS step = 2 nonlinear evals x (one batched 6-component
+    backward chain + one forward chain) x 2 transposes = 8 all-to-alls,
+    and crucially ZERO all-gathers (each would be a full-array
+    replication across the pod)."""
     from pencilarrays_tpu.models import NavierStokesSpectral, taylor_green
 
     model = NavierStokesSpectral(topo, 16, viscosity=1e-2, dtype=jnp.float32)
@@ -114,7 +115,7 @@ def test_ns_step_collective_budget(topo):
 
     c = count_collectives(hlo_of(f, uh.data))
     assert c["all-gather"] == 0, c
-    assert c["all-to-all"] == 12, c
+    assert c["all-to-all"] == 8, c
 
 
 def test_masked_reduction_single_all_reduce(topo):
