@@ -43,26 +43,44 @@ def has_orbax() -> bool:
 
 @dataclass(frozen=True)
 class OrbaxDriver(ParallelIODriver):
-    """Reference ``PHDF5Driver`` analog (``hdf5.jl:16-25``)."""
+    """Reference ``PHDF5Driver`` analog (``hdf5.jl:16-25``).
+
+    ``async_write=True`` overlaps checkpoint serialization with ongoing
+    compute (Orbax AsyncCheckpointer): ``write`` returns as soon as the
+    device data is snapshotted; ``close``/``wait_until_finished``
+    block until storage is durable.
+    """
+
+    async_write: bool = False
 
     def open(self, filename: str, *, write: bool = False, read: bool = False,
              create: bool = False, append: bool = False,
              truncate: bool = False) -> "OrbaxFile":
-        return OrbaxFile(filename, write=write or create or truncate or append)
+        writable = write or create or truncate or append
+        return OrbaxFile(filename, write=writable,
+                         async_write=self.async_write and writable)
 
 
 class OrbaxFile:
     """A checkpoint directory holding named PencilArray datasets."""
 
-    def __init__(self, path: str, *, write: bool):
+    def __init__(self, path: str, *, write: bool, async_write: bool = False):
         if not has_orbax():
             raise RuntimeError(
                 "orbax-checkpoint is not available; use BinaryDriver "
                 "(cf. reference PencilIO falling back when parallel HDF5 "
                 "is absent)"
             )
+        import orbax.checkpoint as ocp
+
         self.path = os.path.abspath(path)
         self.writable = write
+        self.async_write = async_write
+        if async_write:
+            self._ckpt = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler())
+        else:
+            self._ckpt = ocp.StandardCheckpointer()
         if write:
             os.makedirs(self.path, exist_ok=True)
         self._closed = False
@@ -75,20 +93,24 @@ class OrbaxFile:
         return os.path.join(self.path, name + ".meta.json")
 
     def write(self, name: str, x: PencilArray) -> None:
-        import orbax.checkpoint as ocp
-
         if not self.writable:
             raise PermissionError("checkpoint not opened for writing")
         item = self._item_dir(name)
-        ckpt = ocp.StandardCheckpointer()
         target = os.fspath(item)
+        # a previous async save to this target may still be committing:
+        # drain before touching the directory
+        self._ckpt.wait_until_finished()
         if os.path.exists(target):
             import shutil
             shutil.rmtree(target)
         # Store the padded sharded array directly (device->storage, no host
-        # replica); true shape travels in the metadata.
-        ckpt.save(target, {"data": x.data})
-        ckpt.wait_until_finished()
+        # replica); true shape travels in the metadata.  With async_write,
+        # save() returns once devices are snapshotted and serialization
+        # proceeds in background threads (call wait_until_finished/close
+        # before reading back).
+        self._ckpt.save(target, {"data": x.data})
+        if not self.async_write:
+            self._ckpt.wait_until_finished()
         meta = {
             "dtype": np.dtype(x.dtype).name,
             "dims_logical": list(x.pencil.size_global(LogicalOrder)),
@@ -115,6 +137,7 @@ class OrbaxFile:
             extra_dims = tuple(meta["metadata"]["extra_dims"])
         saved_perm = meta["metadata"]["permutation"]
         saved_pad = tuple(meta["dims_padded_memory"])
+        self.wait_until_finished()
         ckpt = ocp.StandardCheckpointer()
         restored = ckpt.restore(
             os.fspath(self._item_dir(name)),
@@ -139,7 +162,14 @@ class OrbaxFile:
             for f in os.listdir(self.path) if f.endswith(".meta.json")
         )
 
+    def wait_until_finished(self):
+        """Block until background serialization is durable."""
+        self._ckpt.wait_until_finished()
+
     def close(self):
+        self._ckpt.wait_until_finished()
+        if hasattr(self._ckpt, "close"):
+            self._ckpt.close()  # join the AsyncCheckpointer thread pool
         self._closed = True
 
     def __enter__(self):

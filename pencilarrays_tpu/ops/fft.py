@@ -46,15 +46,36 @@ def _stage_fn(pen: Pencil, extra_ndims: int, kind: str, axis: int, n: int):
     """Cached per-stage local-transform callable (see _local_fft)."""
     from jax.scipy import fft as jsfft
 
+    def _alt_signs(blk):
+        # (-1)^j along the transform axis, broadcast-shaped
+        shape = [1] * blk.ndim
+        shape[axis] = blk.shape[axis]
+        j = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), axis)
+        return jnp.where(j % 2 == 0, 1.0, -1.0).astype(blk.dtype)
+
+    def _dst(blk):
+        # DST-II(x) = reverse(DCT-II(x * (-1)^j))  (ortho norm; verified
+        # against scipy.fft.dst) — jax.scipy has no native dst
+        return jnp.flip(
+            jsfft.dct(blk * _alt_signs(blk), axis=axis, norm="ortho"),
+            axis=axis)
+
+    def _idst(blk):
+        # inverse: IDST-II(y) = (-1)^j * IDCT-II(reverse(y))
+        out = jsfft.idct(jnp.flip(blk, axis=axis), axis=axis, norm="ortho")
+        return out * _alt_signs(out)
+
     ops = {
         "fft": lambda blk: jnp.fft.fft(blk, axis=axis),
         "ifft": lambda blk: jnp.fft.ifft(blk, axis=axis),
         "rfft": lambda blk: jnp.fft.rfft(blk, axis=axis),
         "irfft": lambda blk: jnp.fft.irfft(blk, n=n, axis=axis),
-        # R2R cosine transforms (PencilFFTs Transforms.R2R parity);
-        # DCT-II with ortho norm so idct is the exact inverse
+        # R2R transforms (PencilFFTs Transforms.R2R parity); ortho norm
+        # so the inverse kinds are exact inverses
         "dct": lambda blk: jsfft.dct(blk, axis=axis, norm="ortho"),
         "idct": lambda blk: jsfft.idct(blk, axis=axis, norm="ortho"),
+        "dst": _dst,
+        "idst": _idst,
     }
     op = ops[kind]
     if math.prod(pen.mesh.devices.shape) == 1:
@@ -89,12 +110,13 @@ class PencilFFTPlan:
                  real: bool = False, dtype=None, permute: bool = True,
                  transform: str = "fft",
                  method: AbstractTransposeMethod = AllToAll()):
-        if transform not in ("fft", "dct"):
-            raise ValueError(f"transform must be 'fft' or 'dct', got "
-                             f"{transform!r}")
+        if transform not in ("fft", "dct", "dst"):
+            raise ValueError(f"transform must be 'fft', 'dct' or 'dst', "
+                             f"got {transform!r}")
         self.transform = transform
-        if transform == "dct" and real:
-            raise ValueError("real=True is implicit for transform='dct'")
+        if transform in ("dct", "dst") and real:
+            raise ValueError(
+                f"real=True is implicit for transform={transform!r}")
         global_shape = tuple(int(n) for n in global_shape)
         N = len(global_shape)
         M = topology.ndims
@@ -107,14 +129,15 @@ class PencilFFTPlan:
         self.shape_physical = global_shape
         self.real = real
         if dtype is None:
-            dtype = (jnp.float32 if (real or transform == "dct")
+            dtype = (jnp.float32 if (real or transform in ("dct", "dst"))
                      else jnp.complex64)
         self.dtype_physical = jnp.dtype(dtype)
         if real and jnp.issubdtype(self.dtype_physical, jnp.complexfloating):
             raise ValueError("real=True requires a real input dtype")
-        if transform == "dct":
+        if transform in ("dct", "dst"):
             if jnp.issubdtype(self.dtype_physical, jnp.complexfloating):
-                raise ValueError("transform='dct' requires a real dtype")
+                raise ValueError(
+                    f"transform={transform!r} requires a real dtype")
             self.dtype_spectral = self.dtype_physical  # R2R: real throughout
         else:
             self.dtype_spectral = jnp.dtype(
@@ -224,7 +247,7 @@ class PencilFFTPlan:
         pen = self._pencils[0]
         axis = self._mem_axis(pen, 0)
         nd_extra = u.ndims_extra
-        fwd_kind = "dct" if self.transform == "dct" else "fft"
+        fwd_kind = self.transform
         if self.real:
             data = self._local_fft(pen, u.data, nd_extra, "rfft", axis)
             pen = self._pencil0_spec
@@ -250,7 +273,7 @@ class PencilFFTPlan:
             )
         N = len(self.shape_physical)
         nd_extra = uh.ndims_extra
-        inv_kind = "idct" if self.transform == "dct" else "ifft"
+        inv_kind = "i" + self.transform
         x = uh
         for d in range(N - 1, 0, -1):
             axis = self._mem_axis(x.pencil, d)
@@ -283,6 +306,9 @@ class PencilFFTPlan:
         n = self.shape_physical[d]
         if self.transform == "dct":
             return jnp.arange(n) / (2.0 * n * spacing)
+        if self.transform == "dst":
+            # DST-II mode j is sin(pi (j+1) (x+1/2)/n): angular pi(j+1)/n
+            return (jnp.arange(n) + 1.0) / (2.0 * n * spacing)
         if self.real and d == 0:
             return jnp.fft.rfftfreq(n, d=spacing)
         return jnp.fft.fftfreq(n, d=spacing)

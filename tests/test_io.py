@@ -281,6 +281,19 @@ def test_hdf5_bfloat16(tmp_path, topo):
 
 
 @pytest.mark.skipif(not has_orbax(), reason="orbax not installed")
+def test_orbax_async_write(tmp_path, pen, topo):
+    """Async checkpointing: write returns early, close() makes durable,
+    read after close is exact."""
+    u, x = make_data(pen, seed=11)
+    path = str(tmp_path / "actx")
+    with open_file(OrbaxDriver(async_write=True), path, write=True,
+                   create=True) as f:
+        f.write("u", x)  # returns before serialization completes
+    with open_file(OrbaxDriver(), path, read=True) as f:
+        np.testing.assert_array_equal(gather(f.read("u", pen)), u)
+
+
+@pytest.mark.skipif(not has_orbax(), reason="orbax not installed")
 def test_orbax_roundtrip(tmp_path, pen, topo):
     u, x = make_data(pen)
     path = str(tmp_path / "ckpt")
