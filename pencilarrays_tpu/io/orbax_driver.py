@@ -83,6 +83,10 @@ class OrbaxFile:
             self._ckpt = ocp.StandardCheckpointer()
         if write:
             os.makedirs(self.path, exist_ok=True)
+        # async mode: metadata is withheld until durability is confirmed,
+        # so a crashed/failed background save never leaves a meta file
+        # advertising a missing checkpoint
+        self._pending_meta = {}
         self._closed = False
 
     # each dataset is its own orbax checkpoint subdirectory + meta json
@@ -109,22 +113,22 @@ class OrbaxFile:
         # proceeds in background threads (call wait_until_finished/close
         # before reading back).
         self._ckpt.save(target, {"data": x.data})
-        if not self.async_write:
-            self._ckpt.wait_until_finished()
         meta = {
             "dtype": np.dtype(x.dtype).name,
             "dims_logical": list(x.pencil.size_global(LogicalOrder)),
             "dims_padded_memory": list(x.data.shape),
             "metadata": metadata(x),
         }
-        with open(self._meta_path(name), "w") as f:
-            json.dump(meta, f, indent=1)
+        if self.async_write:
+            self._pending_meta[name] = meta
+        else:
+            self._ckpt.wait_until_finished()
+            with open(self._meta_path(name), "w") as f:
+                json.dump(meta, f, indent=1)
 
     def read(self, name: str, pencil: Pencil,
              extra_dims: Optional[Tuple[int, ...]] = None) -> PencilArray:
-        import jax
-        import orbax.checkpoint as ocp
-
+        self.wait_until_finished()  # in-flight saves become durable first
         with open(self._meta_path(name)) as f:
             meta = json.load(f)
         dims = tuple(meta["dims_logical"])
@@ -138,8 +142,7 @@ class OrbaxFile:
         saved_perm = meta["metadata"]["permutation"]
         saved_pad = tuple(meta["dims_padded_memory"])
         self.wait_until_finished()
-        ckpt = ocp.StandardCheckpointer()
-        restored = ckpt.restore(
+        restored = self._ckpt.restore(
             os.fspath(self._item_dir(name)),
             {"data": np.empty(saved_pad, dtype=np.dtype(meta["dtype"]))},
         )["data"]
@@ -163,11 +166,16 @@ class OrbaxFile:
         )
 
     def wait_until_finished(self):
-        """Block until background serialization is durable."""
+        """Block until background serialization is durable, then publish
+        the withheld metadata of completed datasets."""
         self._ckpt.wait_until_finished()
+        for name, meta in self._pending_meta.items():
+            with open(self._meta_path(name), "w") as f:
+                json.dump(meta, f, indent=1)
+        self._pending_meta.clear()
 
     def close(self):
-        self._ckpt.wait_until_finished()
+        self.wait_until_finished()  # durability + publish withheld meta
         if hasattr(self._ckpt, "close"):
             self._ckpt.close()  # join the AsyncCheckpointer thread pool
         self._closed = True

@@ -94,12 +94,16 @@ class NavierStokesSpectral:
         return ks
 
     @functools.cached_property
-    def _operators(self):
-        return self._spectral_operators()
+    def _ks(self):
+        """Cached broadcast-shaped 1-D wavenumber components (cheap: O(n)
+        memory each).  The derived 3-D fields (k2, 1/k2, dealias mask) are
+        deliberately NOT cached: computed inside the traced step they are
+        fused into the elementwise kernels and never materialized — at
+        1024^3 a cached full-size k2/inv_k2/mask trio would pin ~GBs."""
+        return self._wavenumbers(self.plan.output_pencil)
 
     def _spectral_operators(self):
-        pen = self.plan.output_pencil
-        kx, ky, kz = self._wavenumbers(pen)
+        kx, ky, kz = self._ks
         k2 = kx * kx + ky * ky + kz * kz
         inv_k2 = 1.0 / jnp.where(k2 == 0, 1.0, k2)
         if self.dealias:
@@ -129,7 +133,7 @@ class NavierStokesSpectral:
 
     def _project(self, uh: PencilArray) -> PencilArray:
         """Leray projection: remove the compressible part."""
-        (kx, ky, kz), k2, inv_k2, _ = self._operators
+        (kx, ky, kz), k2, inv_k2, _ = self._spectral_operators()
         d = uh.data
         # P(u) = u - k (k.u) / |k|^2
         kdotu = kx * d[..., 0] + ky * d[..., 1] + kz * d[..., 2]
@@ -143,7 +147,7 @@ class NavierStokesSpectral:
     def _nonlinear(self, uh: PencilArray) -> PencilArray:
         """Rotational-form nonlinear term, dealiased, in spectral space:
         ``P [ F(u x omega) ]``."""
-        (kx, ky, kz), k2, inv_k2, mask = self._operators
+        (kx, ky, kz), k2, inv_k2, mask = self._spectral_operators()
         pen = uh.pencil
         d = uh.data
         # vorticity in spectral space: omega = i k x u
@@ -183,7 +187,7 @@ class NavierStokesSpectral:
         transform chain (8 all-to-alls total) — compiles to a single XLA
         program.
         """
-        (_, _, _), k2, _, _ = self._operators
+        (_, _, _), k2, _, _ = self._spectral_operators()
         e = jnp.exp(-self.nu * k2 * dt)[..., None]
         n1 = self._nonlinear(uh)
         u1 = PencilArray(uh.pencil, (uh.data + dt * n1.data) * e,

@@ -18,6 +18,7 @@ the analog of the reference's zero-allocation fused broadcast
 
 from __future__ import annotations
 
+import math
 from typing import Callable, Sequence, Tuple
 
 import jax
@@ -39,6 +40,12 @@ class LocalRectilinearGrid:
     Components are accessed as ``g[0]``/``g[1]``/... or ``g.x``/``g.y``/
     ``g.z``/``g.w`` (``rectilinear.jl:159-169``) and come back as
     broadcast-ready sharded arrays aligned with ``PencilArray.data``.
+
+    Protocol note (mirrors the reference): ``g[i]`` indexes COMPONENTS
+    (per-dimension coordinate arrays, like Julia ``g[Val(i)]``), while
+    iteration and ``len`` range over GRID POINTS (like Julia's grid
+    iteration).  ``__reversed__`` is provided so the mixed protocol does
+    not confuse Python's sequence fallback.
     """
 
     def __init__(self, pencil: Pencil, coords_global: Sequence):
@@ -123,6 +130,36 @@ class LocalRectilinearGrid:
         val = jax.lax.with_sharding_constraint(
             val, pen.sharding(len(extra_dims)))
         return PencilArray(pen, val, tuple(extra_dims))
+
+    def __len__(self) -> int:
+        return math.prod(self._pencil.size_global())
+
+    def __iter__(self):
+        """Host-side iteration over global grid points in MEMORY order,
+        yielding logical-order coordinate tuples — the reference's grid
+        iteration invariant (``rectilinear.jl:110-130``).  For compute,
+        use :meth:`evaluate`/:meth:`components`; this is for tests and
+        debug walks."""
+        from ..utils.permuted_indices import PermutedCartesianIndices
+
+        coords = [np.asarray(c) for c in self._coords]
+        for idx in PermutedCartesianIndices(self._pencil.size_global(),
+                                            self._pencil.permutation):
+            yield tuple(coords[d][i] for d, i in enumerate(idx))
+
+    def __reversed__(self):
+        return reversed(list(self))
+
+    def meshgrid(self):
+        """Dense sharded coordinate arrays (one full-size array per dim,
+        broadcast from the components) — ``jnp.meshgrid`` parity for code
+        that wants explicit coordinate fields."""
+        target = self._pencil.padded_size_global(MemoryOrder)
+        return tuple(
+            jax.lax.with_sharding_constraint(
+                jnp.broadcast_to(self[d], target), self._pencil.sharding())
+            for d in range(self.ndims)
+        )
 
     def __repr__(self) -> str:
         return (

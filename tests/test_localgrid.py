@@ -93,6 +93,28 @@ def test_evaluate_extra_dims(topo):
         np.testing.assert_allclose(got[..., c], expect)
 
 
+def test_grid_iteration_and_meshgrid(topo):
+    """Iteration yields coordinate tuples in memory order (reference
+    ``rectilinear.jl:110-130``); meshgrid gives dense coordinate fields."""
+    shape = (3, 4, 2)
+    pen = Pencil(topo, shape, (1, 2), permutation=Permutation(2, 0, 1))
+    xs = [np.arange(n, dtype=float) * (d + 1) for d, n in enumerate(shape)]
+    g = localgrid(pen, xs)
+    pts = list(g)
+    assert len(pts) == len(g) == 24
+    # every grid point exactly once, each a logical coordinate tuple
+    expect = {(xs[0][i], xs[1][j], xs[2][k])
+              for i in range(3) for j in range(4) for k in range(2)}
+    assert set(pts) == expect
+    # memory order (2,0,1): dim 1 is last in memory -> fastest
+    assert pts[0][1] == 0.0 and pts[1][1] == 2.0
+    # meshgrid fields agree with evaluate of identity components
+    mx, my, mz = g.meshgrid()
+    got = gather(PencilArray(pen, mx))
+    np.testing.assert_array_equal(got, np.broadcast_to(
+        xs[0][:, None, None], shape))
+
+
 def test_validation(topo):
     pen = Pencil(topo, (8, 10, 12), (1, 2))
     with pytest.raises(ValueError):
