@@ -107,6 +107,11 @@ class OrbaxFile:
         if os.path.exists(target):
             import shutil
             shutil.rmtree(target)
+        # the old published metadata must not outlive the data it described
+        # (a crash mid-save would otherwise leave meta advertising a
+        # missing checkpoint)
+        if os.path.exists(self._meta_path(name)):
+            os.unlink(self._meta_path(name))
         # Store the padded sharded array directly (device->storage, no host
         # replica); true shape travels in the metadata.  With async_write,
         # save() returns once devices are snapshotted and serialization
@@ -141,7 +146,6 @@ class OrbaxFile:
             extra_dims = tuple(meta["metadata"]["extra_dims"])
         saved_perm = meta["metadata"]["permutation"]
         saved_pad = tuple(meta["dims_padded_memory"])
-        self.wait_until_finished()
         restored = self._ckpt.restore(
             os.fspath(self._item_dir(name)),
             {"data": np.empty(saved_pad, dtype=np.dtype(meta["dtype"]))},
@@ -167,8 +171,15 @@ class OrbaxFile:
 
     def wait_until_finished(self):
         """Block until background serialization is durable, then publish
-        the withheld metadata of completed datasets."""
-        self._ckpt.wait_until_finished()
+        the withheld metadata of completed datasets.  If the background
+        save failed (wait re-raises), the pending entries are dropped so a
+        later wait/close cannot publish metadata for data that never
+        became durable."""
+        try:
+            self._ckpt.wait_until_finished()
+        except Exception:
+            self._pending_meta.clear()
+            raise
         for name, meta in self._pending_meta.items():
             with open(self._meta_path(name), "w") as f:
                 json.dump(meta, f, indent=1)

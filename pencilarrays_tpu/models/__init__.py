@@ -1,7 +1,9 @@
 from .spectral import NavierStokesSpectral, taylor_green
+from .diffusion import DiffusionSpectral
 from .ode import integrate, rk23_step
 
 __all__ = [
+    "DiffusionSpectral",
     "NavierStokesSpectral",
     "taylor_green",
     "integrate",

@@ -65,33 +65,6 @@ class NavierStokesSpectral:
                                   dtype=dtype)
         self.dealias = dealias
 
-    # -- wavenumbers ------------------------------------------------------
-    def _wavenumbers(self, pen: Pencil):
-        """Angular wavenumber component arrays, broadcast-shaped in the
-        pencil's memory order and sharded along its axes (the spectral
-        analog of localgrid components)."""
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        N = 3
-        ks = []
-        mem_ids = pen.permutation.apply(tuple(range(N)))
-        for d in range(N):
-            n = self.shape[d]
-            # box [0, 2pi): integer wavenumbers j = n * fftfreq(n)
-            k = self.plan.frequencies(d) * n
-            n_pad = pen.padded_global_shape[d]
-            if n_pad != k.shape[0]:
-                k = jnp.pad(k, (0, n_pad - k.shape[0]))
-            pos = mem_ids.index(d)
-            shape = [1] * N
-            shape[pos] = n_pad
-            k = k.reshape(shape)
-            spec = [None] * N
-            spec[pos] = pen.decomp_axis_name(d)
-            k = jax.lax.with_sharding_constraint(
-                k, NamedSharding(pen.mesh, PartitionSpec(*spec)))
-            ks.append(k)
-        return ks
 
     @functools.cached_property
     def _ks(self):
@@ -100,7 +73,7 @@ class NavierStokesSpectral:
         deliberately NOT cached: computed inside the traced step they are
         fused into the elementwise kernels and never materialized — at
         1024^3 a cached full-size k2/inv_k2/mask trio would pin ~GBs."""
-        return self._wavenumbers(self.plan.output_pencil)
+        return self.plan.wavenumbers()
 
     def _spectral_operators(self):
         kx, ky, kz = self._ks

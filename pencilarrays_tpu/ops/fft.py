@@ -313,6 +313,34 @@ class PencilFFTPlan:
             return jnp.fft.rfftfreq(n, d=spacing)
         return jnp.fft.fftfreq(n, d=spacing)
 
+    def wavenumbers(self, *, spacing: float = 1.0):
+        """Broadcast-shaped, sharded integer-mode wavenumber components of
+        the OUTPUT pencil — one array per logical dim, non-singleton only
+        at the dim's memory position, sharded along its mesh axis.  The
+        spectral analog of localgrid components; shared by the spectral
+        models."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        pen = self.output_pencil
+        N = pen.ndims
+        mem_ids = pen.permutation.apply(tuple(range(N)))
+        ks = []
+        for d in range(N):
+            k = self.frequencies(d, spacing=spacing) * self.shape_physical[d]
+            n_pad = pen.padded_global_shape[d]
+            if n_pad != k.shape[0]:
+                k = jnp.pad(k, (0, n_pad - k.shape[0]))
+            pos = mem_ids.index(d)
+            shape = [1] * N
+            shape[pos] = n_pad
+            k = k.reshape(shape)
+            spec = [None] * N
+            spec[pos] = pen.decomp_axis_name(d)
+            k = jax.lax.with_sharding_constraint(
+                k, NamedSharding(pen.mesh, PartitionSpec(*spec)))
+            ks.append(k)
+        return tuple(ks)
+
     def __repr__(self) -> str:
         kind = self.transform if self.transform != "fft" else (
             "rfft" if self.real else "fft")
