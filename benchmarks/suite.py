@@ -58,30 +58,37 @@ def main():
         (1,), devices=devs)
     from pencilarrays_tpu import Permutation
 
-    # Permuted layouts so the single-device path measures the real local
-    # permute copies (the reference's copy_permuted! on 1 rank), and the
-    # multi-device path measures all_to_all + permute.
-    p_x, p_y, p_z = Permutation(1, 2, 0), Permutation(2, 0, 1), None
-    if len(dims) == 1:
-        pen_x = Pencil(topo, (n, n, n), (1,), permutation=p_x)
-        pen_y = Pencil(topo, (n, n, n), (0,), permutation=p_y)
-        pen_z = Pencil(topo, (n, n, n), (2,), permutation=p_z)
+    nbytes = n ** 3 * 4
+    if len(devs) == 1:
+        # A closed transpose cycle is a net identity and XLA's algebraic
+        # simplifier cancels transpose pairs THROUGH elementwise ops (no
+        # perturbation survives), yielding impossible multi-TB/s readings.
+        # On one device a hop is a local permute, so measure a single
+        # permute per iteration: the (2,0,1) cube permutation has period
+        # 3 and cannot cancel within one loop body.
+        xp1 = jnp.zeros((n, n, n), jnp.float32)
+        dt = _timeit(
+            lambda a: jnp.transpose(a, (2, 0, 1)) + a.ravel()[0] * 1e-30,
+            xp1, k0=10, k1=110)
     else:
+        # multi-device: permuted layouts so each hop is all_to_all +
+        # permute; the exchange is explicit collectives under shard_map,
+        # which the simplifier does not cancel
+        p_x, p_y, p_z = Permutation(1, 2, 0), Permutation(2, 0, 1), None
         pen_x = Pencil(topo, (n, n, n), (1, 2), permutation=p_x)
         pen_y = Pencil(topo, (n, n, n), (0, 2), permutation=p_y)
         pen_z = Pencil(topo, (n, n, n), (0, 1), permutation=p_z)
-    x = PencilArray.zeros(pen_x, dtype=jnp.float32)
+        x = PencilArray.zeros(pen_x, dtype=jnp.float32)
 
-    def cycle(d):
-        a = PencilArray(pen_x, d)
-        b = transpose(a, pen_y)
-        c = transpose(b, pen_z)
-        cc = transpose(c, pen_y)
-        aa = transpose(cc, pen_x)
-        return aa.data
+        def cycle(d):
+            a = PencilArray(pen_x, d + d.ravel()[0] * 1e-30)
+            b = transpose(a, pen_y)
+            c = transpose(b, pen_z)
+            cc = transpose(c, pen_y)
+            aa = transpose(cc, pen_x)
+            return aa.data
 
-    dt = _timeit(cycle, x.data) / 4  # per transpose hop
-    nbytes = n ** 3 * 4
+        dt = _timeit(cycle, x.data, k0=5, k1=45) / 4  # per transpose hop
     results["transpose_hop_256"] = {
         "seconds": dt,
         "gb_per_s_per_chip": nbytes * 2 / dt / 1e9 / len(devs),
@@ -95,7 +102,7 @@ def main():
         a = PencilArray(plan.input_pencil, d)
         return plan.backward(plan.forward(a)).data
 
-    dt = _timeit(fft_roundtrip, u.data, k0=1, k1=4)
+    dt = _timeit(fft_roundtrip, u.data, k0=2, k1=42)
     # 2 transforms x 5 N^3 log2(N^3) real flops (rough FFT flop model)
     flops = 2 * 5 * n ** 3 * np.log2(float(n) ** 3)
     results["fft_r2c_roundtrip_256"] = {
@@ -110,7 +117,7 @@ def main():
     def step(d):
         return model.step(PencilArray(uh.pencil, d, (3,)), 1e-3).data
 
-    dt = _timeit(step, uh.data, k0=1, k1=9)
+    dt = _timeit(step, uh.data, k0=2, k1=42)
     results["navier_stokes_step_128"] = {"seconds": dt,
                                          "steps_per_s": 1.0 / dt}
 
@@ -122,10 +129,12 @@ def main():
     if (len(devs) == 1 and devs[0].platform == "tpu"
             and pk.supported((n_p,) * 3, (2, 0, 1), jnp.float32)):
         xp = jnp.zeros((n_p,) * 3, jnp.float32)
-        t_pal = _timeit(lambda a: pk.pallas_permute(a, (2, 0, 1)), xp,
-                        k0=2, k1=12)
-        t_xla = _timeit(lambda a: jnp.transpose(a, (2, 0, 1)) + 0.0, xp,
-                        k0=2, k1=12)
+        t_pal = _timeit(
+            lambda a: pk.pallas_permute(a, (2, 0, 1)) + a.ravel()[0] * 1e-30,
+            xp, k0=10, k1=510)
+        t_xla = _timeit(
+            lambda a: jnp.transpose(a, (2, 0, 1)) + a.ravel()[0] * 1e-30,
+            xp, k0=10, k1=510)
         nb = xp.size * 4 * 2
         results["pallas_permute_256"] = {
             "pallas_gb_per_s": nb / t_pal / 1e9,

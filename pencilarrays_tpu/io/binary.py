@@ -125,13 +125,22 @@ def _assemble_sharded(pencil: Pencil, extra_dims: Tuple[int, ...], dtype,
 @dataclass(frozen=True)
 class BinaryDriver(ParallelIODriver):
     """Reference ``MPIIODriver(; sequential=..., uniquify_names=...)``
-    analog (``mpi_io.jl:23-27``)."""
+    analog (``mpi_io.jl:23-27``).
+
+    ``uniquify_names=True`` appends ``(n)`` to dataset names that already
+    exist instead of replacing them (the reference's behavior of the same
+    flag); ``sequential`` has no analog — block writes are already
+    independent positioned writes with no rank ordering to serialize.
+    """
+
+    uniquify_names: bool = False
 
     def open(self, filename: str, *, write: bool = False, read: bool = False,
              create: bool = False, append: bool = False,
              truncate: bool = False) -> "BinaryFile":
         return BinaryFile(filename, write=write, read=read, create=create,
-                          append=append, truncate=truncate)
+                          append=append, truncate=truncate,
+                          uniquify_names=self.uniquify_names)
 
 
 class BinaryFile:
@@ -139,7 +148,9 @@ class BinaryFile:
     ``mpi_io.jl:41-76``)."""
 
     def __init__(self, filename: str, *, write=False, read=False,
-                 create=False, append=False, truncate=False):
+                 create=False, append=False, truncate=False,
+                 uniquify_names=False):
+        self.uniquify_names = uniquify_names
         self.filename = filename
         self.meta_filename = filename + ".json"
         self.writable = write or append or create or truncate
@@ -241,6 +252,12 @@ class BinaryFile:
             raise PermissionError("file not opened for writing")
         from ..utils.timers import timeit
 
+        if self.uniquify_names:
+            base, n = name, 1
+            existing = {d["name"] for d in self._meta["datasets"]}
+            while name in existing:
+                n += 1
+                name = f"{base}({n})"
         with timeit(x.pencil.timer, "write parallel"):
             self._write_dataset(name, x, chunks)
 

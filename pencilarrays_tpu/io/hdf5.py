@@ -44,7 +44,14 @@ def has_hdf5() -> bool:
 
 @dataclass(frozen=True)
 class HDF5Driver(ParallelIODriver):
-    """Reference ``PHDF5Driver`` analog (``hdf5.jl:16-25``)."""
+    """Reference ``PHDF5Driver`` analog (``hdf5.jl:16-25``).
+
+    ``chunks=True`` stores datasets chunked by the writing pencil's local
+    block shape — the analog of the reference's per-rank chunking option
+    (``ext/PencilArraysHDF5Ext.jl:238-253``).
+    """
+
+    chunks: bool = False
 
     def open(self, filename: str, *, write: bool = False, read: bool = False,
              create: bool = False, append: bool = False,
@@ -55,13 +62,15 @@ class HDF5Driver(ParallelIODriver):
             mode = "a"
         else:
             mode = "r"
-        return HDF5File(filename, mode)
+        return HDF5File(filename, mode, chunks=self.chunks)
 
 
 class HDF5File:
     """An open HDF5 container of PencilArray datasets."""
 
-    def __init__(self, filename: str, mode: str = "r"):
+    def __init__(self, filename: str, mode: str = "r", *,
+                 chunks: bool = False):
+        self.chunks = chunks
         if not has_hdf5():
             raise RuntimeError(
                 "h5py is not available; use BinaryDriver or OrbaxDriver "
@@ -124,13 +133,23 @@ class HDF5File:
             # reuse the dataset in place when compatible: HDF5 never
             # reclaims deleted-dataset space, so del+create would leak a
             # full dataset per checkpoint rewrite
+            chunk_shape = None
+            if self.chunks:
+                # chunk by the local block shape, clipped to the dataset
+                # (reference Allreduce-min chunk dims, ext:238-253)
+                chunk_shape = tuple(
+                    min(c, s) for c, s in zip(
+                        pen.size_local((0,) * pen.topology.ndims)
+                        + x.extra_dims, shape))
             dset = self._f.get(name)
             if (dset is None or tuple(dset.shape) != shape
-                    or dset.dtype != store_dt):
+                    or dset.dtype != store_dt
+                    or dset.chunks != chunk_shape):
                 if dset is not None:
                     del self._f[name]
                 dset = self._f.create_dataset(name, shape=shape,
-                                              dtype=store_dt)
+                                              dtype=store_dt,
+                                              chunks=chunk_shape)
             for start, block in iter_local_blocks(x):
                 if marker:
                     block = block.view(store_dt)

@@ -102,8 +102,9 @@ class OrbaxFile:
         item = self._item_dir(name)
         target = os.fspath(item)
         # a previous async save to this target may still be committing:
-        # drain before touching the directory
-        self._ckpt.wait_until_finished()
+        # drain before touching the directory (through the guarded wrapper
+        # so a failed save also drops its withheld metadata)
+        self.wait_until_finished()
         if os.path.exists(target):
             import shutil
             shutil.rmtree(target)

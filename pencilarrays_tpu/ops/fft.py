@@ -313,12 +313,14 @@ class PencilFFTPlan:
             return jnp.fft.rfftfreq(n, d=spacing)
         return jnp.fft.fftfreq(n, d=spacing)
 
-    def wavenumbers(self, *, spacing: float = 1.0):
-        """Broadcast-shaped, sharded integer-mode wavenumber components of
-        the OUTPUT pencil — one array per logical dim, non-singleton only
-        at the dim's memory position, sharded along its mesh axis.  The
-        spectral analog of localgrid components; shared by the spectral
-        models."""
+    def wavenumbers(self):
+        """Broadcast-shaped, sharded mode-number components of the OUTPUT
+        pencil — one array per logical dim, non-singleton only at the
+        dim's memory position, sharded along its mesh axis.  Values are
+        ``frequencies(d) * n_d``: integer Fourier modes for fft/rfft
+        plans; half-integer (j/2) / ((j+1)/2) mode numbers for dct/dst.
+        The spectral analog of localgrid components; shared by the
+        spectral models."""
         from jax.sharding import NamedSharding, PartitionSpec
 
         pen = self.output_pencil
@@ -326,7 +328,7 @@ class PencilFFTPlan:
         mem_ids = pen.permutation.apply(tuple(range(N)))
         ks = []
         for d in range(N):
-            k = self.frequencies(d, spacing=spacing) * self.shape_physical[d]
+            k = self.frequencies(d) * self.shape_physical[d]
             n_pad = pen.padded_global_shape[d]
             if n_pad != k.shape[0]:
                 k = jnp.pad(k, (0, n_pad - k.shape[0]))

@@ -177,6 +177,41 @@ def test_read_validation(tmp_path, pen, topo):
             f.write("w", x)
 
 
+def test_uniquify_names(tmp_path, pen):
+    """BinaryDriver(uniquify_names=True): repeat names get suffixes
+    instead of replacement (``mpi_io.jl:23-27`` option parity)."""
+    u, x = make_data(pen, seed=1)
+    v, y = make_data(pen, seed=2)
+    path = str(tmp_path / "uq.bin")
+    drv = BinaryDriver(uniquify_names=True)
+    with open_file(drv, path, write=True, create=True) as f:
+        f.write("u", x)
+        f.write("u", y)
+    with open_file(BinaryDriver(), path, read=True) as f:
+        assert {d["name"] for d in f.datasets} == {"u", "u(2)"}
+        np.testing.assert_array_equal(gather(f.read("u", pen)), u)
+        np.testing.assert_array_equal(gather(f.read("u(2)", pen)), v)
+
+
+def test_hdf5_chunked_option(tmp_path, pen):
+    from pencilarrays_tpu.io import HDF5Driver, has_hdf5
+
+    if not has_hdf5():
+        pytest.skip("h5py unavailable")
+    import h5py
+
+    u, x = make_data(pen)
+    path = str(tmp_path / "ck.h5")
+    with open_file(HDF5Driver(chunks=True), path, write=True,
+                   create=True) as f:
+        f.write("u", x)
+    with h5py.File(path, "r") as h:
+        assert h["u"].chunks is not None  # chunked storage
+        np.testing.assert_array_equal(h["u"][...], u)
+    with open_file(HDF5Driver(), path, read=True) as f:
+        np.testing.assert_array_equal(gather(f.read("u", pen)), u)
+
+
 def test_native_strided_io_direct(tmp_path):
     """Unit test of the C++ scatter/gather against numpy ground truth."""
     from pencilarrays_tpu.io import native
