@@ -56,5 +56,27 @@ from . import ops  # noqa: F401
 from . import io  # noqa: F401
 from .parallel import distributed  # noqa: F401
 from .ops.fft import PencilFFTPlan  # noqa: F401
+from .compat import (  # noqa: F401
+    GlobalPencilArray,
+    PencilArrayCollection,
+    MPITopology,
+    decomposition,
+    extra_dims,
+    get_comm,
+    length_global,
+    length_local,
+    ndims_extra,
+    ndims_space,
+    pencil,
+    permutation,
+    range_local,
+    range_remote,
+    size_global,
+    size_local,
+    sizeof_global,
+    timer,
+    to_local,
+    topology,
+)
 
 __version__ = "0.1.0"

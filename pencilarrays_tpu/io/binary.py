@@ -124,13 +124,13 @@ def _assemble_sharded(pencil: Pencil, extra_dims: Tuple[int, ...], dtype,
 
 @dataclass(frozen=True)
 class BinaryDriver(ParallelIODriver):
-    """Reference ``MPIIODriver(; sequential=..., uniquify_names=...)``
-    analog (``mpi_io.jl:23-27``).
+    """Reference ``MPIIODriver`` analog (``mpi_io.jl:23-27``).
 
-    ``uniquify_names=True`` appends ``(n)`` to dataset names that already
-    exist instead of replacing them (the reference's behavior of the same
-    flag); ``sequential`` has no analog — block writes are already
-    independent positioned writes with no rank ordering to serialize.
+    The reference's ``sequential``/``uniqueopen`` options are MPI-IO
+    open-mode hints with no analog here (block writes are independent
+    positioned writes).  ``uniquify_names=True`` is a convenience beyond
+    the reference: repeated dataset names get ``(n)`` suffixes instead of
+    replacing the existing dataset.
     """
 
     uniquify_names: bool = False

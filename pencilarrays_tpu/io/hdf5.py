@@ -135,12 +135,22 @@ class HDF5File:
             # full dataset per checkpoint rewrite
             chunk_shape = None
             if self.chunks:
-                # chunk by the local block shape, clipped to the dataset
-                # (reference Allreduce-min chunk dims, ext:238-253)
+                # chunk by the MINIMUM nonempty block extent per dim, like
+                # the reference's Allreduce-min chunk dims (ext:238-253) —
+                # under uneven decompositions the first block is the
+                # largest, not the smallest
+                from ..parallel.pencil import local_data_range
+
+                mins = []
+                for d, nd in enumerate(pen.size_global(LogicalOrder)):
+                    P = pen.proc_count(d)
+                    lens = [len(local_data_range(p, P, nd))
+                            for p in range(P)]
+                    lens = [l for l in lens if l > 0] or [1]
+                    mins.append(min(lens))
                 chunk_shape = tuple(
                     min(c, s) for c, s in zip(
-                        pen.size_local((0,) * pen.topology.ndims)
-                        + x.extra_dims, shape))
+                        tuple(mins) + x.extra_dims, shape))
             dset = self._f.get(name)
             if (dset is None or tuple(dset.shape) != shape
                     or dset.dtype != store_dt

@@ -1,0 +1,60 @@
+"""Free-function API parity with the reference export list
+(``src/PencilArrays.jl:35-39``, ``src/Pencils/Pencils.jl:13-20``)."""
+
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+
+
+@pytest.fixture
+def setup(devices):
+    topo = pa.Topology((2, 4))
+    pen = pa.Pencil(topo, (12, 10, 8), (1, 2),
+                    permutation=pa.Permutation(2, 0, 1),
+                    timer=pa.TimerOutput("t"))
+    u = np.random.default_rng(0).standard_normal((12, 10, 8, 3))
+    x = pa.PencilArray.from_global(pen, u)
+    return topo, pen, x, u
+
+
+def test_every_reference_export_exists():
+    # src/PencilArrays.jl:35-39 + src/Pencils/Pencils.jl:13-20
+    for name in [
+        "PencilArray", "GlobalPencilArray", "PencilArrayCollection",
+        "ManyPencilArray",
+        "pencil", "permutation", "gather", "global_view",
+        "ndims_extra", "ndims_space", "extra_dims", "sizeof_global",
+        "Pencil", "MPITopology", "Permutation", "NoPermutation",
+        "MemoryOrder", "LogicalOrder", "decomposition",
+        "get_comm", "timer", "topology",
+        "range_local", "range_remote", "size_local", "size_global",
+        "to_local", "length_local", "length_global",
+    ]:
+        assert hasattr(pa, name), f"missing export: {name}"
+
+
+def test_free_functions_dispatch(setup):
+    topo, pen, x, u = setup
+    assert pa.pencil(x) is pen
+    assert pa.permutation(x) == pa.Permutation(2, 0, 1)
+    assert pa.permutation(pen) == pa.Permutation(2, 0, 1)
+    assert pa.decomposition(x) == (1, 2)
+    assert pa.topology(pen) is topo
+    assert pa.get_comm(topo) is topo.mesh
+    assert pa.get_comm(x) is topo.mesh
+    assert pa.timer(x) is pen.timer
+    assert pa.extra_dims(x) == (3,)
+    assert pa.ndims_extra(x) == 1
+    assert pa.ndims_space(x) == 3
+    assert pa.sizeof_global(x) == 12 * 10 * 8 * 3 * 8
+    assert pa.range_local(x)[0] == range(0, 12)
+    assert pa.range_remote(pen, 7)[2] == range(6, 8)
+    assert pa.size_local(pen, (1, 3)) == (12, 5, 2)
+    assert pa.size_global(x) == (12, 10, 8, 3)
+    assert pa.size_global(pen, pa.MemoryOrder) == (8, 12, 10)
+    assert pa.length_local(pen) == 12 * 5 * 2
+    assert pa.length_global(pen) == 960
+    assert pa.to_local(pen, (5, 6, 7), (1, 3)) == (5, 1, 1)
+    assert pa.MPITopology is pa.Topology
+    assert pa.GlobalPencilArray is pa.PencilArray

@@ -179,7 +179,7 @@ def test_read_validation(tmp_path, pen, topo):
 
 def test_uniquify_names(tmp_path, pen):
     """BinaryDriver(uniquify_names=True): repeat names get suffixes
-    instead of replacement (``mpi_io.jl:23-27`` option parity)."""
+    instead of replacement (convenience beyond the reference driver)."""
     u, x = make_data(pen, seed=1)
     v, y = make_data(pen, seed=2)
     path = str(tmp_path / "uq.bin")
