@@ -34,6 +34,9 @@ from .utils.permuted_indices import (  # noqa: F401
 )
 from .parallel import (  # noqa: F401
     AllToAll,
+    Alltoallv,
+    PointToPoint,
+    Ring,
     Gspmd,
     IndexOrder,
     LogicalOrder,

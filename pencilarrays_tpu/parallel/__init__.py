@@ -11,6 +11,9 @@ from .pencil import (
 from .arrays import PencilArray, global_view
 from .transpositions import (
     AllToAll,
+    Alltoallv,
+    PointToPoint,
+    Ring,
     Gspmd,
     Transposition,
     assert_compatible,
@@ -23,6 +26,9 @@ from . import distributed
 
 __all__ = [
     "ManyPencilArray",
+    "Alltoallv",
+    "PointToPoint",
+    "Ring",
     "distributed",
     "PencilArray",
     "global_view",

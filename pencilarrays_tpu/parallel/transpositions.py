@@ -25,17 +25,19 @@ a traced function XLA compiles onto the ICI fabric:
   collective is async at dispatch and the compiler interleaves it with
   independent compute — by design there is no user-visible wait handle.
 
-Two methods (reference ``Transpositions.jl:17-24``):
+Three methods (reference ``Transpositions.jl:17-24``):
 
 * :class:`AllToAll` (default) — explicit ``shard_map`` + ``lax.all_to_all``
   on the differing axis.  Deterministic collective choice; the analog of
   ``Alltoallv()``.  Restricted, like the reference, to configurations
   whose decompositions differ in at most one slot (``:182-199``).
+* :class:`Ring` (alias ``PointToPoint``) — P-1 staged ``ppermute``
+  rounds, one peer tile each: the reference's nonblocking per-peer
+  pipeline, re-expressed for the compiler's scheduler.
 * :class:`Gspmd` — express only the *layout change* and let the GSPMD
-  partitioner insert collectives (``with_sharding_constraint``).  The
-  analog of leaving scheduling to the runtime (``PointToPoint()``'s
-  spirit); also powers the unrestricted :func:`reshard`, which can change
-  any number of decomposed dims at once (beyond reference capability).
+  partitioner insert collectives (``with_sharding_constraint``); also
+  powers the unrestricted :func:`reshard`, which can change any number
+  of decomposed dims at once (beyond reference capability).
 """
 
 from __future__ import annotations
@@ -70,7 +72,10 @@ def _maybe_pallas_transpose(a, axes, platform: str):
 
 __all__ = [
     "AllToAll",
+    "Alltoallv",
     "Gspmd",
+    "PointToPoint",
+    "Ring",
     "Transposition",
     "transpose",
     "reshard",
@@ -90,6 +95,23 @@ class AllToAll(AbstractTransposeMethod):
 @dataclass(frozen=True)
 class Gspmd(AbstractTransposeMethod):
     """Compiler-scheduled resharding via ``with_sharding_constraint``."""
+
+
+@dataclass(frozen=True)
+class Ring(AbstractTransposeMethod):
+    """Staged peer-to-peer exchange: P-1 ``lax.ppermute`` rounds, each
+    moving one peer's tile — the reference's ``PointToPoint()`` flavor
+    (nonblocking per-peer sends with unpack-as-they-arrive,
+    ``Transpositions.jl:61-65, 510-516``), re-expressed so XLA's
+    latency-hiding scheduler can overlap rounds with the unpack placement.
+    Data movement is bit-identical to :class:`AllToAll`; which is faster
+    is a hardware/topology question (P-1 shifted ppermute rounds the
+    fabric routes over up to r hops each, vs one fused collective)."""
+
+
+# reference method-name aliases (Transpositions.jl:17-24)
+PointToPoint = Ring
+Alltoallv = AllToAll
 
 
 def assert_compatible(pin: Pencil, pout: Pencil) -> Optional[int]:
@@ -124,11 +146,12 @@ def assert_compatible(pin: Pencil, pout: Pencil) -> Optional[int]:
 # explicit all-to-all path
 # ---------------------------------------------------------------------------
 
-def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
-                          extra_ndims: int):
-    """Exchange on topology axis ``R``: logical dim ``a = pin.decomposition[R]``
-    becomes local, logical dim ``b = pout.decomposition[R]`` becomes
-    decomposed.  ``data`` is the memory-order padded global array."""
+
+def _exchange_transpose(data, pin: Pencil, pout: Pencil, R: int,
+                        extra_ndims: int, exchange_factory):
+    """Shared pack -> exchange -> unpack structure for the explicit
+    single-axis methods.  ``exchange_factory(axis, P, a, b)`` returns the
+    function applied to the packed logical-order padded block."""
     mesh = pin.mesh
     axis = pin.topology.axis_names[R]
     P = pin.topology.dims[R]
@@ -140,10 +163,10 @@ def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
 
     in_spec = pin.partition_spec(extra_ndims)
     out_spec = pout.partition_spec(extra_ndims)
-
     inv_in = _inv_axes(pin, extra_ndims)     # memory -> logical
     fwd_out = _fwd_axes(pout, extra_ndims)   # logical -> memory
     platform = mesh.devices.flat[0].platform
+    exchange = exchange_factory(axis, P, a, b)
 
     def local_fn(block):
         # Phase labels mirror the reference's timer sections
@@ -157,11 +180,7 @@ def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
                 pad[b] = (0, b_pad - n_b)
                 x = jnp.pad(x, pad)
         with jax.named_scope("exchange"):
-            # The exchange: split dim b into P tiles, concat received tiles
-            # along dim a.  This is the reference's entire
-            # pack -> Alltoallv -> unpack pipeline in one op.
-            x = jax.lax.all_to_all(x, axis, split_axis=b, concat_axis=a,
-                                   tiled=True)
+            x = exchange(x)
         with jax.named_scope("unpack_data"):
             # Dim a is now fully local with padded extent; drop tail padding.
             if x.shape[a] != n_a:
@@ -178,6 +197,18 @@ def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
                        out_specs=out_spec,
                        check_vma=not pallas_enabled())
     return fn(data)
+
+
+def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
+                          extra_ndims: int):
+    """Exchange on topology axis ``R``: one ``lax.all_to_all`` — the
+    reference's entire pack -> Alltoallv -> unpack pipeline in one op
+    (split dim b into P tiles, concat received tiles along dim a)."""
+    def factory(axis, P, a, b):
+        return lambda x: jax.lax.all_to_all(
+            x, axis, split_axis=b, concat_axis=a, tiled=True)
+
+    return _exchange_transpose(data, pin, pout, R, extra_ndims, factory)
 
 
 def _transpose_local(data, pin: Pencil, pout: Pencil, extra_ndims: int):
@@ -208,6 +239,49 @@ def _transpose_local(data, pin: Pencil, pout: Pencil, extra_ndims: int):
         return fn(data)
     out = jnp.transpose(data, axes)
     return jax.lax.with_sharding_constraint(out, pout.sharding(extra_ndims))
+
+
+def _transpose_ring(data, pin: Pencil, pout: Pencil, R: int,
+                    extra_ndims: int):
+    """Like :func:`_transpose_all_to_all`, but the exchange is P-1 shifted
+    ``ppermute`` rounds of single tiles."""
+    def factory(axis, P, a, b):
+        def exchange(x):
+            chunk = x.shape[b] // P
+            tiles = jnp.stack(
+                [jax.lax.slice_in_dim(x, j * chunk, (j + 1) * chunk, axis=b)
+                 for j in range(P)], axis=0)
+            me = jax.lax.axis_index(axis).astype(jnp.int32)
+            # received[s] must hold sender s's tile for me; my own tile
+            # seeds the buffer, round r delivers sender (me - r)'s
+            received = jnp.zeros_like(tiles)
+            own = jax.lax.dynamic_index_in_dim(tiles, me, axis=0)
+            received = jax.lax.dynamic_update_index_in_dim(
+                received, own, me, axis=0)
+            # one round per shift r (unrolled: each round's ppermute has a
+            # distinct static permutation; P-1 rounds total)
+            for r in range(1, P):
+                # every device sends tile[(me + r) % P] to peer (me + r)
+                send = jax.lax.dynamic_index_in_dim(
+                    tiles, jax.lax.rem(me + jnp.int32(r), jnp.int32(P)),
+                    axis=0)
+                moved = jax.lax.ppermute(
+                    send, axis, [(i, (i + r) % P) for i in range(P)])
+                # moved holds sender (me - r)'s tile for me
+                src = jax.lax.rem(me - jnp.int32(r) + jnp.int32(P),
+                                  jnp.int32(P))
+                received = jax.lax.dynamic_update_index_in_dim(
+                    received, moved, src, axis=0)
+            # merge the sender axis into dim a (sender order = global
+            # padded order, as with tiled all_to_all)
+            out = jnp.moveaxis(received, 0, a)
+            shape = list(out.shape)
+            shape[a:a + 2] = [shape[a] * shape[a + 1]]
+            return out.reshape(shape)
+
+        return exchange
+
+    return _exchange_transpose(data, pin, pout, R, extra_ndims, factory)
 
 
 # ---------------------------------------------------------------------------
@@ -258,6 +332,8 @@ def _compiled_transpose(pin: Pencil, pout: Pencil, R: Optional[int],
         fn = lambda data: _transpose_local(data, pin, pout, extra_ndims)
     elif isinstance(method, AllToAll):
         fn = lambda data: _transpose_all_to_all(data, pin, pout, R, extra_ndims)
+    elif isinstance(method, Ring):
+        fn = lambda data: _transpose_ring(data, pin, pout, R, extra_ndims)
     elif isinstance(method, Gspmd):
         fn = lambda data: _reshard_gspmd(data, pin, pout, extra_ndims)
     else:

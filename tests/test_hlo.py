@@ -16,6 +16,7 @@ import pytest
 
 from pencilarrays_tpu import (
     AllToAll,
+    Ring,
     Pencil,
     PencilArray,
     PencilFFTPlan,
@@ -56,6 +57,21 @@ def test_single_all_to_all_per_transpose(topo):
     c = count_collectives(hlo_of(f, x))
     assert c["all-to-all"] == 1, c
     assert c["all-gather"] == 0 and c["collective-permute"] == 0, c
+
+
+def test_ring_method_ppermute_rounds(topo):
+    """Ring() lowers to P-1 collective-permutes and no all-to-all."""
+    shape = (16, 16, 16)
+    pen_x = Pencil(topo, shape, (1, 2))
+    pen_y = Pencil(topo, shape, (0, 2))   # exchange over p1 (P=2) -> 1 round
+    pen_z = Pencil(topo, shape, (1, 0))   # exchange over p2 (P=4) -> 3 rounds
+    x = PencilArray.zeros(pen_x)
+    c = count_collectives(hlo_of(
+        lambda a: transpose(a, pen_y, method=Ring()).data, x))
+    assert c["collective-permute"] == 1 and c["all-to-all"] == 0, c
+    c = count_collectives(hlo_of(
+        lambda a: transpose(a, pen_z, method=Ring()).data, x))
+    assert c["collective-permute"] == 3 and c["all-to-all"] == 0, c
 
 
 def test_ragged_transpose_still_one_exchange(topo):

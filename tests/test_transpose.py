@@ -15,6 +15,7 @@ import pytest
 from pencilarrays_tpu import (
     AllToAll,
     Gspmd,
+    Ring,
     Pencil,
     PencilArray,
     Permutation,
@@ -25,7 +26,7 @@ from pencilarrays_tpu import (
     transpose,
 )
 
-METHODS = [AllToAll(), Gspmd()]
+METHODS = [AllToAll(), Gspmd(), Ring()]
 
 
 @pytest.fixture
