@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..parallel.arrays import PencilArray
+from ..parallel.distributed import sync_global_devices
 from ..parallel.pencil import LogicalOrder, MemoryOrder, Pencil
 from .core import ParallelIODriver, metadata
 from . import native
@@ -173,8 +174,6 @@ class BinaryFile:
                               "version": FORMAT_VERSION,
                               "endianness": _endianness(), "datasets": []}
                 self._flush_meta()
-            from ..parallel.distributed import sync_global_devices
-
             sync_global_devices("pa_io_open")
             if not os.path.exists(filename):
                 raise FileNotFoundError(filename)
@@ -262,7 +261,18 @@ class BinaryFile:
             self._write_dataset(name, x, chunks)
 
     def _write_dataset(self, name: str, x: PencilArray, chunks: bool):
-        offset = self._end_offset()
+        # Rewriting an existing dataset of identical size reuses its file
+        # region instead of orphaning it and appending — keeps repeated
+        # checkpoint rewrites from growing the file monotonically (the
+        # HDF5 driver gets this for free from h5py's in-place datasets).
+        # Deterministic across processes: both name and size derive from
+        # the (synchronized) sidecar + pencil math.
+        prev = next((d for d in self._meta["datasets"] if d["name"] == name),
+                    None)
+        if prev is not None and prev["size_bytes"] == x.sizeof_global():
+            offset = prev["offset_bytes"]
+        else:
+            offset = self._end_offset()
         dtype = np.dtype(x.dtype)
         entry = {
             "name": name,
@@ -286,18 +296,22 @@ class BinaryFile:
         # orders the data writes before any subsequent reader.
         if self._is_proc0:
             self._flush_meta()
-        from ..parallel.distributed import sync_global_devices
-
         sync_global_devices("pa_io_write")
 
     def _write_discontiguous(self, x: PencilArray, offset: int, dtype):
         shape = x.pencil.size_global(LogicalOrder) + x.extra_dims
         total = offset + int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
         if self._is_proc0:
-            # extend the file to hold the dataset (pwrite would extend
-            # sparsely anyway; this makes short datasets well-formed)
+            # extend (never shrink: a reused rewrite offset may sit before
+            # later datasets) so short datasets are well-formed; pwrite
+            # would extend sparsely anyway
             with open(self.filename, "r+b") as f:
-                f.truncate(total)
+                f.truncate(max(total, os.path.getsize(self.filename)))
+        # Order proc 0's extension before any peer's data write: memmap
+        # r+ extends a too-short file by writing at the last byte, which
+        # on a shared FS is unordered w.r.t. other processes' writes and
+        # can zero bytes a peer already wrote.
+        sync_global_devices("pa_io_truncate")
         # Walk THIS process's blocks (iter_local_blocks) so that under
         # multi-host SPMD every process writes exactly its own blocks into
         # the shared file — the collective write_all of mpi_io.jl:335-380.
@@ -346,7 +360,8 @@ class BinaryFile:
             pos += int(np.prod(shape_mem, dtype=np.int64)) * dtype.itemsize
         if self._is_proc0:
             with open(self.filename, "r+b") as f:
-                f.truncate(pos)
+                f.truncate(max(pos, os.path.getsize(self.filename)))
+        sync_global_devices("pa_io_truncate")
         # each process writes its own addressable shards' chunks
         with open(self.filename, "r+b") as f:
             for coords, block in iter_local_blocks(x, MemoryOrder):

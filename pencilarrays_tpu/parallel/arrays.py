@@ -448,14 +448,30 @@ class PencilArray:
         )
 
     # -- comparison -------------------------------------------------------
+    def equals(self, other: "PencilArray"):
+        """Elementwise-equality reduction as a traced scalar ``jax.Array``
+        — the jit-safe form of ``==``.  Compares logical (true-shape)
+        views: tail padding is storage detail and may legitimately differ
+        (e.g. after scalar arithmetic which also touches padding)."""
+        if not isinstance(other, PencilArray):
+            raise TypeError(f"equals() expects a PencilArray, got "
+                            f"{type(other).__name__}")
+        if self._pencil != other._pencil or self._extra_dims != other._extra_dims:
+            return jnp.asarray(False)
+        return (self.logical() == other.logical()).all()
+
     def __eq__(self, other):
-        # Compare logical (true-shape) views: tail padding is storage
-        # detail and may legitimately differ (e.g. after scalar arithmetic
-        # which also touches padding).
+        # Eager-only (returns a Python bool): inside jit, use equals().
         if isinstance(other, PencilArray):
-            if self._pencil != other._pencil or self._extra_dims != other._extra_dims:
-                return False
-            return bool((self.logical() == other.logical()).all())
+            eq = self.equals(other)
+            try:
+                return bool(eq)
+            except jax.errors.TracerBoolConversionError:
+                raise TypeError(
+                    "PencilArray == PencilArray returns a Python bool and "
+                    "is eager-only; inside jit-traced code use "
+                    "u.equals(v), which returns a traced scalar"
+                ) from None
         return NotImplemented
 
     __hash__ = None

@@ -188,14 +188,21 @@ def _exchange_transpose(data, pin: Pencil, pout: Pencil, R: int,
             # Store in the output pencil's memory order.
             return _maybe_pallas_transpose(x, fwd_out, platform)
 
-    # check_vma=False only when pallas may run: pallas_call outputs carry
-    # no varying-mesh-axes metadata, which the static check rejects; on
-    # the default path the check stays on.
-    from ..ops.pallas_kernels import pallas_enabled
+    # check_vma=False only when the Pallas unpack kernel can actually run
+    # for this block shape/dtype (pallas_call outputs carry no
+    # varying-mesh-axes metadata, which the static check rejects); when
+    # the plain jnp.transpose path runs the check stays on.
+    from ..ops import pallas_kernels as pk
 
+    out_block = tuple(pout.padded_size_local(LogicalOrder)) + tuple(
+        data.shape[pin.ndims:])
+    pallas_may_run = (
+        fwd_out != tuple(range(len(fwd_out)))
+        and pk.pallas_enabled()
+        and pk.supported(out_block, fwd_out, data.dtype))
     fn = jax.shard_map(local_fn, mesh=mesh, in_specs=in_spec,
                        out_specs=out_spec,
-                       check_vma=not pallas_enabled())
+                       check_vma=not pallas_may_run)
     return fn(data)
 
 

@@ -1,0 +1,83 @@
+"""HLO-text introspection: collective op counts and byte-volume accounting.
+
+The reference's cost model for a transposition is "bytes on the wire per
+rank per hop" (``Transpositions.jl`` sends exactly the intersection ranges;
+``benchmarks/`` report per-process timings).  On TPU the compiled artifact
+is the ground truth, so we parse the partitioned HLO instead: each
+collective *application* (``all-to-all(...)``, ``collective-permute(...)``,
+async ``*-start`` forms) is counted once, and its result shape is priced in
+bytes.  Under SPMD partitioning the compiled module is per-device, so the
+byte volumes reported here are **per chip per application** — the unit the
+ICI cost model wants.
+
+Used by the driver gate (``__graft_entry__.dryrun_multichip``) to turn the
+multichip correctness check into a perf-model artifact, and by tests as a
+budget regression guard.
+"""
+
+from __future__ import annotations
+
+import re
+
+_DTYPE_BYTES = {
+    "pred": 1,
+    "s4": 1, "u4": 1,
+    "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+COLLECTIVE_OPS = (
+    "all-to-all",
+    "all-gather",
+    "reduce-scatter",
+    "all-reduce",
+    "collective-permute",
+)
+
+# Matches an opcode *application*: `= <shape> all-to-all(`, including the
+# async `-start` form (the `-done` half is deliberately excluded so async
+# pairs count once).  The shape is taken non-greedily up to the opcode
+# token: TPU layouts embed parenthesized tile specs (`{1,0:T(8,128)}`)
+# inside tuple shapes, so balanced-paren matching is not an option.  Name
+# *references* (`%all-to-all.3`) never match: they are preceded by `%`,
+# not whitespace, and are never followed directly by `(`.
+_APP_RE = re.compile(
+    r"=\s*(?P<shape>\S.*?)\s+"
+    r"(?P<op>" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?\("
+)
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def shape_bytes(shape_str: str) -> int:
+    """Total bytes of every ``dtype[dims]`` component in an HLO shape string."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue  # token[] etc. — zero-cost control types
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_stats(hlo: str) -> dict:
+    """Per-collective ``{op: {"count": n, "bytes": total_result_bytes}}``.
+
+    ``bytes`` prices each application's *result* shape (per device —
+    partitioned-HLO shapes are per-shard).  For async ``-start`` ops the
+    tuple result includes the operand alias, so async bytes are an upper
+    bound; count semantics are exact either way.
+    """
+    stats: dict = {}
+    for m in _APP_RE.finditer(hlo):
+        entry = stats.setdefault(m.group("op"), {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += shape_bytes(m.group("shape"))
+    return stats

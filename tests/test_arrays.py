@@ -182,3 +182,24 @@ def test_fill_and_eq(pen):
     assert y == y
     assert not (x == y)
     assert x.allclose(x)
+
+def test_equals_traced(pen):
+    """``==`` is eager-only with a clear error under tracing; ``equals()``
+    is the jit-safe traced form (cf. ADVICE r1: TracerBoolConversionError
+    trap for a registered pytree)."""
+    x = PencilArray.zeros(pen)
+    y = x.fill(2.0)
+
+    @jax.jit
+    def f(a, b):
+        return a.equals(b)
+
+    assert bool(f(x, x))
+    assert not bool(f(x, y))
+
+    @jax.jit
+    def g(a, b):
+        return a == b
+
+    with pytest.raises(TypeError, match="equals"):
+        g(x, y)

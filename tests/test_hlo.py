@@ -169,3 +169,32 @@ def test_masked_reduction_single_all_reduce(topo):
     # GSPMD may reduce per mesh axis (one all-reduce per axis is optimal
     # staged reduction, not waste)
     assert c["all-reduce"] <= 2, c
+
+
+def test_collective_stats_parser(topo):
+    """The cost-model parser (utils/hlo.py) agrees with the opcode counter
+    on real compiled HLO, and handles async `-start` forms with TPU tiled
+    layouts (nested parens) on synthetic text."""
+    from pencilarrays_tpu.utils.hlo import collective_stats
+
+    shape = (16, 16, 16)
+    pen_x = Pencil(topo, shape, (1, 2))
+    pen_y = Pencil(topo, shape, (0, 2))
+    x = PencilArray.zeros(pen_x)
+    hlo = hlo_of(lambda a: transpose(a, pen_y).data, x)
+    stats = collective_stats(hlo)
+    assert stats["all-to-all"]["count"] == count_collectives(hlo)["all-to-all"]
+    # per-shard result bytes: the exchanged tile is the full local block
+    assert stats["all-to-all"]["bytes"] > 0
+
+    synth = (
+        "%ag = (f32[4,8]{1,0:T(8,128)}, f32[16,8]{1,0:T(8,128)}) "
+        "all-gather-start(f32[4,8]{1,0:T(8,128)} %p), replica_groups={{0,1}}\n"
+        "%agd = f32[16,8]{1,0:T(8,128)} all-gather-done((f32[4,8], "
+        "f32[16,8]) %ag)\n"
+        "%gte = f32[4] get-tuple-element((f32[4], f32[4]) %all-to-all.3)\n"
+        "%add = f32[4]{0} add(f32[4]{0} %y, f32[4]{0} %all-to-all.9)\n"
+    )
+    s = collective_stats(synth)
+    assert s["all-gather"]["count"] == 1  # -start counted, -done not
+    assert "all-to-all" not in s  # name references don't count
