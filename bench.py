@@ -1,24 +1,115 @@
 """Headline benchmark — prints exactly ONE JSON line to stdout.
 
-Metric: the reference's only published absolute number — the fused grid
-broadcast ``v = f(u, x, y, z)`` on a 60x110x21 grid
-(``/root/reference/benchmarks/grids.jl:100-118``: 212.889 us at 0
-allocations, 1 MPI rank, Julia 1.7.2).  Same workload here: localgrid
-components broadcast in memory order against a PencilArray, fused by XLA
-into one kernel on the TPU chip.
+The line carries the north-star metrics (BASELINE.md "Target metric"):
 
-``vs_baseline`` is reference_time / our_time (>1 means faster than the
-reference).  Details for other configs (transpose cycle bandwidth, 3-D
-FFT) are written to BENCH_DETAILS.json — see benchmarks/suite.py.
+* ``transpose_hop_256``  — 256^3 f32 pencil-transpose hop, GB/s/chip,
+  with a same-chip raw-XLA baseline (``jnp.transpose`` of the same cube)
+  so the framework's pad/permute/slice overhead is measured against what
+  the hardware does without the framework;
+* ``fft_r2c_256``        — 3-D r2c FFT round trip, GFLOPS/chip, with a
+  raw ``jnp.fft.rfftn``/``irfftn`` round trip as the same-chip baseline;
+* ``grid_broadcast_60x110x21_f64`` — the reference's only published
+  absolute number (``/root/reference/benchmarks/grids.jl:115``:
+  212.889 us, 1 MPI rank, Julia 1.7.2), reproduced like for like.
+
+Top-level ``metric``/``value``/``vs_baseline`` expose the FFT GFLOPS with
+``vs_baseline`` = raw_xla_time / framework_time (>= 1 means the pencil
+framework costs nothing over raw XLA on one chip).
+
+Timing uses the hardened protocol in ``utils/benchtime.py`` (in-jit
+fori_loop, min-of-repeats, K-differencing): remote TPU tunnels do not
+synchronize on ``block_until_ready``, so naive wall-clock timing measures
+dispatch, not kernels.
 """
 
 from __future__ import annotations
 
 import json
-import sys
-import time
 
-REF_US = 212.889  # benchmarks/grids.jl:115 (NoPermutation broadcast)
+REF_GRID_US = 212.889  # benchmarks/grids.jl:115 (NoPermutation broadcast)
+
+
+def bench_grid_broadcast(jax, jnp, np, pa, timeit):
+    topo = pa.Topology((1,), devices=jax.devices()[:1])
+    shape = (60, 110, 21)
+    pen = pa.Pencil(topo, shape, (1,))
+    rng = np.random.default_rng(0)
+    u = pa.PencilArray.from_global(pen, rng.standard_normal(shape))
+    g = pa.localgrid(pen, [np.linspace(0, 1, n) for n in shape])
+    gx, gy, gz = g.components()
+
+    def body(a):
+        # grids.jl ftest-shaped expression: u + x + 2 y cos z.  eps is 0
+        # at runtime but data-dependent on the carry, so XLA cannot hoist
+        # the grid subexpression out of the timing loop.
+        eps = a[0, 0, 0] * 0.0
+        return a + gx + 2.0 * gy * jnp.cos(gz + eps)
+
+    dt_us = timeit(body, u.data, k0=10, k1=10010) * 1e6
+    return {"us": round(dt_us, 3),
+            "vs_reference": round(REF_GRID_US / dt_us, 2)}
+
+
+def bench_transpose_hop(jax, jnp, np, pa, timeit):
+    """Framework single-hop layout change vs raw jnp.transpose, 256^3 f32.
+
+    On one chip a hop is the local-permute path (the exchange itself is
+    exercised on the virtual mesh / in MULTICHIP_COSTS.json); the ratio
+    isolates what PencilArray's bookkeeping adds on top of XLA's permute.
+    A (2,0,1) cube permutation has period 3, so consecutive fori_loop
+    iterations cannot cancel; the data-dependent eps blocks hoisting.
+    """
+    n = 256
+    nbytes = 2 * n ** 3 * 4  # read + write per permute
+    topo = pa.Topology((1,), devices=jax.devices()[:1])
+    pen_x = pa.Pencil(topo, (n, n, n), (1,))
+    pen_y = pen_x.replace(permutation=pa.Permutation(2, 0, 1))
+
+    def fw(d):
+        a = pa.PencilArray(pen_x, d + d.ravel()[0] * 1e-30)
+        return pa.transpose(a, pen_y).data
+
+    def raw(d):
+        return jnp.transpose(d + d.ravel()[0] * 1e-30, (2, 0, 1))
+
+    x = jnp.zeros((n, n, n), jnp.float32)
+    t_fw = timeit(fw, x, k0=10, k1=110)
+    t_raw = timeit(raw, x, k0=10, k1=110)
+    return {
+        "framework_gb_s": round(nbytes / t_fw / 1e9, 1),
+        "raw_xla_gb_s": round(nbytes / t_raw / 1e9, 1),
+        "ratio_vs_raw_xla": round(t_raw / t_fw, 3),
+    }
+
+
+def bench_fft(jax, jnp, np, pa, timeit):
+    """PencilFFTPlan r2c round trip vs raw jnp.fft round trip, 256^3 f32."""
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+
+    n = 256
+    topo = pa.Topology((1,), devices=jax.devices()[:1])
+    plan = PencilFFTPlan(topo, (n, n, n), real=True, dtype=jnp.float32)
+    u = plan.allocate_input()
+
+    def fw(d):
+        a = pa.PencilArray(plan.input_pencil, d + d.ravel()[0] * 1e-30)
+        return plan.backward(plan.forward(a)).data
+
+    def raw(d):
+        y = jnp.fft.rfftn(d + d.ravel()[0] * 1e-30)
+        return jnp.fft.irfftn(y, s=(n, n, n)).astype(jnp.float32)
+
+    x = u.data
+    t_fw = timeit(fw, x, k0=2, k1=42)
+    t_raw = timeit(raw, x, k0=2, k1=42)
+    # 2 transforms x 5 N^3 log2(N^3) real flops (rough FFT flop model)
+    flops = 2 * 5 * n ** 3 * np.log2(float(n) ** 3)
+    return {
+        "framework_gflops": round(flops / t_fw / 1e9, 1),
+        "raw_xla_gflops": round(flops / t_raw / 1e9, 1),
+        "ratio_vs_raw_xla": round(t_raw / t_fw, 3),
+        "framework_seconds": t_fw,
+    }
 
 
 def main():
@@ -26,42 +117,34 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    from pencilarrays_tpu import PencilArray, Permutation, Pencil, Topology, localgrid
-
-    # single chip, slab topology of 1 (matches "1 MPI rank")
-    topo = Topology((1,), devices=jax.devices()[:1])
-    shape = (60, 110, 21)
-    # float64 to match the reference benchmark's Float64 arrays
-    dtype = jnp.float64
-    jax.config.update("jax_enable_x64", True)
-    pen = Pencil(topo, shape, (1,))
-    rng = np.random.default_rng(0)
-    u = PencilArray.from_global(pen, rng.standard_normal(shape))
-    g = localgrid(pen, [np.linspace(0, 1, n) for n in shape])
-    gx, gy, gz = g.components()
-
-    # Shared hardened protocol (see utils/benchtime.py): in-jit loop,
-    # min-of-repeats, K-differencing with plausibility guard — the
-    # like-for-like comparison with the reference's BenchmarkTools kernel
-    # minimum.
+    import pencilarrays_tpu as pa
     from pencilarrays_tpu.utils.benchtime import device_seconds_per_iter
 
-    def body(a):
-        # grids.jl ftest-shaped expression: u + x + 2 y cos z.
-        # eps is 0 at runtime but data-dependent on the carry, so XLA
-        # cannot hoist the grid subexpression out of the timing loop
-        # (the reference evaluates the FULL expression every time).
-        eps = a[0, 0, 0] * 0.0
-        return a + gx + 2.0 * gy * jnp.cos(gz + eps)
+    jax.config.update("jax_enable_x64", True)  # grid bench is f64
 
-    dt_us = device_seconds_per_iter(body, u.data, k0=10, k1=10010) * 1e6
+    out = {}
+    failures = {}
+    for key, fn in [
+        ("fft_r2c_256", bench_fft),
+        ("transpose_hop_256", bench_transpose_hop),
+        ("grid_broadcast_60x110x21_f64", bench_grid_broadcast),
+    ]:
+        try:
+            out[key] = fn(jax, jnp, np, pa, device_seconds_per_iter)
+        except Exception as e:  # one failed metric must not kill the line
+            failures[key] = f"{type(e).__name__}: {e}"
 
-    print(json.dumps({
-        "metric": "grid_broadcast_60x110x21_f64",
-        "value": round(dt_us, 3),
-        "unit": "us",
-        "vs_baseline": round(REF_US / dt_us, 2),
-    }))
+    fft = out.get("fft_r2c_256", {})
+    line = {
+        "metric": "fft_r2c_roundtrip_256_gflops_per_chip",
+        "value": fft.get("framework_gflops"),
+        "unit": "gflops",
+        "vs_baseline": fft.get("ratio_vs_raw_xla"),
+        **out,
+    }
+    if failures:
+        line["failures"] = failures
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -132,16 +132,27 @@ class BinaryDriver(ParallelIODriver):
     positioned writes).  ``uniquify_names=True`` is a convenience beyond
     the reference: repeated dataset names get ``(n)`` suffixes instead of
     replacing the existing dataset.
+
+    ``reuse_regions`` (default True) rewrites a same-name, same-size
+    dataset in place (like the HDF5 driver) so checkpoint rotation does
+    not grow the file monotonically.  Trade-off: a crash mid-rewrite
+    leaves the sidecar pointing at half-written bytes — the same exposure
+    as any in-place store (h5py included).  For crash-consistent rotation
+    set ``reuse_regions=False`` (append-only: the old bytes survive until
+    the sidecar is re-flushed) or use the Orbax driver, whose async
+    commit protocol is crash-consistent by design.
     """
 
     uniquify_names: bool = False
+    reuse_regions: bool = True
 
     def open(self, filename: str, *, write: bool = False, read: bool = False,
              create: bool = False, append: bool = False,
              truncate: bool = False) -> "BinaryFile":
         return BinaryFile(filename, write=write, read=read, create=create,
                           append=append, truncate=truncate,
-                          uniquify_names=self.uniquify_names)
+                          uniquify_names=self.uniquify_names,
+                          reuse_regions=self.reuse_regions)
 
 
 class BinaryFile:
@@ -150,8 +161,9 @@ class BinaryFile:
 
     def __init__(self, filename: str, *, write=False, read=False,
                  create=False, append=False, truncate=False,
-                 uniquify_names=False):
+                 uniquify_names=False, reuse_regions=True):
         self.uniquify_names = uniquify_names
+        self.reuse_regions = reuse_regions
         self.filename = filename
         self.meta_filename = filename + ".json"
         self.writable = write or append or create or truncate
@@ -266,9 +278,10 @@ class BinaryFile:
         # checkpoint rewrites from growing the file monotonically (the
         # HDF5 driver gets this for free from h5py's in-place datasets).
         # Deterministic across processes: both name and size derive from
-        # the (synchronized) sidecar + pencil math.
-        prev = next((d for d in self._meta["datasets"] if d["name"] == name),
-                    None)
+        # the (synchronized) sidecar + pencil math.  Crash trade-off
+        # documented on BinaryDriver.reuse_regions.
+        prev = None if not self.reuse_regions else next(
+            (d for d in self._meta["datasets"] if d["name"] == name), None)
         if prev is not None and prev["size_bytes"] == x.sizeof_global():
             offset = prev["offset_bytes"]
         else:

@@ -1,26 +1,41 @@
 """Distributed N-D FFT over pencil decompositions — the PencilFFTs proof.
 
 The reference library exists to power PencilFFTs.jl (``README.md:29-31``):
-a multidimensional FFT decomposes into per-dimension 1-D transforms, each
+a multidimensional FFT decomposes into per-dimension transforms, each
 applied while that dimension is *local*, with global transposes in
 between — the x->y->z pencil cycle (``docs/src/Transpositions.md:7-16``).
 This module is that layer rebuilt TPU-first:
 
-* local transforms are XLA FFT ops (``jnp.fft``) on the sharded array,
-  batched over all non-transform dims — large contiguous batches feed the
-  hardware well;
+* **per-dimension transforms** (the PencilFFTs ``Transforms`` taxonomy:
+  ``FFT``, ``RFFT``, ``R2R`` DCT/DST, ``NoTransform``): pass
+  ``transforms=("rfft", "fft", "none")`` and each dim carries its own
+  kind, with per-stage dtypes and global shapes derived at plan time;
+* **local-dim batching**: the plan is compiled into a static *schedule*
+  at construction — at every stage ALL still-pending dims that are local
+  there are transformed in ONE native XLA FFT op (``jnp.fft.rfftn`` /
+  ``fftn`` over several axes).  On one chip the whole 3-D r2c transform
+  is a single fused XLA FFT with zero transposes — raw-``jnp.fft``
+  parity by construction; on a slab (1-D) topology it is two stages
+  instead of three.  The reference applies strictly one 1-D FFTW call
+  per dim; batching is the TPU-first re-design (XLA's FFT kernels are
+  multi-axis natively);
 * between stages, the transpose engine's ``all_to_all`` exchanges ride
-  ICI (``parallel/transpositions.py``);
-* with ``permute=True`` (default, like PencilFFTs' ``permute_dims``) each
-  stage's pencil permutation places the transform dimension *last in
-  memory*, where XLA's FFT is contiguous — the zero-cost layout trick the
-  reference implements with compile-time permutations;
+  ICI (``parallel/transpositions.py``); local transforms run under
+  ``shard_map`` so GSPMD can never insert a hidden all-gather;
+* with ``permute=True`` (default, like PencilFFTs' ``permute_dims``)
+  each stage's pencil permutation places the stage's transform dim
+  *last in memory*, where the FFT is contiguous;
 * the whole plan is traceable: ``jit(plan.forward)`` fuses transforms,
   packing and collectives into one XLA program.
 
-The transform dimension is exact-size at its stage (a local dim is never
+Transform dims are exact-size at their stage (a local dim is never
 padded), so tail padding on *other* dims stays inert garbage, masked as
 usual downstream.
+
+Ordering constraint (PencilFFTs convention: the real transform comes
+first): ``rfft``/``dct``/``dst`` act on *real* data, so on a distributed
+mesh they must appear at stage indices before any ``fft`` dim has made
+the data complex; violations raise at plan construction.
 """
 
 from __future__ import annotations
@@ -40,44 +55,84 @@ from ..utils.permutations import Permutation
 
 __all__ = ["PencilFFTPlan"]
 
+_KINDS = ("fft", "rfft", "dct", "dst", "none")
 
-@lru_cache(maxsize=512)
-def _stage_fn(pen: Pencil, extra_ndims: int, kind: str, axis: int, n: int):
-    """Cached per-stage local-transform callable (see _local_fft)."""
+
+def _alt_signs(blk, axis):
+    # (-1)^j along the transform axis, broadcast-shaped
+    shape = [1] * blk.ndim
+    shape[axis] = blk.shape[axis]
+    j = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), axis)
+    return jnp.where(j % 2 == 0, 1.0, -1.0).astype(blk.dtype)
+
+
+def _dst(blk, axis):
+    # DST-II(x) = reverse(DCT-II(x * (-1)^j))  (ortho norm; verified
+    # against scipy.fft.dst) — jax.scipy has no native dst
     from jax.scipy import fft as jsfft
 
-    def _alt_signs(blk):
-        # (-1)^j along the transform axis, broadcast-shaped
-        shape = [1] * blk.ndim
-        shape[axis] = blk.shape[axis]
-        j = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), axis)
-        return jnp.where(j % 2 == 0, 1.0, -1.0).astype(blk.dtype)
+    return jnp.flip(
+        jsfft.dct(blk * _alt_signs(blk, axis), axis=axis, norm="ortho"),
+        axis=axis)
 
-    def _dst(blk):
-        # DST-II(x) = reverse(DCT-II(x * (-1)^j))  (ortho norm; verified
-        # against scipy.fft.dst) — jax.scipy has no native dst
-        return jnp.flip(
-            jsfft.dct(blk * _alt_signs(blk), axis=axis, norm="ortho"),
-            axis=axis)
 
-    def _idst(blk):
-        # inverse: IDST-II(y) = (-1)^j * IDCT-II(reverse(y))
-        out = jsfft.idct(jnp.flip(blk, axis=axis), axis=axis, norm="ortho")
-        return out * _alt_signs(out)
+def _idst(blk, axis):
+    # inverse: IDST-II(y) = (-1)^j * IDCT-II(reverse(y))
+    from jax.scipy import fft as jsfft
 
-    ops = {
-        "fft": lambda blk: jnp.fft.fft(blk, axis=axis),
-        "ifft": lambda blk: jnp.fft.ifft(blk, axis=axis),
-        "rfft": lambda blk: jnp.fft.rfft(blk, axis=axis),
-        "irfft": lambda blk: jnp.fft.irfft(blk, n=n, axis=axis),
-        # R2R transforms (PencilFFTs Transforms.R2R parity); ortho norm
-        # so the inverse kinds are exact inverses
-        "dct": lambda blk: jsfft.dct(blk, axis=axis, norm="ortho"),
-        "idct": lambda blk: jsfft.idct(blk, axis=axis, norm="ortho"),
-        "dst": _dst,
-        "idst": _idst,
-    }
-    op = ops[kind]
+    out = jsfft.idct(jnp.flip(blk, axis=axis), axis=axis, norm="ortho")
+    return out * _alt_signs(out, axis)
+
+
+@lru_cache(maxsize=512)
+def _stage_fn(pen: Pencil, extra_ndims: int, ops: tuple, inverse: bool,
+              pre_complex: bool):
+    """Cached batched local-transform callable for one schedule step.
+
+    ``ops`` is a tuple of ``(kind, mem_axis, n_logical)`` — every
+    transform applied at this stage, all along axes that are local
+    (unsharded) in ``pen``.  Runs under ``shard_map`` so each device
+    transforms its own block with zero communication: without this,
+    GSPMD cannot partition the FFT op and inserts an all-gather of the
+    full array per stage (observed: 6 all-gathers in a 3-D forward
+    plan) — the multi-chip killer.  Caching lets eager (un-jitted)
+    plans reuse function objects and hit JAX's dispatch cache.
+    """
+    from jax.scipy import fft as jsfft
+
+    r2r = tuple(op for op in ops if op[0] in ("dct", "dst"))
+    four = tuple(op for op in ops if op[0] in ("fft", "rfft"))
+    rf = tuple(op for op in four if op[0] == "rfft")
+    cax = tuple(ax for k, ax, n in four if k == "fft")
+
+    if not inverse:
+        def op(blk):
+            for k, ax, n in r2r:
+                blk = (jsfft.dct(blk, axis=ax, norm="ortho") if k == "dct"
+                       else _dst(blk, ax))
+            if rf:
+                # rfftn transforms its LAST listed axis real-to-complex
+                blk = jnp.fft.rfftn(blk, axes=cax + (rf[0][1],))
+            elif cax:
+                blk = jnp.fft.fftn(blk, axes=cax)
+            return blk
+    else:
+        def op(blk):
+            if rf:
+                _, ax, n = rf[0]
+                s = tuple(m for k, a, m in four if k == "fft") + (n,)
+                blk = jnp.fft.irfftn(blk, s=s, axes=cax + (ax,))
+            elif cax:
+                blk = jnp.fft.ifftn(blk, axes=cax)
+            if not pre_complex and jnp.iscomplexobj(blk):
+                # forward promoted real->complex here; the spectrum is
+                # conjugate-symmetric, imag is numerically zero
+                blk = blk.real
+            for k, ax, n in reversed(r2r):
+                blk = (jsfft.idct(blk, axis=ax, norm="ortho") if k == "dct"
+                       else _idst(blk, ax))
+            return blk
+
     if math.prod(pen.mesh.devices.shape) == 1:
         return op
     spec = pen.partition_spec(extra_ndims)
@@ -93,8 +148,7 @@ def _stage_permutation(ndims: int, d: int, permute: bool):
 
 
 class PencilFFTPlan:
-    """Plan for a distributed N-D (inverse) FFT, optionally real-to-complex
-    along the first transform dimension.
+    """Plan for a distributed N-D transform with per-dimension kinds.
 
     Mirrors PencilFFTs' ``PencilFFTPlan(dims_global, transform, proc_dims,
     comm)``: the plan owns its chain of pencil configurations; use
@@ -102,21 +156,22 @@ class PencilFFTPlan:
     :attr:`input_pencil` / :attr:`output_pencil`) and call
     :meth:`forward` / :meth:`backward`.
 
+    ``transforms`` (or a tuple passed as ``transform``) selects one of
+    ``"fft" | "rfft" | "dct" | "dst" | "none"`` per dim — the PencilFFTs
+    per-dimension ``Transforms`` tuple (``RFFT x FFT x FFT``,
+    ``NoTransform``, R2R mixes).  The legacy spellings remain:
+    ``real=True`` = ``("rfft", "fft", ...)``; ``transform="dct"`` =
+    all-DCT.
+
     Normalization follows ``jnp.fft`` defaults: unnormalized forward,
-    ``1/n``-scaled inverse, so ``backward(forward(u)) == u``.
+    ``1/n``-scaled inverse (R2R kinds are ortho-normalized both ways),
+    so ``backward(forward(u)) == u``.
     """
 
     def __init__(self, topology: Topology, global_shape: Sequence[int], *,
                  real: bool = False, dtype=None, permute: bool = True,
-                 transform: str = "fft",
+                 transform="fft", transforms: Sequence[str] = None,
                  method: AbstractTransposeMethod = AllToAll()):
-        if transform not in ("fft", "dct", "dst"):
-            raise ValueError(f"transform must be 'fft', 'dct' or 'dst', "
-                             f"got {transform!r}")
-        self.transform = transform
-        if transform in ("dct", "dst") and real:
-            raise ValueError(
-                f"real=True is implicit for transform={transform!r}")
         global_shape = tuple(int(n) for n in global_shape)
         N = len(global_shape)
         M = topology.ndims
@@ -125,61 +180,151 @@ class PencilFFTPlan:
                 f"topology ndims ({M}) must be < array ndims ({N}) so that "
                 f"at least one dim is local per stage"
             )
+        # -- resolve per-dim transform kinds ------------------------------
+        if transforms is None and isinstance(transform, (tuple, list)):
+            transforms = transform
+            transform = "mixed"
+        if transforms is not None:
+            kinds = tuple(str(k).lower() for k in transforms)
+            if len(kinds) != N:
+                raise ValueError(
+                    f"transforms has {len(kinds)} entries for a rank-{N} "
+                    f"array")
+            for k in kinds:
+                if k not in _KINDS:
+                    raise ValueError(
+                        f"unknown transform kind {k!r}; expected one of "
+                        f"{_KINDS}")
+            if real:
+                raise ValueError(
+                    "real=True is implicit in per-dim transforms; spell the "
+                    "real dim 'rfft'")
+            transform = "mixed"
+        else:
+            if transform not in ("fft", "dct", "dst"):
+                raise ValueError(f"transform must be 'fft', 'dct' or 'dst', "
+                                 f"got {transform!r}")
+            if transform in ("dct", "dst") and real:
+                raise ValueError(
+                    f"real=True is implicit for transform={transform!r}")
+            if transform == "fft" and real:
+                kinds = ("rfft",) + ("fft",) * (N - 1)
+            else:
+                kinds = (transform,) * N
+        if kinds.count("rfft") > 1:
+            raise ValueError("at most one dim may be 'rfft'")
+        # Real-input kinds must precede any fft dim in STAGE order.  This
+        # is validated upfront on the conceptual per-dim chain — not on
+        # the batched schedule — so the same transforms tuple is accepted
+        # or rejected identically on every topology (a slab mesh could
+        # batch ("fft","rfft") into one real transform, but the plan must
+        # not construct on one process grid and raise on another).
+        complex_seen = False
+        for d, k in enumerate(kinds):
+            if k in ("rfft", "dct", "dst") and complex_seen:
+                raise ValueError(
+                    f"transform {k!r} on dim {d} would act on data an "
+                    f"earlier 'fft' dim made complex; real-input kinds "
+                    f"must come first in stage order")
+            if k in ("fft", "rfft"):
+                complex_seen = True
+        self.transforms = kinds
+        self.transform = transform  # legacy attribute
+        self.real = "rfft" in kinds
         self.topology = topology
         self.shape_physical = global_shape
-        self.real = real
-        if dtype is None:
-            dtype = (jnp.float32 if (real or transform in ("dct", "dst"))
-                     else jnp.complex64)
-        self.dtype_physical = jnp.dtype(dtype)
-        if real and jnp.issubdtype(self.dtype_physical, jnp.complexfloating):
-            raise ValueError("real=True requires a real input dtype")
-        if transform in ("dct", "dst"):
-            if jnp.issubdtype(self.dtype_physical, jnp.complexfloating):
-                raise ValueError(
-                    f"transform={transform!r} requires a real dtype")
-            self.dtype_spectral = self.dtype_physical  # R2R: real throughout
-        else:
-            self.dtype_spectral = jnp.dtype(
-                jnp.result_type(self.dtype_physical, jnp.complex64))
         self.method = method
         self.permute = permute
 
-        # spectral global shape: r2c halves dim 0 (first transform dim);
-        # R2R transforms preserve every extent
-        if real:
-            self.shape_spectral = (global_shape[0] // 2 + 1,) + global_shape[1:]
+        # -- dtypes -------------------------------------------------------
+        needs_real = any(k in ("rfft", "dct", "dst") for k in kinds)
+        if dtype is None:
+            dtype = jnp.float32 if needs_real else jnp.complex64
+        self.dtype_physical = jnp.dtype(dtype)
+        is_cplx_in = jnp.issubdtype(self.dtype_physical, jnp.complexfloating)
+        if needs_real and is_cplx_in:
+            kr = next(k for k in kinds if k in ("rfft", "dct", "dst"))
+            if self.real and transform != "mixed":
+                raise ValueError("real=True requires a real input dtype")
+            raise ValueError(f"transform {kr!r} requires a real dtype")
+        if any(k in ("fft", "rfft") for k in kinds):
+            self.dtype_spectral = jnp.dtype(
+                jnp.result_type(self.dtype_physical, jnp.complex64))
         else:
-            self.shape_spectral = global_shape
+            self.dtype_spectral = self.dtype_physical  # R2R/none: real
 
-        # Stage d transforms logical dim d.  Configuration for stage d:
-        # dim d local, decomposition = the M dims "after" d cyclically —
-        # stage 0 is the classic x-pencil (last M dims decomposed,
-        # matching Pencil's default), and consecutive stages differ in
-        # exactly one decomposition slot, so each hop is a single
-        # all_to_all.
-        self._pencils: List[Pencil] = []
-        decomp = list(range(N - M, N))  # stage 0: last M dims
+        self.shape_spectral = tuple(
+            n // 2 + 1 if k == "rfft" else n
+            for n, k in zip(global_shape, kinds))
+
+        # -- stage configurations (decomp chain) --------------------------
+        # Stage d has logical dim d local; consecutive stages differ in at
+        # most one decomposition slot, so each hop is a single all_to_all.
+        cfgs = []
+        decomp = list(range(N - M, N))  # stage 0: classic x-pencil
         for d in range(N):
-            shape = self.shape_spectral if (real and d > 0) else global_shape
-            perm = _stage_permutation(N, d, permute)
+            cfgs.append((tuple(decomp), _stage_permutation(N, d, permute)))
+            if d + 1 < N and (d + 1) in decomp:
+                decomp[decomp.index(d + 1)] = d
+
+        # -- static schedule ----------------------------------------------
+        # Walk the chain once at plan time; batch every pending dim that
+        # is local at the current configuration.  A dim decomposed over a
+        # size-1 mesh axis is local in every way that matters.
+        def _is_local(pen: Pencil, p: int) -> bool:
+            if p not in pen.decomposition:
+                return True
+            return topology.dims[pen.decomposition.index(p)] == 1
+
+        shape = list(global_shape)
+        pending = [d for d in range(N) if kinds[d] != "none"]
+        is_complex = is_cplx_in
+        steps: List[tuple] = []
+        cur = Pencil(topology, tuple(shape), cfgs[0][0],
+                     permutation=cfgs[0][1])
+        self._input_pencil = cur
+        for d in range(N):
+            if not pending:
+                break
+            dec, perm = cfgs[d]
+            if dec != cur.decomposition:
+                tgt = Pencil(topology, tuple(shape), dec, permutation=perm)
+                steps.append(("t", cur, tgt))
+                cur = tgt
+            if d != min(pending):
+                continue  # path hop only; d's transform already applied
+            batch = tuple(sorted(p for p in pending if _is_local(cur, p)))
+            mem_ids = cur.permutation.apply(tuple(range(N)))
+            ops = []
+            for p in batch:
+                k = kinds[p]
+                # upfront stage-order validation guarantees real input here
+                assert not (k in ("rfft", "dct", "dst") and is_complex)
+                ops.append((k, mem_ids.index(p), shape[p]))
+            pre = cur
+            pre_complex = is_complex
+            for p in batch:
+                if kinds[p] == "rfft":
+                    shape[p] = shape[p] // 2 + 1
+            if any(kinds[p] in ("fft", "rfft") for p in batch):
+                is_complex = True
+            if tuple(shape) != pre.size_global():
+                cur = Pencil(topology, tuple(shape), dec, permutation=perm)
+            steps.append(("f", pre, cur, tuple(ops), pre_complex))
+            pending = [p for p in pending if p not in batch]
+        self._steps = tuple(steps)
+        self._output_pencil = cur
+
+        # conceptual full chain (stage d pencil at its pre-stage shape),
+        # for introspection/tests; the schedule above may visit fewer.
+        self._pencils: List[Pencil] = []
+        sh = list(global_shape)
+        for d in range(N):
             self._pencils.append(
-                Pencil(topology, shape, tuple(decomp), permutation=perm))
-            # next stage: dim d+1 must become local; it is decomposed in
-            # exactly one slot (if any) — swap d into that slot.
-            if d + 1 < N:
-                nxt = d + 1
-                slot = decomp.index(nxt) if nxt in decomp else None
-                if slot is not None:
-                    decomp[slot] = d
-        # spectral-side input pencil for stage 0 of the backward pass when
-        # real=True (dim 0 local but halved global size)
-        if real:
-            self._pencil0_spec = Pencil(
-                topology, self.shape_spectral, self._pencils[0].decomposition,
-                permutation=self._pencils[0].permutation)
-        else:
-            self._pencil0_spec = self._pencils[0]
+                Pencil(topology, tuple(sh), cfgs[d][0],
+                       permutation=cfgs[d][1]))
+            if kinds[d] == "rfft":
+                sh[d] = sh[d] // 2 + 1
 
     # -- pencils ----------------------------------------------------------
     @property
@@ -189,16 +334,12 @@ class PencilFFTPlan:
 
     @property
     def input_pencil(self) -> Pencil:
-        return self._pencils[0]
+        return self._input_pencil
 
     @property
     def output_pencil(self) -> Pencil:
         """Configuration of the spectral (fully transformed) array."""
-        last = self._pencils[-1]
-        if self.real:
-            return Pencil(self.topology, self.shape_spectral,
-                          last.decomposition, permutation=last.permutation)
-        return last
+        return self._output_pencil
 
     def allocate_input(self, extra_dims: Tuple[int, ...] = ()) -> PencilArray:
         return PencilArray.zeros(self.input_pencil, extra_dims,
@@ -208,108 +349,70 @@ class PencilFFTPlan:
         return PencilArray.zeros(self.output_pencil, extra_dims,
                                  self.dtype_spectral)
 
-    # -- helpers ----------------------------------------------------------
-    @staticmethod
-    def _mem_axis(pen: Pencil, d: int) -> int:
-        """Memory-order axis index of logical dim ``d``."""
-        return pen.permutation.apply(tuple(range(pen.ndims))).index(d)
-
-    @staticmethod
-    def _local_fft(pen: Pencil, data, extra_ndims: int, kind: str,
-                   axis: int, n: int = 0):
-        """Apply a 1-D transform along a *local* (unsharded) axis under
-        ``shard_map``, so each device transforms its own block with zero
-        communication.  Without this, GSPMD cannot partition the FFT op
-        and inserts an all-gather of the full array per stage (observed:
-        6 all-gathers in a 3-D forward plan) — the multi-chip killer.
-        Stage callables are cached so eager (un-jitted) plans reuse the
-        same function objects and hit JAX's dispatch cache.
-        """
-        return _stage_fn(pen, extra_ndims, kind, axis, n)(data)
-
-    def _spectral_pencil_for(self, pen: Pencil) -> Pencil:
-        """Same configuration, spectral global shape (r2c size change)."""
-        if pen.size_global() == self.shape_spectral:
-            return pen
-        return Pencil(self.topology, self.shape_spectral, pen.decomposition,
-                      permutation=pen.permutation)
-
     # -- transforms -------------------------------------------------------
     def forward(self, u: PencilArray) -> PencilArray:
-        """Physical -> spectral: fft along dim 0 (rfft if ``real``), then
-        for each further dim: transpose so it is local, fft."""
+        """Physical -> spectral: interpret the static schedule (batched
+        local transforms + single-hop transposes)."""
         if u.pencil != self.input_pencil:
             raise ValueError(
                 f"input must live on plan.input_pencil "
                 f"({self.input_pencil!r}), got {u.pencil!r}"
             )
-        N = len(self.shape_physical)
-        pen = self._pencils[0]
-        axis = self._mem_axis(pen, 0)
         nd_extra = u.ndims_extra
-        fwd_kind = self.transform
-        if self.real:
-            data = self._local_fft(pen, u.data, nd_extra, "rfft", axis)
-            pen = self._pencil0_spec
-        else:
-            data = self._local_fft(
-                pen, u.data.astype(self.dtype_spectral), nd_extra, fwd_kind,
-                axis)
-        x = PencilArray(pen, data.astype(self.dtype_spectral), u.extra_dims)
-        for d in range(1, N):
-            target = self._spectral_pencil_for(self._pencils[d])
-            x = transpose(x, target, method=self.method)
-            axis = self._mem_axis(target, d)
-            data = self._local_fft(target, x.data, nd_extra, fwd_kind, axis)
-            x = PencilArray(target, data, x.extra_dims)
+        x = u
+        for step in self._steps:
+            if step[0] == "t":
+                x = transpose(x, step[2], method=self.method)
+            else:
+                _, pre, post, ops, pre_complex = step
+                data = _stage_fn(pre, nd_extra, ops, False, pre_complex)(
+                    x.data)
+                x = PencilArray(post, data, x.extra_dims)
+        if x.dtype != self.dtype_spectral:
+            x = PencilArray(x.pencil, x.data.astype(self.dtype_spectral),
+                            x.extra_dims)
         return x
 
     def backward(self, uh: PencilArray) -> PencilArray:
-        """Spectral -> physical (inverse transforms, reverse chain)."""
+        """Spectral -> physical (inverse transforms, reverse schedule)."""
         if uh.pencil != self.output_pencil:
             raise ValueError(
                 f"input must live on plan.output_pencil "
                 f"({self.output_pencil!r}), got {uh.pencil!r}"
             )
-        N = len(self.shape_physical)
         nd_extra = uh.ndims_extra
-        inv_kind = "i" + self.transform
         x = uh
-        for d in range(N - 1, 0, -1):
-            axis = self._mem_axis(x.pencil, d)
-            data = self._local_fft(x.pencil, x.data, nd_extra, inv_kind,
-                                   axis)
-            x = PencilArray(x.pencil, data, x.extra_dims)
-            target = self._spectral_pencil_for(self._pencils[d - 1])
-            x = transpose(x, target, method=self.method)
-        axis = self._mem_axis(x.pencil, 0)
-        if self.real:
-            n0 = self.shape_physical[0]
-            data = self._local_fft(self._pencil0_spec, x.data, nd_extra,
-                                   "irfft", axis, n0)
-            # irfft output length n0 may exceed the padded extent rule for
-            # dim 0 only if dim 0 is decomposed — it is local here, so the
-            # shape is exact.
-            data = data.astype(self.dtype_physical)
-            return PencilArray(self._pencils[0], data, x.extra_dims)
-        data = self._local_fft(x.pencil, x.data, nd_extra, inv_kind, axis)
-        return PencilArray(self._pencils[0], data, x.extra_dims)
+        for step in reversed(self._steps):
+            if step[0] == "t":
+                x = transpose(x, step[1], method=self.method)
+            else:
+                _, pre, post, ops, pre_complex = step
+                data = _stage_fn(post, nd_extra, ops, True, pre_complex)(
+                    x.data)
+                x = PencilArray(pre, data, x.extra_dims)
+        if x.dtype != self.dtype_physical:
+            x = PencilArray(x.pencil, x.data.astype(self.dtype_physical),
+                            x.extra_dims)
+        return x
 
     # -- spectral helpers -------------------------------------------------
     def frequencies(self, d: int, *, spacing: float = 1.0):
         """Global frequency vector of logical dim ``d`` in CYCLES per
         unit for every transform kind (scale by ``2*pi`` for angular
         wavenumbers, as with ``fftfreq``): ``fftfreq``/``rfftfreq`` for
-        Fourier plans; for ``transform='dct'`` mode ``j`` (the basis
-        function ``cos(pi j (x+1/2)/n)``) has angular wavenumber
+        Fourier dims; for ``'dct'`` mode ``j`` (the basis function
+        ``cos(pi j (x+1/2)/n)``) has angular wavenumber
         ``pi j/(n spacing)``, i.e. ``j/(2 n spacing)`` cycles."""
         n = self.shape_physical[d]
-        if self.transform == "dct":
+        k = self.transforms[d]
+        if k == "none":
+            raise ValueError(f"dim {d} has transform 'none': no frequencies")
+        if k == "dct":
             return jnp.arange(n) / (2.0 * n * spacing)
-        if self.transform == "dst":
+        if k == "dst":
             # DST-II mode j is sin(pi (j+1) (x+1/2)/n): angular pi(j+1)/n
             return (jnp.arange(n) + 1.0) / (2.0 * n * spacing)
-        if self.real and d == 0:
+        if k == "rfft":
             return jnp.fft.rfftfreq(n, d=spacing)
         return jnp.fft.fftfreq(n, d=spacing)
 
@@ -318,9 +421,9 @@ class PencilFFTPlan:
         pencil — one array per logical dim, non-singleton only at the
         dim's memory position, sharded along its mesh axis.  Values are
         ``frequencies(d) * n_d``: integer Fourier modes for fft/rfft
-        plans; half-integer (j/2) / ((j+1)/2) mode numbers for dct/dst.
-        The spectral analog of localgrid components; shared by the
-        spectral models."""
+        dims; half-integer (j/2) / ((j+1)/2) mode numbers for dct/dst;
+        zeros for 'none' dims (no modal meaning).  The spectral analog of
+        localgrid components; shared by the spectral models."""
         from jax.sharding import NamedSharding, PartitionSpec
 
         pen = self.output_pencil
@@ -328,7 +431,10 @@ class PencilFFTPlan:
         mem_ids = pen.permutation.apply(tuple(range(N)))
         ks = []
         for d in range(N):
-            k = self.frequencies(d) * self.shape_physical[d]
+            if self.transforms[d] == "none":
+                k = jnp.zeros(self.shape_spectral[d])
+            else:
+                k = self.frequencies(d) * self.shape_physical[d]
             n_pad = pen.padded_global_shape[d]
             if n_pad != k.shape[0]:
                 k = jnp.pad(k, (0, n_pad - k.shape[0]))
@@ -344,9 +450,8 @@ class PencilFFTPlan:
         return tuple(ks)
 
     def __repr__(self) -> str:
-        kind = self.transform if self.transform != "fft" else (
-            "rfft" if self.real else "fft")
         return (
-            f"PencilFFTPlan({kind}, shape={self.shape_physical}, "
+            f"PencilFFTPlan({'x'.join(self.transforms)}, "
+            f"shape={self.shape_physical}, "
             f"topo={self.topology.dims}, permute={self.permute})"
         )

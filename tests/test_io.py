@@ -361,3 +361,20 @@ def test_rewrite_reuses_offset(tmp_path, pen):
     with open_file(BinaryDriver(), path, read=True) as f:
         np.testing.assert_array_equal(gather(f.read("u", pen)), w)
         np.testing.assert_array_equal(gather(f.read("v", pen)), v)
+
+
+def test_reuse_regions_opt_out(tmp_path, pen):
+    """reuse_regions=False restores append-only rewrites (crash-safe
+    rotation: old bytes survive until the sidecar re-flush)."""
+    u, x = make_data(pen, seed=4)
+    w, z = make_data(pen, seed=5)
+    path = str(tmp_path / "ao.bin")
+    drv = BinaryDriver(reuse_regions=False)
+    with open_file(drv, path, write=True, create=True) as f:
+        f.write("u", x)
+    size0 = os.path.getsize(path)
+    with open_file(drv, path, append=True, write=True) as f:
+        f.write("u", z)
+    assert os.path.getsize(path) == 2 * size0  # appended, not reused
+    with open_file(BinaryDriver(), path, read=True) as f:
+        np.testing.assert_array_equal(gather(f.read("u", pen)), w)
