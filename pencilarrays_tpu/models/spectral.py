@@ -68,12 +68,17 @@ class NavierStokesSpectral:
 
     @functools.cached_property
     def _ks(self):
-        """Cached broadcast-shaped 1-D wavenumber components (cheap: O(n)
-        memory each).  The derived 3-D fields (k2, 1/k2, dealias mask) are
-        deliberately NOT cached: computed inside the traced step they are
-        fused into the elementwise kernels and never materialized — at
-        1024^3 a cached full-size k2/inv_k2/mask trio would pin ~GBs."""
-        return self.plan.wavenumbers()
+        """Cached broadcast-shaped 1-D wavenumber components in LOGICAL
+        order (cheap: O(n) memory each), ready to broadcast against
+        PencilArrays — the model is written on the array abstraction, not
+        on raw ``.data`` (broadcasting interop, ``parallel/arrays.py``).
+        The derived 3-D fields (k2, 1/k2, dealias mask) are deliberately
+        NOT cached: computed inside the traced step they are fused into
+        the elementwise kernels and never materialized — at 1024^3 a
+        cached full-size k2/inv_k2/mask trio would pin ~GBs."""
+        from ..parallel.pencil import LogicalOrder
+
+        return self.plan.wavenumbers(LogicalOrder)
 
     def _spectral_operators(self):
         kx, ky, kz = self._ks
@@ -105,51 +110,46 @@ class NavierStokesSpectral:
         return self.plan.backward(uh)
 
     def _project(self, uh: PencilArray) -> PencilArray:
-        """Leray projection: remove the compressible part."""
+        """Leray projection: remove the compressible part.
+
+        Written on PencilArrays: components via :meth:`~..parallel.arrays.
+        PencilArray.component`, wavenumbers broadcast against the arrays
+        (logical-shape operands align to the parent layout with zero
+        collectives), re-assembled with ``PencilArray.stack``."""
         (kx, ky, kz), k2, inv_k2, _ = self._spectral_operators()
-        d = uh.data
+        u0, u1, u2 = (uh.component(i) for i in range(3))
         # P(u) = u - k (k.u) / |k|^2
-        kdotu = kx * d[..., 0] + ky * d[..., 1] + kz * d[..., 2]
-        corr = inv_k2 * kdotu
-        out = jnp.stack(
-            [d[..., 0] - kx * corr, d[..., 1] - ky * corr,
-             d[..., 2] - kz * corr], axis=-1)
-        return PencilArray(uh.pencil, out, uh.extra_dims)
+        corr = (u0 * kx + u1 * ky + u2 * kz) * inv_k2
+        return PencilArray.stack(
+            [u0 - corr * kx, u1 - corr * ky, u2 - corr * kz])
 
     # -- dynamics ---------------------------------------------------------
     def _nonlinear(self, uh: PencilArray) -> PencilArray:
         """Rotational-form nonlinear term, dealiased, in spectral space:
         ``P [ F(u x omega) ]``."""
         (kx, ky, kz), k2, inv_k2, mask = self._spectral_operators()
-        pen = uh.pencil
-        d = uh.data
+        u0, u1, u2 = (uh.component(i) for i in range(3))
         # vorticity in spectral space: omega = i k x u
-        wx = 1j * (ky * d[..., 2] - kz * d[..., 1])
-        wy = 1j * (kz * d[..., 0] - kx * d[..., 2])
-        wz = 1j * (kx * d[..., 1] - ky * d[..., 0])
+        wx = (u2 * ky - u1 * kz) * 1j
+        wy = (u0 * kz - u2 * kx) * 1j
+        wz = (u1 * kx - u0 * ky) * 1j
         # One 6-component backward chain for (u, omega) instead of two
         # 3-component ones: same FLOPs, HALF the inverse-transform
         # transposes (extra dims batch through the exchange for free)
-        both = PencilArray(
-            pen,
-            jnp.concatenate([d, jnp.stack([wx, wy, wz], axis=-1)], axis=-1),
-            (6,))
-        uw = self.plan.backward(both)
-        ud, wd = uw.data[..., :3], uw.data[..., 3:]
+        uw = self.plan.backward(
+            PencilArray.stack([u0, u1, u2, wx, wy, wz]))
+        a0, a1, a2, b0, b1, b2 = (uw.component(i) for i in range(6))
         # u x omega in physical space
-        cx = ud[..., 1] * wd[..., 2] - ud[..., 2] * wd[..., 1]
-        cy = ud[..., 2] * wd[..., 0] - ud[..., 0] * wd[..., 2]
-        cz = ud[..., 0] * wd[..., 1] - ud[..., 1] * wd[..., 0]
-        c = PencilArray(uw.pencil, jnp.stack([cx, cy, cz], axis=-1), (3,))
+        c = PencilArray.stack([a1 * b2 - a2 * b1,
+                               a2 * b0 - a0 * b2,
+                               a0 * b1 - a1 * b0])
         ch = self.plan.forward(c)
         # dealias + project: P(c) = c - k (k.c) / |k|^2
-        cd = ch.data * mask[..., None]
-        kdotc = kx * cd[..., 0] + ky * cd[..., 1] + kz * cd[..., 2]
-        corr = inv_k2 * kdotc
-        out = jnp.stack([cd[..., 0] - kx * corr,
-                         cd[..., 1] - ky * corr,
-                         cd[..., 2] - kz * corr], axis=-1)
-        return PencilArray(pen, out, (3,))
+        chm = ch * mask[..., None]
+        c0, c1, c2 = (chm.component(i) for i in range(3))
+        corr = (c0 * kx + c1 * ky + c2 * kz) * inv_k2
+        return PencilArray.stack(
+            [c0 - corr * kx, c1 - corr * ky, c2 - corr * kz])
 
     def step(self, uh: PencilArray, dt: float) -> PencilArray:
         """One RK2 (Heun) step with exact viscous integrating factor.
@@ -161,13 +161,11 @@ class NavierStokesSpectral:
         program.
         """
         (_, _, _), k2, _, _ = self._spectral_operators()
-        e = jnp.exp(-self.nu * k2 * dt)[..., None]
+        e = jnp.exp(-self.nu * k2 * dt)[..., None]  # broadcasts over comps
         n1 = self._nonlinear(uh)
-        u1 = PencilArray(uh.pencil, (uh.data + dt * n1.data) * e,
-                         uh.extra_dims)
+        u1 = (uh + n1 * dt) * e
         n2 = self._nonlinear(u1)
-        out = (uh.data + 0.5 * dt * n1.data) * e + 0.5 * dt * n2.data
-        return PencilArray(uh.pencil, out, uh.extra_dims)
+        return (uh + n1 * (0.5 * dt)) * e + n2 * (0.5 * dt)
 
     def simulate(self, uh: PencilArray, dt: float, n_steps: int,
                  *, record_energy: bool = False):

@@ -416,14 +416,32 @@ class PencilFFTPlan:
             return jnp.fft.rfftfreq(n, d=spacing)
         return jnp.fft.fftfreq(n, d=spacing)
 
-    def wavenumbers(self):
-        """Broadcast-shaped, sharded mode-number components of the OUTPUT
-        pencil — one array per logical dim, non-singleton only at the
-        dim's memory position, sharded along its mesh axis.  Values are
-        ``frequencies(d) * n_d``: integer Fourier modes for fft/rfft
-        dims; half-integer (j/2) / ((j+1)/2) mode numbers for dct/dst;
-        zeros for 'none' dims (no modal meaning).  The spectral analog of
-        localgrid components; shared by the spectral models."""
+    def wavenumbers(self, order: type = MemoryOrder):
+        """Broadcast-shaped mode-number components of the OUTPUT pencil —
+        one array per logical dim.  Values are ``frequencies(d) * n_d``:
+        integer Fourier modes for fft/rfft dims; half-integer (j/2) /
+        ((j+1)/2) mode numbers for dct/dst; zeros for 'none' dims (no
+        modal meaning).  The spectral analog of localgrid components.
+
+        ``order=MemoryOrder`` (default): non-singleton at each dim's
+        memory position, padded and sharded along its mesh axis — for
+        arithmetic against raw ``.data``.  ``order=LogicalOrder``:
+        true-size, non-singleton at logical position ``d`` — for
+        arithmetic against PencilArrays, whose broadcasting aligns raw
+        operands to the logical shape (``parallel/arrays.py``)."""
+        if order is LogicalOrder:
+            ks = []
+            N = len(self.shape_spectral)
+            for d in range(N):
+                if self.transforms[d] == "none":
+                    k = jnp.zeros(self.shape_spectral[d])
+                else:
+                    k = self.frequencies(d) * self.shape_physical[d]
+                shape = [1] * N
+                shape[d] = self.shape_spectral[d]
+                ks.append(k.reshape(shape))
+            return tuple(ks)
+
         from jax.sharding import NamedSharding, PartitionSpec
 
         pen = self.output_pencil

@@ -354,6 +354,134 @@ class PencilArray:
     def __jax_array__(self):
         return self.logical()
 
+    # -- broadcasting interop (reference broadcast.jl:15-89) --------------
+    # The reference gives PencilArray full participation in Julia's
+    # broadcast machinery: mixed PencilArray/scalar/array operands, style
+    # resolution where PencilArrayStyle beats plain array styles, all
+    # running on the *parents* in memory order with zero layout churn
+    # (``broadcast.jl:31-57``).  The Python analog is the NumPy
+    # ``__array_ufunc__`` protocol: ``np.cos(u)``, ``np.add(u, v)`` and
+    # ``u * raw_array`` all dispatch here, run on the memory-order padded
+    # parent, and return PencilArrays.  Raw operands are interpreted
+    # against the LOGICAL global shape under standard (right-aligned)
+    # NumPy broadcasting rules, then permuted/padded to the parent
+    # layout — a few 1-D-ish ops XLA fuses away, never a collective.
+    #
+    # Divergence: ``jnp.*`` functions have no third-party dispatch
+    # protocol; ``jnp.cos(u)`` works via ``__jax_array__`` but returns a
+    # plain logical-order jax.Array (and costs the logical() permute).
+    # Keep PencilArray on the left of mixed infix expressions, or use the
+    # ``np.*`` ufunc spellings / ``u.map(jnp.cos)``.
+
+    def _align_to_parent(self, arr):
+        """Broadcast a raw array against the logical global shape, then
+        permute/pad it into the parent's memory-order padded layout.
+        Tail padding is zero-filled (inert: reductions mask it,
+        transposes slice it)."""
+        arr = jnp.asarray(arr)
+        nd_extra = len(self._extra_dims)
+        logical = self._pencil.size_global(LogicalOrder) + self._extra_dims
+        if arr.ndim > len(logical):
+            raise ValueError(
+                f"operand rank {arr.ndim} exceeds array rank {len(logical)}")
+        shape = (1,) * (len(logical) - arr.ndim) + tuple(arr.shape)
+        for s, n in zip(shape, logical):
+            if s not in (1, n):
+                raise ValueError(
+                    f"operand shape {tuple(arr.shape)} not broadcastable "
+                    f"to logical shape {logical}")
+        arr = arr.reshape(shape)
+        arr = jnp.transpose(arr, _fwd_axes(self._pencil, nd_extra))
+        padded = self._pencil.padded_size_global(MemoryOrder) + self._extra_dims
+        pad = [(0, p - s) if s != 1 else (0, 0)
+               for s, p in zip(arr.shape, padded)]
+        if any(p != (0, 0) for p in pad):
+            arr = jnp.pad(arr, pad)
+        return arr
+
+    @staticmethod
+    def _is_scalar(x) -> bool:
+        return isinstance(x, (int, float, complex, bool, np.generic)) or (
+            hasattr(x, "shape") and getattr(x, "shape", None) == ()
+        )
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__":
+            return NotImplemented
+        if kwargs.pop("out", None) is not None or kwargs:
+            return NotImplemented  # out=/where=/casting= unsupported
+        if getattr(ufunc, "signature", None) is not None or ufunc.nout != 1:
+            # only elementwise single-output ufuncs act on the memory-order
+            # parent: a gufunc (np.matmul) would contract over a MEMORY
+            # axis (wrong logical axis, padding included), and nout>1
+            # (np.modf) has no single wrapped result
+            return NotImplemented
+        f = getattr(jnp, ufunc.__name__, None)
+        if f is None:
+            return NotImplemented
+        args = []
+        for x in inputs:
+            if isinstance(x, PencilArray):
+                if (x._pencil != self._pencil
+                        or x._extra_dims != self._extra_dims):
+                    raise ValueError(
+                        "operands live on different pencils; transpose "
+                        "first (cf. reference broadcast.jl which requires "
+                        "matching pencil configurations)"
+                    )
+                args.append(x._data)
+            elif self._is_scalar(x):
+                args.append(x)
+            elif isinstance(x, (np.ndarray, jax.Array, list, tuple)):
+                args.append(self._align_to_parent(x))
+            else:
+                return NotImplemented
+        return PencilArray(self._pencil, f(*args), self._extra_dims)
+
+    def __array_function__(self, func, types, args, kwargs):
+        """Whitelisted NumPy free functions (``np.sum(u)`` etc.) forward
+        to the padding-masked distributed reductions."""
+        from ..ops import reductions
+
+        table = {
+            np.sum: reductions.sum,
+            np.prod: reductions.prod,
+            np.mean: reductions.mean,
+            np.min: reductions.minimum,
+            np.max: reductions.maximum,
+            np.all: reductions.all,
+            np.any: reductions.any,
+            np.count_nonzero: reductions.count_nonzero,
+        }
+        f = table.get(func)
+        if (f is None or kwargs or len(args) != 1
+                or not isinstance(args[0], PencilArray)):
+            return NotImplemented
+        return f(args[0])
+
+    # -- extra-dims components -------------------------------------------
+    def component(self, *idx: int) -> "PencilArray":
+        """The spatial field at extra-dims index ``idx`` (one index per
+        extra dim) as a PencilArray with ``extra_dims=()`` — zero-copy at
+        trace time (a trailing-axis slice of the parent)."""
+        if len(idx) != len(self._extra_dims):
+            raise ValueError(
+                f"component expects {len(self._extra_dims)} indices, "
+                f"got {len(idx)}")
+        data = self._data[(Ellipsis,) + tuple(int(i) for i in idx)]
+        return PencilArray(self._pencil, data, ())
+
+    @classmethod
+    def stack(cls, components: Sequence["PencilArray"]) -> "PencilArray":
+        """Stack same-pencil arrays along a NEW trailing extra dim (the
+        inverse of :meth:`component`)."""
+        first = components[0]
+        for c in components[1:]:
+            if c._pencil != first._pencil or c._extra_dims != first._extra_dims:
+                raise ValueError("stack: pencil/extra_dims mismatch")
+        data = jnp.stack([c._data for c in components], axis=-1)
+        return cls(first._pencil, data, first._extra_dims + (len(components),))
+
     # -- arithmetic (memory-order, parent-level: broadcast.jl parity) -----
     def _binop(self, other, op):
         if isinstance(other, PencilArray):
@@ -370,10 +498,14 @@ class PencilArray:
                 )
             return PencilArray(self._pencil, op(self._data, other._data),
                                self._extra_dims)
-        if isinstance(other, (int, float, complex, jnp.ndarray, np.ndarray)) and (
-            not hasattr(other, "shape") or other.shape == ()
-        ):
+        if self._is_scalar(other):
             return PencilArray(self._pencil, op(self._data, other),
+                               self._extra_dims)
+        if isinstance(other, (np.ndarray, jax.Array, list, tuple)):
+            # raw array broadcastable against the logical shape: align to
+            # the parent layout (zero collectives, see broadcasting note)
+            return PencilArray(self._pencil,
+                               op(self._data, self._align_to_parent(other)),
                                self._extra_dims)
         return NotImplemented
 

@@ -1,0 +1,164 @@
+"""Broadcasting interop — the Python analog of ``test/broadcast.jl:37-74``.
+
+The reference checks that PencilArray participates in Julia's broadcast
+machinery: mixed operands, style resolution (PencilArrayStyle beats plain
+array styles), operations running on parents with zero allocations
+(``broadcast.jl:38-40``).  Here the analogs are the NumPy
+``__array_ufunc__``/``__array_function__`` protocols, raw-operand
+alignment to the parent layout, and a zero-extra-collectives HLO guard.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pencilarrays_tpu import (
+    Pencil, PencilArray, Permutation, Topology, gather,
+)
+
+
+@pytest.fixture
+def topo(devices):
+    return Topology((2, 4))
+
+
+@pytest.fixture
+def pen(topo):
+    # permuted + ragged so alignment must permute AND pad
+    return Pencil(topo, (13, 11, 9), (1, 2), permutation=Permutation(2, 0, 1))
+
+
+def make(pen, seed=0):
+    u = np.random.default_rng(seed).standard_normal(pen.size_global())
+    return u, PencilArray.from_global(pen, u)
+
+
+def test_np_ufunc_unary(pen):
+    u, x = make(pen)
+    y = np.cos(x)
+    assert isinstance(y, PencilArray) and y.pencil == pen
+    np.testing.assert_allclose(gather(y), np.cos(u), rtol=1e-12)
+
+
+def test_np_ufunc_binary_pencil_pencil(pen):
+    u, x = make(pen, 1)
+    v, y = make(pen, 2)
+    z = np.add(x, y)
+    assert isinstance(z, PencilArray)
+    np.testing.assert_allclose(gather(z), u + v, rtol=1e-12)
+    z = np.arctan2(x, y)
+    assert isinstance(z, PencilArray)
+    np.testing.assert_allclose(gather(z), np.arctan2(u, v), rtol=1e-12)
+
+
+def test_style_resolution_raw_left(pen):
+    """np.add(raw, u): ndarray defers to PencilArray's protocol — the
+    analog of PencilArrayStyle beating DefaultArrayStyle
+    (``broadcast.jl:15-29``)."""
+    u, x = make(pen, 3)
+    raw = np.linspace(0, 1, 9).reshape(1, 1, 9)
+    z = np.add(raw, x)
+    assert isinstance(z, PencilArray)
+    np.testing.assert_allclose(gather(z), raw + u, rtol=1e-12)
+
+
+def test_infix_with_broadcast_raw(pen):
+    """PencilArray-vs-raw-array expressions: operands are interpreted
+    against the LOGICAL shape (right-aligned numpy rules), permuted and
+    padded to the parent layout."""
+    u, x = make(pen, 4)
+    kx = np.linspace(0, 1, 13).reshape(13, 1, 1)
+    kz = np.linspace(2, 3, 9)  # rank-1: right-aligns to last logical dim
+    z = x * kx + x * kz
+    assert isinstance(z, PencilArray)
+    np.testing.assert_allclose(gather(z), u * kx + u * kz, rtol=1e-12)
+    z = (x + 1.0) / 2.0  # scalars still fine
+    np.testing.assert_allclose(gather(z), (u + 1.0) / 2.0, rtol=1e-12)
+
+
+def test_full_shape_raw_operand(pen):
+    """A full logical-shape raw operand is permuted+padded to the parent."""
+    u, x = make(pen, 5)
+    w = np.random.default_rng(6).standard_normal(pen.size_global())
+    z = x + w
+    np.testing.assert_allclose(gather(z), u + w, rtol=1e-12)
+
+
+def test_not_broadcastable_raises(pen):
+    _, x = make(pen)
+    with pytest.raises(ValueError, match="broadcastable"):
+        _ = x + np.zeros((2, 11, 9))
+
+
+def test_pencil_mismatch_raises(pen, topo):
+    _, x = make(pen)
+    pen2 = Pencil(topo, (13, 11, 9), (0, 2))
+    y = PencilArray.zeros(pen2, dtype=x.dtype)
+    with pytest.raises(ValueError, match="different pencils"):
+        np.add(x, y)
+
+
+def test_np_reductions_forward_to_masked(pen):
+    """np.sum/np.max on a PencilArray route to the padding-masked
+    distributed reductions (padding garbage never leaks in)."""
+    u, x = make(pen, 7)
+    # poison the padding: scalar arithmetic touches padded entries too
+    x2 = (x + 100.0) - 100.0
+    assert np.isclose(float(np.sum(x2)), u.sum(), rtol=1e-8)
+    assert np.isclose(float(np.max(x2)), u.max(), rtol=1e-12)
+    assert np.isclose(float(np.mean(x2)), u.mean(), rtol=1e-8)
+
+
+def test_component_stack_roundtrip(pen):
+    rng = np.random.default_rng(8)
+    u = rng.standard_normal(pen.size_global() + (3,))
+    x = PencilArray.from_global(pen, u)
+    comps = [x.component(i) for i in range(3)]
+    assert comps[0].extra_dims == ()
+    np.testing.assert_allclose(gather(comps[1]), u[..., 1], rtol=1e-12)
+    back = PencilArray.stack(comps)
+    assert back.extra_dims == (3,)
+    np.testing.assert_allclose(gather(back), u, rtol=1e-12)
+
+
+def test_broadcast_zero_extra_collectives(pen):
+    """The HLO analog of the reference's zero-allocation broadcast
+    assertion (``test/broadcast.jl:38-40``): a mixed
+    PencilArray/raw/scalar expression compiles with NO collectives."""
+    _, x = make(pen, 9)
+    kx = jnp.linspace(0, 1, 13).reshape(13, 1, 1)
+
+    def f(d):
+        a = PencilArray(x.pencil, d)
+        return (np.cos(a) * kx + a * 2.0).data
+
+    hlo = jax.jit(f).lower(x.data).compile().as_text()
+    for op in ("all-to-all", "all-gather", "all-reduce",
+               "collective-permute"):
+        assert not re.findall(rf" {op}\(", hlo), op
+
+
+def test_jnp_escape_hatch(pen):
+    """jnp.* has no third-party dispatch: jnp.cos(u) works via
+    __jax_array__ but returns a plain logical-order jax.Array
+    (documented divergence; use np.cos(u) or u.map(jnp.cos) to stay
+    wrapped)."""
+    u, x = make(pen, 10)
+    y = jnp.cos(x)
+    assert not isinstance(y, PencilArray)
+    assert y.shape == x.shape  # true logical shape
+    np.testing.assert_allclose(np.asarray(y), np.cos(u), rtol=1e-12)
+
+
+def test_gufunc_and_multi_output_rejected(pen):
+    """Only elementwise single-output ufuncs dispatch to the parent: a
+    gufunc would contract over a memory-order axis (wrong logical axis),
+    and nout>1 has no single wrapped result."""
+    _, x = make(pen, 11)
+    with pytest.raises(TypeError):
+        np.matmul(x, x)
+    with pytest.raises(TypeError):
+        np.modf(x)
