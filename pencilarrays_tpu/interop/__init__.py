@@ -1,0 +1,3 @@
+"""Ecosystem interop extensions (the role of the reference's ``ext/``)."""
+
+from .diffrax_ext import global_wrms_norm, diffrax_available, diffeqsolve  # noqa: F401

@@ -1,0 +1,106 @@
+"""diffrax interop — the analog of the reference's DiffEq extension.
+
+The reference ships an 11-line package extension
+(``ext/PencilArraysDiffEqExt.jl:5-9``) whose entire job is to make a
+*third-party* adaptive ODE integrator globally consistent: it overloads
+the error norm (``UNITLESS_ABS2`` / ``recursive_length``) so every MPI
+rank computes the same WRMS error and therefore chooses the same ``dt``
+(property pinned by reference ``test/ode.jl:59-74``).
+
+The JAX-ecosystem integrator is `diffrax <https://docs.kidger.site/diffrax>`_.
+Two facts make the interop thin here too:
+
+1. **PencilArray is a registered pytree** — ``diffrax.diffeqsolve`` can
+   carry it as the state ``y`` unchanged (flatten → sharded jax.Array
+   leaf → unflatten).
+2. **The error norm is the only global hook** — diffrax's
+   ``PIDController(norm=...)`` accepts any ``pytree -> scalar``; passing
+   :func:`global_wrms_norm` makes the controller's scalar derive from
+   padding-masked *global* reductions, so the accepted/rejected steps and
+   the next ``dt`` are identical under every decomposition (single
+   controller, single program — under SPMD there is one trace, so unlike
+   MPI there is no per-rank divergence to begin with; the norm hook's
+   job is masking the padding, which plain ``sqrt(mean(y**2))`` over the
+   raw leaves would corrupt).
+
+``diffrax`` is not bundled in every image; :func:`diffeqsolve` raises a
+clear error when it is missing, and the calling convention (pytree
+state + ``norm=`` hook, here :func:`global_wrms_norm`) is exercised
+against a stand-in controller in ``tests/test_diffrax_interop.py`` so
+the hook cannot rot.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.arrays import PencilArray
+
+__all__ = ["global_wrms_norm", "diffrax_available", "diffeqsolve"]
+
+
+def diffrax_available() -> bool:
+    try:
+        import diffrax  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def global_wrms_norm(y: Any) -> jax.Array:
+    """RMS norm over a pytree that treats PencilArray leaves GLOBALLY:
+    padding masked, true global element count — the
+    ``UNITLESS_ABS2``/``recursive_length`` overloads of the reference ext
+    (``ext/PencilArraysDiffEqExt.jl:5-9``) in one function.
+
+    Signature matches ``diffrax.PIDController(norm=...)``: pytree in,
+    non-negative scalar out.  Non-PencilArray leaves contribute their
+    plain sum-of-squares/length, so mixed states (e.g. a PencilArray
+    field plus scalar auxiliaries) work.
+    """
+    from ..ops import reductions
+
+    sumsq = jnp.zeros(())
+    count = jnp.zeros(())
+    leaves = jax.tree_util.tree_leaves(
+        y, is_leaf=lambda x: isinstance(x, PencilArray))
+    for leaf in leaves:
+        if isinstance(leaf, PencilArray):
+            s = reductions.mapreduce(
+                lambda d: jnp.abs(d) ** 2, jnp.sum, leaf, identity=0)
+            n = leaf.length_global()
+        else:
+            arr = jnp.asarray(leaf)
+            s = jnp.sum(jnp.abs(arr) ** 2)
+            n = arr.size
+        sumsq = sumsq + s.astype(sumsq.dtype)
+        count = count + n
+    return jnp.sqrt(sumsq / jnp.maximum(count, 1))
+
+
+def diffeqsolve(terms, solver, t0, t1, dt0, y0, *, rtol=1e-6, atol=1e-9,
+                **kwargs):
+    """``diffrax.diffeqsolve`` with the global-norm controller wired in —
+    the whole extension, as in the reference (the state ``y0`` may be a
+    PencilArray or any pytree containing them).
+
+    Extra ``kwargs`` pass through; a ``stepsize_controller`` kwarg
+    overrides the default ``PIDController(rtol, atol,
+    norm=global_wrms_norm)``.
+    """
+    if not diffrax_available():
+        raise ImportError(
+            "diffrax is not installed in this environment; "
+            "pencilarrays_tpu.interop.diffeqsolve needs it (the "
+            "global_wrms_norm hook itself has no diffrax dependency)")
+    import diffrax
+
+    controller = kwargs.pop(
+        "stepsize_controller",
+        diffrax.PIDController(rtol=rtol, atol=atol, norm=global_wrms_norm))
+    return diffrax.diffeqsolve(
+        terms, solver, t0=t0, t1=t1, dt0=dt0, y0=y0,
+        stepsize_controller=controller, **kwargs)

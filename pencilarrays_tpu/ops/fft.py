@@ -429,17 +429,19 @@ class PencilFFTPlan:
         true-size, non-singleton at logical position ``d`` — for
         arithmetic against PencilArrays, whose broadcasting aligns raw
         operands to the logical shape (``parallel/arrays.py``)."""
+        def mode_vector(d):
+            # one definition serves both orders
+            if self.transforms[d] == "none":
+                return jnp.zeros(self.shape_spectral[d])
+            return self.frequencies(d) * self.shape_physical[d]
+
         if order is LogicalOrder:
             ks = []
             N = len(self.shape_spectral)
             for d in range(N):
-                if self.transforms[d] == "none":
-                    k = jnp.zeros(self.shape_spectral[d])
-                else:
-                    k = self.frequencies(d) * self.shape_physical[d]
                 shape = [1] * N
                 shape[d] = self.shape_spectral[d]
-                ks.append(k.reshape(shape))
+                ks.append(mode_vector(d).reshape(shape))
             return tuple(ks)
 
         from jax.sharding import NamedSharding, PartitionSpec
@@ -449,10 +451,7 @@ class PencilFFTPlan:
         mem_ids = pen.permutation.apply(tuple(range(N)))
         ks = []
         for d in range(N):
-            if self.transforms[d] == "none":
-                k = jnp.zeros(self.shape_spectral[d])
-            else:
-                k = self.frequencies(d) * self.shape_physical[d]
+            k = mode_vector(d)
             n_pad = pen.padded_global_shape[d]
             if n_pad != k.shape[0]:
                 k = jnp.pad(k, (0, n_pad - k.shape[0]))

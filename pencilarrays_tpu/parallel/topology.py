@@ -135,10 +135,30 @@ class Topology:
 
     @classmethod
     def from_mesh(cls, mesh: Mesh) -> "Topology":
+        """Adopt an existing ``jax.sharding.Mesh`` as a topology.
+
+        Validates what the constructor validates: positive dims, unique
+        axis names — plus ``Auto`` axis types, since ``Explicit``/
+        ``Manual`` meshes reject the ``shard_map`` collectives the
+        transpose engine issues (the failure would otherwise surface
+        later as an opaque shard_map error)."""
+        bad = [str(t) for t in getattr(mesh, "axis_types", ())
+               if t != AxisType.Auto]
+        if bad:
+            raise ValueError(
+                f"from_mesh requires Auto axis types, got {bad}; build the "
+                f"mesh with axis_types=(AxisType.Auto, ...) or use the "
+                f"Topology constructor")
+        axis_names = tuple(mesh.axis_names)
+        if len(set(axis_names)) != len(axis_names):
+            raise ValueError(f"duplicate axis names: {axis_names}")
+        dims = tuple(int(d) for d in mesh.devices.shape)
+        if any(d <= 0 for d in dims):
+            raise ValueError(f"topology dims must be positive: {dims}")
         t = cls.__new__(cls)
         t._mesh = mesh
-        t._dims = tuple(mesh.devices.shape)
-        t._axis_names = tuple(mesh.axis_names)
+        t._dims = dims
+        t._axis_names = axis_names
         return t
 
     # -- accessors --------------------------------------------------------

@@ -250,41 +250,78 @@ def _transpose_local(data, pin: Pencil, pout: Pencil, extra_ndims: int):
 
 def _transpose_ring(data, pin: Pencil, pout: Pencil, R: int,
                     extra_ndims: int):
-    """Like :func:`_transpose_all_to_all`, but the exchange is P-1 shifted
-    ``ppermute`` rounds of single tiles."""
+    """Like :func:`_transpose_all_to_all`, but the exchange is staged
+    shifted ``ppermute`` rounds of single tiles — and it is RAGGED-AWARE.
+
+    Bytes-on-the-wire model (vs reference ``Transpositions.jl:383-389``,
+    which sends exact per-peer intersection ranges): under XLA SPMD every
+    round's tile must have ONE static shape across devices, while the
+    true intersection extents vary per (source, dest) pair — so exact
+    intersection-size transfers are unrepresentable, and for dense
+    configurations padded-uniform tiles are already optimal.  What IS
+    statically known is which ceil-rule blocks are *entirely empty*:
+    with ``n`` true elements in ``P`` blocks of ``ceil(n/P)``, only the
+    first ``S = ceil(n / ceil(n/P))`` devices own data.  The ring
+    therefore runs ``G-1`` rounds among the first
+    ``G = max(S_a, S_b)`` participants instead of ``P-1``: for the
+    pathological raggedness the padded scheme is worst at (``n`` barely
+    above ``P``), this removes most of the pure-padding traffic —
+    e.g. ``n_a = n_b = 9, P = 8`` runs 4 rounds instead of 7.
+    Structurally-empty destination blocks are zero-filled, keeping the
+    padding-is-zeros invariant and bit-identity with :class:`AllToAll`.
+    """
     def factory(axis, P, a, b):
+        n_a = pin.size_global()[a]
+        n_b = pin.size_global()[b]
+        a_blk = pin.padded_global_shape[a] // P
+        b_blk = pout.padded_global_shape[b] // P
+        S_a = -(-n_a // a_blk)  # nonempty source blocks (ceil division)
+        S_b = -(-n_b // b_blk)  # nonempty destination blocks
+        G = max(S_a, S_b)       # ring participants
+
         def exchange(x):
-            chunk = x.shape[b] // P
             tiles = jnp.stack(
-                [jax.lax.slice_in_dim(x, j * chunk, (j + 1) * chunk, axis=b)
-                 for j in range(P)], axis=0)
+                [jax.lax.slice_in_dim(x, j * b_blk, (j + 1) * b_blk, axis=b)
+                 for j in range(G)], axis=0)
             me = jax.lax.axis_index(axis).astype(jnp.int32)
             # received[s] must hold sender s's tile for me; my own tile
-            # seeds the buffer, round r delivers sender (me - r)'s
+            # seeds the buffer, round r delivers sender (me - r)'s.
+            # (Devices >= G hold only padding; their clamped seeds and
+            # received zeros are overwritten by the final mask.)
             received = jnp.zeros_like(tiles)
             own = jax.lax.dynamic_index_in_dim(tiles, me, axis=0)
             received = jax.lax.dynamic_update_index_in_dim(
                 received, own, me, axis=0)
             # one round per shift r (unrolled: each round's ppermute has a
-            # distinct static permutation; P-1 rounds total)
-            for r in range(1, P):
-                # every device sends tile[(me + r) % P] to peer (me + r)
+            # distinct static permutation; G-1 rounds total, only the
+            # nonempty participants exchange)
+            for r in range(1, G):
+                # participant i sends tile[(i + r) % G] to peer (i + r) % G
                 send = jax.lax.dynamic_index_in_dim(
-                    tiles, jax.lax.rem(me + jnp.int32(r), jnp.int32(P)),
+                    tiles, jax.lax.rem(me + jnp.int32(r), jnp.int32(G)),
                     axis=0)
                 moved = jax.lax.ppermute(
-                    send, axis, [(i, (i + r) % P) for i in range(P)])
+                    send, axis, [(i, (i + r) % G) for i in range(G)])
                 # moved holds sender (me - r)'s tile for me
-                src = jax.lax.rem(me - jnp.int32(r) + jnp.int32(P),
-                                  jnp.int32(P))
+                src = jax.lax.rem(me - jnp.int32(r) + jnp.int32(G),
+                                  jnp.int32(G))
                 received = jax.lax.dynamic_update_index_in_dim(
                     received, moved, src, axis=0)
             # merge the sender axis into dim a (sender order = global
-            # padded order, as with tiled all_to_all)
+            # padded order, as with tiled all_to_all); senders >= G hold
+            # no true rows (G >= S_a), appended as zeros
             out = jnp.moveaxis(received, 0, a)
             shape = list(out.shape)
             shape[a:a + 2] = [shape[a] * shape[a + 1]]
-            return out.reshape(shape)
+            out = out.reshape(shape)
+            # dim a now has G*a_blk >= n_a rows; the unpack slices to n_a.
+            if G < P:
+                # destinations >= S_b own only padding columns, and
+                # devices >= G saw clamped seeds: zero-fill their blocks
+                # (padding-is-zeros invariant, bit-identity with AllToAll)
+                out = jnp.where(me < jnp.int32(S_b), out,
+                                jnp.zeros_like(out))
+            return out
 
         return exchange
 

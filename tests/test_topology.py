@@ -70,3 +70,19 @@ def test_subset_of_devices(devices):
     t = Topology((2, 2), devices=devices[:4])
     assert len(t) == 4
     assert t.device((0, 0)).id == devices[0].id
+
+
+def test_from_mesh_validates(devices):
+    """from_mesh applies constructor-grade validation (ADVICE r1 weak #8):
+    Explicit axis types would fail later with an opaque shard_map error."""
+    import numpy as np
+    from jax.sharding import AxisType, Mesh
+
+    dev = np.array(devices, dtype=object).reshape(2, 4)
+    ok = Mesh(dev, ("a", "b"), axis_types=(AxisType.Auto,) * 2)
+    t = Topology.from_mesh(ok)
+    assert t.dims == (2, 4)
+    bad = Mesh(dev, ("a", "b"),
+               axis_types=(AxisType.Explicit, AxisType.Auto))
+    with pytest.raises(ValueError, match="Auto axis types"):
+        Topology.from_mesh(bad)

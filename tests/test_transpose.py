@@ -217,3 +217,49 @@ def test_1d_slab_topology(devices):
         for m in METHODS:
             y = transpose(x, pen_b, method=m)
             np.testing.assert_array_equal(gather(y), u)
+
+
+def test_ring_ragged_skips_empty_rounds(topo):
+    """Ragged-aware Ring: with n=9 over P=4 (ceil blocks of 3 -> only 3
+    nonempty blocks) the ring runs G-1=2 ppermute rounds instead of P-1=3,
+    bit-identical to AllToAll.  The reference sends exact intersection
+    ranges (Transpositions.jl:383-389); under SPMD static shapes the
+    achievable analog is statically skipping structurally-empty rounds."""
+    import re
+
+    shape = (9, 16, 9)
+    pen_x = Pencil(topo, shape, (1, 2))
+    pen_y = Pencil(topo, shape, (1, 0))  # differ in slot 1: P=4 axis
+    rng = np.random.default_rng(40)
+    u = rng.standard_normal(shape)
+    x = PencilArray.from_global(pen_x, u)
+
+    y_ring = transpose(x, pen_y, method=Ring())
+    y_a2a = transpose(x, pen_y, method=AllToAll())
+    np.testing.assert_array_equal(gather(y_ring), u)
+    np.testing.assert_array_equal(np.asarray(y_ring.data),
+                                  np.asarray(y_a2a.data))  # incl. padding
+
+    hlo = jax.jit(
+        lambda d: transpose(PencilArray(pen_x, d), pen_y,
+                            method=Ring()).data
+    ).lower(x.data).compile().as_text()
+    n_pp = len(re.findall(r" collective-permute\(", hlo))
+    assert n_pp == 2, n_pp  # G-1, not P-1
+
+
+@pytest.mark.parametrize("n_ab", [(5, 9), (13, 9), (9, 13), (6, 2), (1, 9)])
+def test_ring_ragged_asymmetric_bit_identity(topo, n_ab):
+    """Asymmetric raggedness (S_a != S_b, and G == P with S_b < P):
+    Ring must stay bit-identical to AllToAll including padding content."""
+    n_a, n_b = n_ab
+    shape = (n_b, 16, n_a)
+    pen_x = Pencil(topo, shape, (1, 2))
+    pen_y = Pencil(topo, shape, (1, 0))  # exchange over the P=4 axis
+    u = np.random.default_rng(41).standard_normal(shape)
+    x = PencilArray.from_global(pen_x, u)
+    y_ring = transpose(x, pen_y, method=Ring())
+    y_a2a = transpose(x, pen_y, method=AllToAll())
+    np.testing.assert_array_equal(gather(y_ring), u)
+    np.testing.assert_array_equal(np.asarray(y_ring.data),
+                                  np.asarray(y_a2a.data))
