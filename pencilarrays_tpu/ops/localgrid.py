@@ -116,20 +116,49 @@ class LocalRectilinearGrid:
         """All broadcastable components (reference ``components(g)``)."""
         return tuple(self[d] for d in range(self.ndims))
 
+    def _wrap(self, val, extra_dims: Tuple[int, ...]) -> PencilArray:
+        """Broadcast a memory-order value to the padded target, apply the
+        pencil sharding, wrap — shared result-materialization tail of
+        :meth:`evaluate` and :meth:`zip_with`."""
+        pen = self._pencil
+        target = pen.padded_size_global(MemoryOrder) + tuple(extra_dims)
+        val = jnp.broadcast_to(val, target)
+        val = jax.lax.with_sharding_constraint(
+            val, pen.sharding(len(extra_dims)))
+        return PencilArray(pen, val, tuple(extra_dims))
+
     def evaluate(self, f: Callable, extra_dims: Tuple[int, ...] = ()) -> PencilArray:
         """``u = f(x, y, z, ...)`` broadcast over the grid, returned as a
         PencilArray — the fused grid-broadcast pattern of
         ``README.md:101`` / ``benchmarks/grids.jl``."""
         val = f(*self.components())
-        pen = self._pencil
-        target = pen.padded_size_global(MemoryOrder) + tuple(extra_dims)
         if extra_dims:
             # keep spatial dims left-aligned: extras are trailing singletons
             val = val.reshape(val.shape + (1,) * len(extra_dims))
-        val = jnp.broadcast_to(val, target)
-        val = jax.lax.with_sharding_constraint(
-            val, pen.sharding(len(extra_dims)))
-        return PencilArray(pen, val, tuple(extra_dims))
+        return self._wrap(val, extra_dims)
+
+    def zip_with(self, f: Callable, *arrays: PencilArray) -> PencilArray:
+        """``v = f(u1, ..., x, y, z)`` fused elementwise over array
+        values and grid coordinates — the ``zip(eachindex(u), grid)``
+        iteration style of ``benchmarks/grids.jl:117`` as ONE XLA kernel
+        (values and coordinates stream together in memory order, no
+        index arithmetic).  Arrays must live on this grid's pencil and
+        share extra dims; grid components broadcast over extra dims."""
+        pen = self._pencil
+        for a in arrays:
+            if a.pencil != pen:
+                raise ValueError(
+                    "zip_with: array pencil differs from grid pencil")
+        extra = arrays[0].extra_dims if arrays else ()
+        for a in arrays[1:]:
+            if a.extra_dims != extra:
+                raise ValueError("zip_with: extra_dims mismatch")
+        comps = self.components()
+        if extra:
+            comps = tuple(c.reshape(c.shape + (1,) * len(extra))
+                          for c in comps)
+        val = f(*(a.data for a in arrays), *comps)
+        return self._wrap(val, extra)
 
     def __len__(self) -> int:
         return math.prod(self._pencil.size_global())

@@ -12,9 +12,22 @@ kernel can control tiling explicitly.
 over VMEM tiles, choosing tile extents so that BOTH the input's and the
 output's minor (lane) dimension run at 128 elements — the in-VMEM
 transpose then happens at register granularity instead of strided HBM
-access.  Used as an opt-in fast path by the transpose engine (set the
-``PENCILARRAYS_TPU_PALLAS=1`` environment variable); anything the kernel
-does not support falls back to ``jnp.transpose`` transparently.
+access.
+
+**Measured verdict (v5e, benchmarks/PALLAS_SWEEP.json)**: XLA's own
+transpose runs at/near the HBM roofline in every shape class; this
+kernel never beats it (best 0.96x on the 256^3 f32 (2,0,1) class, worst
+0.02x on 4-D batched permutes; bf16 loses ~2x to XLA's packed-sublane
+handling).  A bandwidth-bound permute leaves no headroom for hand
+kernels on this hardware — the TPU-first conclusion is to let XLA own
+local data movement, exactly as the framework lets it own collective
+scheduling.  The kernel is therefore retained as an opt-in
+*integration demonstrator* of the Pallas path (grid/BlockSpec tiling
+under ``shard_map``, interpret-mode CPU tests), gated to the one
+near-parity class; ``supported()`` rejects every measured-regression
+class so the opt-in can never be a trap.  Enable with
+``PENCILARRAYS_TPU_PALLAS=1``; anything unsupported falls back to
+``jnp.transpose`` transparently.
 """
 
 from __future__ import annotations
@@ -54,14 +67,21 @@ def _tile_shape(shape_out: Tuple[int, ...], axes: Tuple[int, ...]):
 
 
 def supported(shape: Sequence[int], axes: Sequence[int], dtype) -> bool:
-    """Whether :func:`pallas_permute` handles this case natively."""
+    """Whether :func:`pallas_permute` handles this case at near-XLA
+    performance.  Gated to the measured near-parity class
+    (benchmarks/PALLAS_SWEEP.json): 3-D f32/i32 permutes whose OUTPUT
+    leading dim is the input's minor dim (the (2,0,1) family, 0.92-0.96x
+    XLA).  bf16 (packed-sublane losses), 2-D, 4-D/batched and the
+    (1,2,0) family are rejected — all measured at 0.02-0.6x XLA."""
     shape, axes = tuple(shape), tuple(axes)
-    if len(shape) < 2 or len(shape) > 4:
-        return False
+    if len(shape) != 3 or axes != (2, 0, 1):
+        return False  # only the measured both-minors-tiled rotation
     if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
-                                jnp.dtype(jnp.bfloat16),
                                 jnp.dtype(jnp.int32)):
         return False
+    if shape[0] * shape[1] * shape[2] < 8 * 1024 * 1024:
+        return False  # cache-resident sizes: 128^3 measured 0.61x; the
+        # near-parity class is HBM-bound (>= 32 MB f32)
     shape_out = tuple(shape[a] for a in axes)
     return _tile_shape(shape_out, axes) is not None
 

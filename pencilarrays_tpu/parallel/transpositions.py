@@ -57,8 +57,9 @@ from .pencil import LogicalOrder, MemoryOrder, Pencil
 
 def _maybe_pallas_transpose(a, axes, platform: str):
     """Local permute: VMEM-tiled Pallas kernel when enabled & supported
-    (~1.3x over XLA's strided transpose on v5e under min-of-repeats
-    timing — the Strided.jl role, ``Transpositions.jl:636-648``), else
+    (near-XLA-parity class only, 0.92-0.96x measured on v5e — the
+    Strided.jl role, ``Transpositions.jl:636-648``; see
+    ``ops/pallas_kernels.py`` for the measured verdict), else
     ``jnp.transpose``.  On CPU the kernel runs in interpret mode so the
     virtual-mesh tests exercise the same code path."""
     axes = tuple(axes)

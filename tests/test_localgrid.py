@@ -121,3 +121,23 @@ def test_validation(topo):
         localgrid(pen, [np.arange(8.0), np.arange(10.0)])
     with pytest.raises(ValueError):
         localgrid(pen, [np.arange(8.0), np.arange(10.0), np.arange(13.0)])
+
+
+def test_zip_with(topo):
+    """zip(eachindex(u), grid) analog (benchmarks/grids.jl:117): values
+    and coordinates fuse into one elementwise kernel."""
+    import jax.numpy as jnp
+
+    pen = Pencil(topo, (13, 11, 10), (1, 2), permutation=Permutation(2, 0, 1))
+    coords = [np.linspace(0, 1, n) for n in pen.size_global()]
+    g = localgrid(pen, coords)
+    u = np.random.default_rng(5).standard_normal(pen.size_global())
+    x = PencilArray.from_global(pen, u)
+    v = g.zip_with(lambda a, gx, gy, gz: a + gx + 2.0 * gy * jnp.cos(gz), x)
+    assert isinstance(v, PencilArray)
+    X, Y, Z = np.meshgrid(*coords, indexing="ij")
+    np.testing.assert_allclose(gather(v), u + X + 2.0 * Y * np.cos(Z),
+                               rtol=1e-12)
+    with pytest.raises(ValueError, match="pencil"):
+        g.zip_with(lambda a, *k: a,
+                   PencilArray.zeros(pen.replace(decomp_dims=(0, 2))))
