@@ -17,9 +17,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+# runnable as `python benchmarks/suite.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _timeit(body, x0, k0=1, k1=6, repeats=5):
@@ -31,6 +36,64 @@ def _timeit(body, x0, k0=1, k1=6, repeats=5):
     from pencilarrays_tpu.utils.benchtime import device_seconds_per_iter
 
     return device_seconds_per_iter(body, x0, k0=k0, k1=k1, repeats=repeats)
+
+
+def _raw_ns_state(n):
+    """Taylor-Green spectral state for the raw-jnp NS baseline: physical
+    (n,n,n,3) f32 -> rfftn over the spatial axes."""
+    import jax.numpy as jnp
+
+    x = jnp.arange(n) * (2 * jnp.pi / n)
+    X, Y, Z = jnp.meshgrid(x, x, x, indexing="ij")
+    u = jnp.stack([jnp.cos(X) * jnp.sin(Y) * jnp.sin(Z),
+                   -jnp.sin(X) * jnp.cos(Y) * jnp.sin(Z),
+                   jnp.zeros_like(X)], axis=-1).astype(jnp.float32)
+    return jnp.fft.rfftn(u, axes=(0, 1, 2))
+
+
+def _raw_ns_step_fn(n, nu):
+    """Rotational-form RK2 NS step on plain jnp.fft — mathematically the
+    model's step with zero framework involvement."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    kx = jnp.asarray(np.fft.fftfreq(n) * n).reshape(n, 1, 1, 1)
+    ky = jnp.asarray(np.fft.fftfreq(n) * n).reshape(1, n, 1, 1)
+    kz = jnp.asarray(np.fft.rfftfreq(n) * n).reshape(1, 1, n // 2 + 1, 1)
+    k2 = kx * kx + ky * ky + kz * kz
+    inv_k2 = 1.0 / jnp.where(k2 == 0, 1.0, k2)
+    cut = n / 3.0
+    mask = ((jnp.abs(kx) < cut) & (jnp.abs(ky) < cut)
+            & (jnp.abs(kz) < cut)).astype(jnp.float32)
+
+    def nonlinear(uh):
+        w = 1j * jnp.concatenate(
+            [ky * uh[..., 2:3] - kz * uh[..., 1:2],
+             kz * uh[..., 0:1] - kx * uh[..., 2:3],
+             kx * uh[..., 1:2] - ky * uh[..., 0:1]], axis=-1)
+        uw = jnp.fft.irfftn(jnp.concatenate([uh, w], axis=-1),
+                            s=(n, n, n), axes=(0, 1, 2))
+        u, om = uw[..., :3], uw[..., 3:]
+        c = jnp.stack([u[..., 1] * om[..., 2] - u[..., 2] * om[..., 1],
+                       u[..., 2] * om[..., 0] - u[..., 0] * om[..., 2],
+                       u[..., 0] * om[..., 1] - u[..., 1] * om[..., 0]],
+                      axis=-1)
+        ch = jnp.fft.rfftn(c, axes=(0, 1, 2)) * mask
+        kdotc = (kx * ch[..., 0:1] + ky * ch[..., 1:2] + kz * ch[..., 2:3])
+        corr = inv_k2 * kdotc
+        return jnp.concatenate([ch[..., 0:1] - kx * corr,
+                                ch[..., 1:2] - ky * corr,
+                                ch[..., 2:3] - kz * corr], axis=-1)
+
+    def step(uh):
+        dt = 1e-3
+        e = jnp.exp(-nu * k2 * dt)
+        n1 = nonlinear(uh)
+        u1 = (uh + dt * n1) * e
+        n2 = nonlinear(u1)
+        return (uh + 0.5 * dt * n1) * e + 0.5 * dt * n2
+
+    return step
 
 
 def main():
@@ -121,13 +184,25 @@ def main():
     results["navier_stokes_step_128"] = {"seconds": dt,
                                          "steps_per_s": 1.0 / dt}
 
+    # -- 4b. same physics, raw jnp (framework-overhead baseline) ----------
+    # The same rotational-form RK2 written directly on jnp.fft with no
+    # pencil machinery: what the chip does without the framework.  Only
+    # meaningful single-chip (the raw form has no distribution story).
+    if len(devs) == 1:
+        results["navier_stokes_step_128_raw_xla"] = {
+            "seconds": (dt_raw := _timeit(
+                _raw_ns_step_fn(128, 1e-3), _raw_ns_state(128), k0=2, k1=42)),
+            "steps_per_s": 1.0 / dt_raw,
+            "raw_over_framework": dt_raw / dt,  # >1: framework faster
+        }
+
     # -- 5. pallas tiled permute vs XLA transpose (local path) ------------
     from pencilarrays_tpu.ops import pallas_kernels as pk
 
     n_p = 256
     # TPU only: interpret-mode numbers would be meaningless as bandwidth
     if (len(devs) == 1 and devs[0].platform == "tpu"
-            and pk.supported((n_p,) * 3, (2, 0, 1), jnp.float32)):
+            and pk.supported((n_p,) * 3, (2, 0, 1), jnp.float32, "tpu")):
         xp = jnp.zeros((n_p,) * 3, jnp.float32)
         t_pal = _timeit(
             lambda a: pk.pallas_permute(a, (2, 0, 1)) + a.ravel()[0] * 1e-30,

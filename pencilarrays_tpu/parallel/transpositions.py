@@ -67,7 +67,8 @@ def _maybe_pallas_transpose(a, axes, platform: str):
         return a
     from ..ops import pallas_kernels as pk
 
-    if pk.pallas_enabled() and pk.supported(a.shape, axes, a.dtype):
+    if pk.pallas_enabled() and pk.supported(a.shape, axes, a.dtype,
+                                            platform):
         return pk.pallas_permute(a, axes, interpret=(platform != "tpu"))
     return jnp.transpose(a, axes)
 
@@ -200,7 +201,7 @@ def _exchange_transpose(data, pin: Pencil, pout: Pencil, R: int,
     pallas_may_run = (
         fwd_out != tuple(range(len(fwd_out)))
         and pk.pallas_enabled()
-        and pk.supported(out_block, fwd_out, data.dtype))
+        and pk.supported(out_block, fwd_out, data.dtype, platform))
     fn = jax.shard_map(local_fn, mesh=mesh, in_specs=in_spec,
                        out_specs=out_spec,
                        check_vma=not pallas_may_run)
@@ -236,7 +237,8 @@ def _transpose_local(data, pin: Pencil, pout: Pencil, extra_ndims: int):
 
     local_shape = pin.padded_size_local(MemoryOrder) + data.shape[
         pin.ndims:]
-    if pk.pallas_enabled() and pk.supported(local_shape, axes, data.dtype):
+    if pk.pallas_enabled() and pk.supported(local_shape, axes, data.dtype,
+                                            platform):
         # per-block tiled permute under shard_map (block layouts are
         # identical across devices, so one kernel serves all); gating and
         # interpret policy live in _maybe_pallas_transpose
