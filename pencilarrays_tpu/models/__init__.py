@@ -1,6 +1,7 @@
 from .spectral import NavierStokesSpectral, taylor_green
 from .diffusion import DiffusionSpectral
 from .ode import integrate, rk23_step
+from .attention import dense_attention, ring_attention, ulysses_attention
 
 __all__ = [
     "DiffusionSpectral",
@@ -8,4 +9,7 @@ __all__ = [
     "taylor_green",
     "integrate",
     "rk23_step",
+    "dense_attention",
+    "ring_attention",
+    "ulysses_attention",
 ]

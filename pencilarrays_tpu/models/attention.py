@@ -1,0 +1,161 @@
+"""Sequence-parallel attention on pencil primitives — the long-context
+story made explicit.
+
+SURVEY.md §2.3 identifies the reference's pencil transpose as "the direct
+ancestor of ML sequence/context parallelism: resharding along the FFT
+axis via all-to-all is exactly the Ulysses/DeepSpeed all-to-all
+head-vs-sequence reshard pattern".  This module closes the loop: both
+canonical long-context schemes, built from THIS framework's primitives:
+
+* :func:`ulysses_attention` — the DeepSpeed-Ulysses pattern: arrays live
+  sequence-decomposed; ONE framework transpose (``lax.all_to_all``)
+  reshards q/k/v together to head-decomposed (heads sharded, sequence
+  local), plain softmax attention runs per local head group, one
+  transpose returns the output to sequence-decomposed.  The exchange is
+  literally :func:`~pencilarrays_tpu.parallel.transpositions.transpose`
+  on a ``(S, H)`` pencil — 2 all-to-alls per call, HLO-guarded.
+* :func:`ring_attention` — blockwise-streaming attention: q stays
+  sequence-local; k/v blocks rotate through the ring via ``ppermute``
+  (P-1 rounds, the Ring transpose method's pattern) with the
+  flash-attention running max/denominator accumulation, so the full
+  ``S x S`` score matrix never materializes — memory O(S_local x S_blk).
+
+Both are numerically the same softmax attention (tested against a dense
+single-device reference and against each other); which wins is the usual
+trade: Ulysses moves activations twice and wants H >= P (ragged or small
+H still works via the pad->exchange->slice path, at the cost of idle
+head slots), ring moves k/v P-1 times and scales to any S.  Requires
+shard-divisible S (the attention softmax runs along the sequence and
+must not see padded positions; S-divisibility makes the sequence padding
+empty).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.arrays import PencilArray
+from ..parallel.transpositions import transpose
+
+__all__ = ["ulysses_attention", "ring_attention", "dense_attention"]
+
+
+def _check_qkv(q: PencilArray, k: PencilArray, v: PencilArray):
+    pen = q.pencil
+    for name, x in (("k", k), ("v", v)):
+        if x.pencil != pen or x.extra_dims != q.extra_dims:
+            raise ValueError(f"{name} must share q's pencil and extra dims")
+    if pen.ndims != 2:
+        raise ValueError("attention pencils are (S, H); put the feature "
+                         "dim in extra_dims")
+    if len(q.extra_dims) != 1:
+        raise ValueError("q/k/v need extra_dims=(head_dim,)")
+    if pen.padded_global_shape != pen.size_global():
+        raise ValueError(
+            "attention requires shard-divisible S and H (softmax must not "
+            "see padded positions); pad the sequence yourself with masked "
+            "tokens if needed")
+    if not pen.permutation.is_identity():
+        raise ValueError("attention requires identity permutation pencils")
+    return pen
+
+
+def dense_attention(q, k, v):
+    """Reference softmax attention on raw ``(S, H, D)`` arrays."""
+    d = q.shape[-1]
+    s = jnp.einsum("shd,thd->hst", q, k) / math.sqrt(d)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hst,thd->shd", p, v)
+
+
+def ulysses_attention(q: PencilArray, k: PencilArray, v: PencilArray
+                      ) -> PencilArray:
+    """Sequence-parallel attention via the all-to-all head/sequence
+    reshard (DeepSpeed-Ulysses), as two framework transposes.
+
+    q/k/v: PencilArrays on a ``(S, H)`` pencil decomposed along S (dim
+    0), ``extra_dims=(D,)``.  ``H`` need not divide the mesh axis size
+    (the transpose pads and the padded head slots are discarded), but
+    divisible ``H >= P`` keeps every device busy.  Returns the attention
+    output on the same pencil.
+    """
+    pen_seq = _check_qkv(q, k, v)
+    if pen_seq.decomposition != (0,):
+        raise ValueError("ulysses: q/k/v must be sequence-decomposed "
+                         "(decomposition == (0,))")
+    pen_heads = pen_seq.replace(decomp_dims=(1,))
+    # ONE exchange for all three operands: stack q/k/v on a new extra dim
+    # so the all-to-all moves them together (extra dims ride along free).
+    qkv = PencilArray.stack([q, k, v])
+    qkv_h = transpose(qkv, pen_heads)  # all_to_all: S local, H sharded
+
+    spec = pen_heads.partition_spec(2)
+
+    def local_attn(blk):  # blk: (S, H/P, D, 3), full sequence local
+        out = dense_attention(blk[..., 0], blk[..., 1], blk[..., 2])
+        return out[..., None]  # keep the qkv axis for spec symmetry
+
+    fn = jax.shard_map(local_attn, mesh=pen_heads.mesh,
+                       in_specs=spec, out_specs=spec)
+    out_h = PencilArray(pen_heads, fn(qkv_h.data)[..., 0], q.extra_dims)
+    return transpose(out_h, pen_seq)  # back: S sharded, H local
+
+
+def ring_attention(q: PencilArray, k: PencilArray, v: PencilArray
+                   ) -> PencilArray:
+    """Blockwise ring attention: k/v blocks rotate via ``ppermute`` with
+    flash-style running max/denominator accumulation.  q/k/v as in
+    :func:`ulysses_attention`; works for any H (heads stay local),
+    memory is O(S_local x S_block) — the long-sequence scheme.
+    """
+    pen_seq = _check_qkv(q, k, v)
+    if pen_seq.decomposition != (0,):
+        raise ValueError("ring: q/k/v must be sequence-decomposed")
+    mesh = pen_seq.mesh
+    axis = pen_seq.topology.axis_names[0]
+    P = pen_seq.topology.dims[0]
+    d = q.extra_dims[0]
+    spec = pen_seq.partition_spec(1)
+
+    def local_fn(qb, kb, vb):
+        # blocks: (S/P, H, D); rotate (kb, vb) around the ring, keeping
+        # flash accumulators (m: running max, l: denom, acc: numerator)
+        scale = 1.0 / math.sqrt(d)
+
+        def scores(kb):
+            return jnp.einsum("shd,thd->hst", qb, kb) * scale
+
+        m = None
+        l = None
+        acc = None
+        # one rotating buffer for k AND v (concatenated along D): each
+        # round is ONE ppermute launch, not two — the same batching trick
+        # ulysses uses for its single q/k/v exchange
+        cur_kv = jnp.concatenate([kb, vb], axis=-1)
+        for r in range(P):
+            cur_k, cur_v = cur_kv[..., :d], cur_kv[..., d:]
+            s = scores(cur_k)                       # (H, Sq, Skv)
+            blk_m = jnp.max(s, axis=-1)             # (H, Sq)
+            new_m = blk_m if m is None else jnp.maximum(m, blk_m)
+            p = jnp.exp(s - new_m[..., None])
+            blk_l = jnp.sum(p, axis=-1)
+            blk_acc = jnp.einsum("hst,thd->shd", p, cur_v)
+            if m is None:
+                l, acc = blk_l, blk_acc
+            else:
+                corr = jnp.exp(m - new_m)           # (H, Sq)
+                l = l * corr + blk_l
+                acc = acc * corr.T[..., None] + blk_acc
+            m = new_m
+            if r + 1 < P:
+                # shift the k/v block one step around the ring
+                perm = [(i, (i + 1) % P) for i in range(P)]
+                cur_kv = jax.lax.ppermute(cur_kv, axis, perm)
+        return acc / l.T[..., None]
+
+    fn = jax.shard_map(local_fn, mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec)
+    return PencilArray(pen_seq, fn(q.data, k.data, v.data), q.extra_dims)

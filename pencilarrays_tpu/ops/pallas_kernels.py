@@ -74,16 +74,17 @@ def supported(shape: Sequence[int], axes: Sequence[int], dtype,
     leading dim is the input's minor dim (the (2,0,1) family, 0.92-0.96x
     XLA), at HBM-bound sizes.  bf16 (packed-sublane losses), 2-D,
     4-D/batched and the (1,2,0) family are rejected — all measured at
-    0.02-0.6x XLA.  The size cut is a TPU bandwidth criterion only: with
-    ``platform != "tpu"`` (the interpret-mode CPU path the virtual-mesh
-    tests drive) it does not apply."""
+    0.02-0.6x XLA.  The size cut is waived ONLY for the interpret-mode
+    CPU path the virtual-mesh tests drive; any accelerator platform
+    (tpu, gpu, ...) keeps it, since interpret-mode emulation of a small
+    permute would be far slower than the native fallback."""
     shape, axes = tuple(shape), tuple(axes)
     if len(shape) != 3 or axes != (2, 0, 1):
         return False  # only the measured both-minors-tiled rotation
     if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
                                 jnp.dtype(jnp.int32)):
         return False
-    if platform == "tpu" and shape[0] * shape[1] * shape[2] < 8 * 1024 * 1024:
+    if platform != "cpu" and shape[0] * shape[1] * shape[2] < 8 * 1024 * 1024:
         return False  # cache-resident sizes: 128^3 measured 0.61x; the
         # near-parity class is HBM-bound (>= 32 MB f32)
     shape_out = tuple(shape[a] for a in axes)

@@ -1,0 +1,108 @@
+"""Sequence-parallel attention on pencil primitives (SURVEY §2.3: the
+pencil transpose IS the Ulysses head/sequence all-to-all reshard).
+
+Ground truth is dense softmax attention on gathered arrays; both
+distributed schemes must match it and each other, with HLO-pinned
+collective budgets (2 all-to-alls for Ulysses, P-1 ppermute-pair rounds
+for ring) and decomposition independence.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pencilarrays_tpu import Pencil, PencilArray, Topology, gather
+from pencilarrays_tpu.models import (
+    dense_attention, ring_attention, ulysses_attention,
+)
+
+S, H, D = 64, 8, 16
+
+
+@pytest.fixture
+def topo(devices):
+    return Topology((8,))
+
+
+def make_qkv(topo, seed=0):
+    pen = Pencil(topo, (S, H), (0,))
+    rng = np.random.default_rng(seed)
+    qkv = [rng.standard_normal((S, H, D)).astype(np.float32)
+           for _ in range(3)]
+    wrapped = [PencilArray.from_global(pen, x) for x in qkv]
+    return pen, qkv, wrapped
+
+
+def test_ulysses_matches_dense(topo):
+    _, (q, k, v), (qw, kw, vw) = make_qkv(topo)
+    out = ulysses_attention(qw, kw, vw)
+    assert isinstance(out, PencilArray) and out.pencil == qw.pencil
+    expect = np.asarray(dense_attention(*map(jnp.asarray, (q, k, v))))
+    np.testing.assert_allclose(gather(out), expect, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_matches_dense(topo):
+    _, (q, k, v), (qw, kw, vw) = make_qkv(topo, seed=1)
+    out = ring_attention(qw, kw, vw)
+    expect = np.asarray(dense_attention(*map(jnp.asarray, (q, k, v))))
+    np.testing.assert_allclose(gather(out), expect, rtol=2e-4, atol=2e-5)
+
+
+def test_schemes_agree_and_decomposition_independent(topo, devices):
+    pen8, _, (qw, kw, vw) = make_qkv(topo, seed=2)
+    out_u = gather(ulysses_attention(qw, kw, vw))
+    out_r = gather(ring_attention(qw, kw, vw))
+    np.testing.assert_allclose(out_u, out_r, rtol=2e-4, atol=2e-5)
+
+    topo1 = Topology((1,), devices=jax.devices()[:1])
+    pen1 = Pencil(topo1, (S, H), (0,))
+    qkv1 = [PencilArray.from_global(pen1, gather(x))
+            for x in (qw, kw, vw)]
+    out_1 = gather(ring_attention(*qkv1))
+    np.testing.assert_allclose(out_r, out_1, rtol=2e-4, atol=2e-5)
+
+
+def test_collective_budgets(topo):
+    """Ulysses = exactly 2 all-to-alls (qkv stacked into ONE exchange,
+    output in the second); ring = P-1 rounds x k&v ppermutes, zero
+    all-to-alls, zero all-gathers."""
+    pen, _, (qw, kw, vw) = make_qkv(topo, seed=3)
+
+    def f_u(a, b, c):
+        return ulysses_attention(PencilArray(pen, a), PencilArray(pen, b),
+                                 PencilArray(pen, c)).data
+
+    hlo = jax.jit(f_u).lower(qw.data, kw.data, vw.data).compile().as_text()
+    assert len(re.findall(r" all-to-all\(", hlo)) == 2
+    assert not re.findall(r" all-gather\(", hlo)
+
+    def f_r(a, b, c):
+        return ring_attention(PencilArray(pen, a), PencilArray(pen, b),
+                              PencilArray(pen, c)).data
+
+    hlo = jax.jit(f_r).lower(qw.data, kw.data, vw.data).compile().as_text()
+    n_pp = len(re.findall(r" collective-permute\(", hlo))
+    assert n_pp == 8 - 1, n_pp  # ONE k+v buffer per round, P-1 rounds
+    assert not re.findall(r" all-to-all\(", hlo)
+    assert not re.findall(r" all-gather\(", hlo)
+
+
+def test_validation(topo):
+    pen = Pencil(topo, (S, H), (0,))
+    q = PencilArray.zeros(pen, (D,))
+    pen_h = Pencil(topo, (S, H), (1,))
+    kh = PencilArray.zeros(pen_h, (D,))
+    with pytest.raises(ValueError, match="share q's pencil"):
+        ulysses_attention(q, kh, kh)
+    # ragged sequence rejected (softmax must not see padding)
+    pen_r = Pencil(topo, (S - 3, H), (0,))
+    qr = PencilArray.zeros(pen_r, (D,))
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(qr, qr, qr)
+    # head-decomposed input rejected
+    qh = PencilArray.zeros(pen_h, (D,))
+    with pytest.raises(ValueError, match="sequence-decomposed"):
+        ring_attention(qh, qh, qh)
