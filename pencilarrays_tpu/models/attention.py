@@ -55,9 +55,9 @@ def _check_qkv(q: PencilArray, k: PencilArray, v: PencilArray):
         raise ValueError("q/k/v need extra_dims=(head_dim,)")
     if pen.padded_global_shape != pen.size_global():
         raise ValueError(
-            "attention requires shard-divisible S and H (softmax must not "
-            "see padded positions); pad the sequence yourself with masked "
-            "tokens if needed")
+            "attention requires a shard-divisible sequence length S (the "
+            "softmax must not see padded positions); pad the sequence "
+            "yourself with masked tokens if needed")
     if not pen.permutation.is_identity():
         raise ValueError("attention requires identity permutation pencils")
     return pen

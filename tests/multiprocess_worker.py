@@ -20,9 +20,10 @@ import sys
 def main():
     coordinator, nprocs, pid, tmpdir = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    n_local = 8 // nprocs  # 8 devices total, split across processes
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=4")
+        + f" --xla_force_host_platform_device_count={n_local}")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -36,8 +37,8 @@ def main():
     from pencilarrays_tpu.io import BinaryDriver, open_file
 
     assert jax.process_count() == nprocs
-    assert len(jax.devices()) == 4 * nprocs
-    assert len(jax.local_devices()) == 4
+    assert len(jax.devices()) == 8
+    assert len(jax.local_devices()) == n_local
 
     topo = pa.Topology((2, 4))
     shape = (11, 9, 13)  # ragged on purpose
@@ -68,6 +69,25 @@ def main():
     with open_file(BinaryDriver(), path, read=True) as f:
         back = f.read("u", pen_y)  # different decomposition on re-read
     assert np.array_equal(pa.gather(back), g), "IO round trip mismatch"
+
+    # sequence-parallel attention spanning the processes: the ring's
+    # ppermute rounds and ulysses' all_to_all cross the process boundary
+    from pencilarrays_tpu.models import (
+        dense_attention, ring_attention, ulysses_attention)
+
+    topo_seq = pa.Topology((8,))
+    pen_s = pa.Pencil(topo_seq, (32, 8), (0,))
+    rng = np.random.default_rng(3)  # same seed -> same data every process
+    qn, kn, vn = (rng.standard_normal((32, 8, 8)).astype(np.float32)
+                  for _ in range(3))
+    qa, ka, va = (pa.PencilArray.from_global(pen_s, x)
+                  for x in (qn, kn, vn))
+    expect = np.asarray(dense_attention(jnp.asarray(qn), jnp.asarray(kn),
+                                        jnp.asarray(vn)))
+    out_r = pa.gather(ring_attention(qa, ka, va))
+    out_u = pa.gather(ulysses_attention(qa, ka, va))
+    assert np.allclose(out_r, expect, rtol=2e-4, atol=2e-5), "ring attn"
+    assert np.allclose(out_u, expect, rtol=2e-4, atol=2e-5), "ulysses attn"
 
     pa.distributed.sync_global_devices("done")
     print(f"WORKER_OK pid={pid} sum={total:.6f}")

@@ -1,7 +1,9 @@
-"""True multi-process integration test — the ``mpiexec -n 2`` analog
-(reference ``test/runtests.jl:48-53``): two OS processes, each with 4
-virtual devices, joined by ``jax.distributed``; the framework must behave
-identically to the single-process 8-device mesh."""
+"""True multi-process integration test — the ``mpiexec -n N`` analog
+(reference ``test/runtests.jl:48-53``, which clamps to 4-6 processes):
+N OS processes splitting 8 virtual devices, joined by ``jax.distributed``;
+the framework must behave identically to the single-process 8-device
+mesh, including the sequence-parallel attention collectives crossing the
+process boundary."""
 
 import os
 import socket
@@ -17,7 +19,8 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_integration(tmp_path):
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_multi_process_integration(tmp_path, nprocs):
     here = os.path.dirname(os.path.abspath(__file__))
     worker = os.path.join(here, "multiprocess_worker.py")
     coordinator = f"127.0.0.1:{_free_port()}"
@@ -29,12 +32,12 @@ def test_two_process_integration(tmp_path):
     env["PYTHONPATH"] = os.path.dirname(here)
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, coordinator, "2", str(pid),
+            [sys.executable, worker, coordinator, str(nprocs), str(pid),
              str(tmp_path)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )
-        for pid in range(2)
+        for pid in range(nprocs)
     ]
     outs = []
     try:
