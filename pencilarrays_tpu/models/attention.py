@@ -63,16 +63,26 @@ def _check_qkv(q: PencilArray, k: PencilArray, v: PencilArray):
     return pen
 
 
-def dense_attention(q, k, v):
+_NEG = -1e9  # masked-score value: finite so flash accumulation of a
+# fully-masked block stays NaN-free (its contribution underflows once a
+# real block raises the running max; every causal row eventually sees
+# its own diagonal block)
+
+
+def dense_attention(q, k, v, *, causal: bool = False):
     """Reference softmax attention on raw ``(S, H, D)`` arrays."""
     d = q.shape[-1]
     s = jnp.einsum("shd,thd->hst", q, k) / math.sqrt(d)
+    if causal:
+        S = q.shape[0]
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(mask[None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("hst,thd->shd", p, v)
 
 
-def ulysses_attention(q: PencilArray, k: PencilArray, v: PencilArray
-                      ) -> PencilArray:
+def ulysses_attention(q: PencilArray, k: PencilArray, v: PencilArray,
+                      *, causal: bool = False) -> PencilArray:
     """Sequence-parallel attention via the all-to-all head/sequence
     reshard (DeepSpeed-Ulysses), as two framework transposes.
 
@@ -95,7 +105,8 @@ def ulysses_attention(q: PencilArray, k: PencilArray, v: PencilArray
     spec = pen_heads.partition_spec(2)
 
     def local_attn(blk):  # blk: (S, H/P, D, 3), full sequence local
-        out = dense_attention(blk[..., 0], blk[..., 1], blk[..., 2])
+        out = dense_attention(blk[..., 0], blk[..., 1], blk[..., 2],
+                              causal=causal)
         return out[..., None]  # keep the qkv axis for spec symmetry
 
     fn = jax.shard_map(local_attn, mesh=pen_heads.mesh,
@@ -104,8 +115,8 @@ def ulysses_attention(q: PencilArray, k: PencilArray, v: PencilArray
     return transpose(out_h, pen_seq)  # back: S sharded, H local
 
 
-def ring_attention(q: PencilArray, k: PencilArray, v: PencilArray
-                   ) -> PencilArray:
+def ring_attention(q: PencilArray, k: PencilArray, v: PencilArray,
+                   *, causal: bool = False) -> PencilArray:
     """Blockwise ring attention: k/v blocks rotate via ``ppermute`` with
     flash-style running max/denominator accumulation.  q/k/v as in
     :func:`ulysses_attention`; works for any H (heads stay local),
@@ -124,6 +135,8 @@ def ring_attention(q: PencilArray, k: PencilArray, v: PencilArray
         # blocks: (S/P, H, D); rotate (kb, vb) around the ring, keeping
         # flash accumulators (m: running max, l: denom, acc: numerator)
         scale = 1.0 / math.sqrt(d)
+        s_blk = qb.shape[0]
+        me = jax.lax.axis_index(axis)
 
         def scores(kb):
             return jnp.einsum("shd,thd->hst", qb, kb) * scale
@@ -138,6 +151,19 @@ def ring_attention(q: PencilArray, k: PencilArray, v: PencilArray
         for r in range(P):
             cur_k, cur_v = cur_kv[..., :d], cur_kv[..., d:]
             s = scores(cur_k)                       # (H, Sq, Skv)
+            if causal:
+                # after r forward shifts, this device holds k/v block
+                # (me - r) mod P; mask by GLOBAL positions.  Known
+                # limitation: fully-future blocks still pay their score/
+                # value FLOPs (static SPMD shapes; ~2x waste at large P)
+                # — the fix is zigzag/striped block placement, which
+                # changes the sequence layout contract; revisit if the
+                # causal path becomes the bottleneck.
+                kv_blk = (me - jnp.int32(r)) % jnp.int32(P)
+                gq = me * s_blk + jnp.arange(s_blk)        # (Sq,)
+                gt = kv_blk * s_blk + jnp.arange(s_blk)    # (Skv,)
+                s = jnp.where((gq[:, None] >= gt[None, :])[None],
+                              s, _NEG)
             blk_m = jnp.max(s, axis=-1)             # (H, Sq)
             new_m = blk_m if m is None else jnp.maximum(m, blk_m)
             p = jnp.exp(s - new_m[..., None])

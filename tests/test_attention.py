@@ -106,3 +106,26 @@ def test_validation(topo):
     qh = PencilArray.zeros(pen_h, (D,))
     with pytest.raises(ValueError, match="sequence-decomposed"):
         ring_attention(qh, qh, qh)
+
+
+@pytest.mark.parametrize("scheme", ["ulysses", "ring"])
+def test_causal_matches_dense(topo, scheme):
+    """causal=True masks by GLOBAL positions (ring must map its rotating
+    block back to global kv indices)."""
+    _, (q, k, v), (qw, kw, vw) = make_qkv(topo, seed=4)
+    fn = ulysses_attention if scheme == "ulysses" else ring_attention
+    out = fn(qw, kw, vw, causal=True)
+    expect = np.asarray(dense_attention(*map(jnp.asarray, (q, k, v)),
+                                        causal=True))
+    np.testing.assert_allclose(gather(out), expect, rtol=2e-4, atol=2e-5)
+
+
+def test_causal_decomposition_independent(topo, devices):
+    pen8, _, (qw, kw, vw) = make_qkv(topo, seed=5)
+    out8 = gather(ring_attention(qw, kw, vw, causal=True))
+    topo1 = Topology((1,), devices=jax.devices()[:1])
+    pen1 = Pencil(topo1, (S, H), (0,))
+    q1, k1, v1 = (PencilArray.from_global(pen1, gather(x))
+                  for x in (qw, kw, vw))
+    out1 = gather(ring_attention(q1, k1, v1, causal=True))
+    np.testing.assert_allclose(out8, out1, rtol=2e-4, atol=2e-5)
