@@ -74,8 +74,8 @@ def dense_attention(q, k, v, *, causal: bool = False):
     d = q.shape[-1]
     s = jnp.einsum("shd,thd->hst", q, k) / math.sqrt(d)
     if causal:
-        S = q.shape[0]
-        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        mask = (jnp.arange(q.shape[0])[:, None]
+                >= jnp.arange(k.shape[0])[None, :])
         s = jnp.where(mask[None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("hst,thd->shd", p, v)

@@ -101,14 +101,16 @@ class Gspmd(AbstractTransposeMethod):
 
 @dataclass(frozen=True)
 class Ring(AbstractTransposeMethod):
-    """Staged peer-to-peer exchange: P-1 ``lax.ppermute`` rounds, each
-    moving one peer's tile — the reference's ``PointToPoint()`` flavor
-    (nonblocking per-peer sends with unpack-as-they-arrive,
+    """Staged peer-to-peer exchange: shifted ``lax.ppermute`` rounds,
+    each moving one peer's tile — the reference's ``PointToPoint()``
+    flavor (nonblocking per-peer sends with unpack-as-they-arrive,
     ``Transpositions.jl:61-65, 510-516``), re-expressed so XLA's
     latency-hiding scheduler can overlap rounds with the unpack placement.
+    RAGGED-AWARE: runs G-1 rounds among the G nonempty ceil-rule
+    participants instead of P-1 (see :func:`_transpose_ring`).
     Data movement is bit-identical to :class:`AllToAll`; which is faster
-    is a hardware/topology question (P-1 shifted ppermute rounds the
-    fabric routes over up to r hops each, vs one fused collective)."""
+    is a hardware/topology question (shifted ppermute rounds the fabric
+    routes over up to r hops each, vs one fused collective)."""
 
 
 # reference method-name aliases (Transpositions.jl:17-24)

@@ -323,3 +323,20 @@ def test_per_dim_validation_topology_independent(devices):
         with pytest.raises(ValueError, match="must come first"):
             PencilFFTPlan(topo_i, (8, 8, 8, 8),
                           transforms=("fft", "rfft", "fft", "fft"))
+
+
+def test_4d_per_dim_transforms(topo):
+    """4-D array over the 2-D mesh with mixed per-dim kinds — the N=4,
+    M=2 configuration of BASELINE config 4, on the FFT layer."""
+    shape = (8, 12, 10, 6)
+    u = np.random.default_rng(16).standard_normal(shape)
+    plan = PencilFFTPlan(topo, shape,
+                         transforms=("rfft", "fft", "none", "fft"),
+                         dtype=jnp.float64)
+    assert plan.shape_spectral == (5, 12, 10, 6)
+    x = PencilArray.from_global(plan.input_pencil, u)
+    xh = plan.forward(x)
+    expect = np.fft.fftn(np.fft.rfft(u, axis=0), axes=(1, 3))
+    np.testing.assert_allclose(gather(xh), expect, rtol=1e-9, atol=1e-8)
+    back = plan.backward(xh)
+    np.testing.assert_allclose(gather(back), u, rtol=1e-10, atol=1e-10)
