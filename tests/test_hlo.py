@@ -134,6 +134,22 @@ def test_ns_step_collective_budget(topo):
     assert c["all-to-all"] == 8, c
 
 
+def test_rk4_step_collective_budget(topo):
+    """RK4: 4 nonlinear evaluations x 4 exchanges = 16 all-to-alls,
+    ZERO all-gathers (the RK2 twin is test_ns_step_collective_budget)."""
+    from pencilarrays_tpu.models import NavierStokesSpectral, taylor_green
+
+    model = NavierStokesSpectral(topo, 16, viscosity=1e-2, dtype=jnp.float32)
+    uh = taylor_green(model)
+
+    def f(d):
+        return model.step_rk4(PencilArray(uh.pencil, d, (3,)), 1e-2).data
+
+    c = count_collectives(hlo_of(f, uh.data))
+    assert c["all-gather"] == 0, c
+    assert c["all-to-all"] == 16, c
+
+
 def test_transpose_executable_cache(topo):
     """Repeated eager transposes must reuse the compiled executable — the
     framework's analog of the reference's @inferred zero-cost assertions

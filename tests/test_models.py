@@ -193,3 +193,32 @@ def test_ode_under_jit(topo):
     u, stats = run(u0)
     np.testing.assert_allclose(gather(u), np.full(shape, 2.0 * np.exp(-0.5)),
                                rtol=1e-4)
+
+
+def test_rk4_order(topo):
+    """step_rk4 converges at 4th order (error ratio ~16 when dt halves)
+    where RK2 shows ~4; both against a fine-step RK4 reference."""
+    model = NavierStokesSpectral(topo, 16, viscosity=0.02, dtype=jnp.float64)
+    uh0 = taylor_green(model)
+    # seed a second mode so the nonlinear term is active
+    uh0 = model.step(uh0, 0.02)
+    T = 0.32
+    # one jitted stepper each (dt traced): the whole sweep compiles twice
+    j2 = jax.jit(model.step)
+    j4 = jax.jit(model.step_rk4)
+
+    def run(stepper, dt):
+        u = uh0
+        for _ in range(int(round(T / dt))):
+            u = stepper(u, dt)
+        return np.asarray(gather(u))
+
+    ref = run(j4, T / 64)
+    err4_a = np.abs(run(j4, T / 4) - ref).max()
+    err4_b = np.abs(run(j4, T / 8) - ref).max()
+    err2_a = np.abs(run(j2, T / 4) - ref).max()
+    err2_b = np.abs(run(j2, T / 8) - ref).max()
+    assert err4_a / err4_b > 9.0, (err4_a, err4_b)   # nominal 16
+    assert 2.5 < err2_a / err2_b < 7.0, (err2_a, err2_b)  # nominal 4
+    assert err4_b < err2_b  # RK4 strictly more accurate
+
