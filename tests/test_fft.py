@@ -2,6 +2,8 @@
 golden-comparison strategy of SURVEY §4), round trips, r2c, permuted
 layouts, jit fusion."""
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -340,3 +342,52 @@ def test_4d_per_dim_transforms(topo):
     np.testing.assert_allclose(gather(xh), expect, rtol=1e-9, atol=1e-8)
     back = plan.backward(xh)
     np.testing.assert_allclose(gather(back), u, rtol=1e-10, atol=1e-10)
+
+
+def test_extent_aware_chain_avoids_stranding(devices):
+    """Round-3 fix (dryrun weak #1): the stage chain is chosen by extent,
+    so the post-rfft shrunken dim rides the SMALL mesh axis and no stage
+    strands a device.  Pinned chain for the flagship dryrun config."""
+    topo = Topology((4, 2))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)  # empty-rank warning fails
+        plan = PencilFFTPlan(topo, (16, 12, 20), real=True,
+                             dtype=jnp.float64)
+    # dim 0 shrinks 16 -> 9: it must never sit on the size-4 axis
+    # (slot 0); dim 2 (size 20) takes that axis instead.
+    assert [p.decomposition for p in plan.pencils] == \
+        [(2, 1), (2, 0), (1, 0)]
+    u = np.random.default_rng(21).standard_normal((16, 12, 20))
+    x = PencilArray.from_global(plan.input_pencil, u)
+    xh = plan.forward(x)
+    expect = np.fft.fftn(np.fft.rfft(u, axis=0), axes=(1, 2))
+    np.testing.assert_allclose(gather(xh), expect, rtol=1e-9, atol=1e-8)
+    np.testing.assert_allclose(gather(plan.backward(xh)), u,
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_extent_aware_chain_symmetric_keeps_legacy(devices):
+    """Cost ties resolve to the classic x->y->z chain: symmetric plans
+    are bit-stable across the round-3 chain search."""
+    plan = PencilFFTPlan(Topology((2, 4)), (16, 16, 16),
+                         dtype=jnp.complex64)
+    assert [p.decomposition for p in plan.pencils] == \
+        [(1, 2), (0, 2), (0, 1)]
+
+
+def test_none_dim_relaxes_chain_hops(devices):
+    """A dim with transform='none' never needs to be local, so the chain
+    search may leave it decomposed and skip a hop: 4-D fft/none/fft/fft
+    over a 2-D mesh runs in 1 exchange instead of 3."""
+    topo = Topology((4, 2))
+    plan = PencilFFTPlan(topo, (16, 16, 16, 16),
+                         transforms=("fft", "none", "fft", "fft"),
+                         dtype=jnp.complex128)
+    hops = sum(1 for s in plan._steps if s[0] == "t")
+    assert hops == 1
+    u = np.random.default_rng(22).standard_normal((16, 16, 16, 16)) \
+        .astype(complex)
+    x = PencilArray.from_global(plan.input_pencil, u)
+    np.testing.assert_allclose(gather(plan.forward(x)),
+                               np.fft.fftn(u, axes=(0, 2, 3)),
+                               rtol=1e-9, atol=1e-8)
