@@ -133,14 +133,17 @@ class BinaryDriver(ParallelIODriver):
     the reference: repeated dataset names get ``(n)`` suffixes instead of
     replacing the existing dataset.
 
-    ``reuse_regions`` (default True) rewrites a same-name, same-size
-    dataset in place (like the HDF5 driver) so checkpoint rotation does
-    not grow the file monotonically.  Trade-off: a crash mid-rewrite
-    leaves the sidecar pointing at half-written bytes — the same exposure
-    as any in-place store (h5py included).  For crash-consistent rotation
-    set ``reuse_regions=False`` (append-only: the old bytes survive until
-    the sidecar is re-flushed) or use the Orbax driver, whose async
-    commit protocol is crash-consistent by design.
+    ``reuse_regions`` (default True) bounds file growth under checkpoint
+    rotation: a same-name, same-size rewrite ping-pongs between TWO file
+    regions — the new bytes land in the dataset's spare region (never
+    the region the current sidecar points at) and the sidecar flush
+    swaps them.  A crash mid-rewrite therefore leaves the previous
+    checkpoint fully intact (old sidecar -> old region, untouched),
+    unlike a plain in-place store; steady-state cost is 2x the dataset
+    size instead of monotonic growth.  ``reuse_regions=False`` restores
+    pure append-only layout (every version survives until its region is
+    never referenced again).  The Orbax driver's async commit protocol
+    is the third, directory-per-step option.
     """
 
     uniquify_names: bool = False
@@ -228,8 +231,14 @@ class BinaryFile:
                 "endianness": _endianness(), "datasets": []}
 
     def _flush_meta(self):
-        with open(self.meta_filename, "w") as f:
+        # atomic replace: a crash mid-flush must never corrupt the
+        # sidecar (it is the commit point of every write)
+        tmp = self.meta_filename + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(self._meta, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.meta_filename)
 
     @property
     def datasets(self) -> List[Dict]:
@@ -245,6 +254,9 @@ class BinaryFile:
         end = self._base_offset
         for d in self._meta["datasets"]:
             end = max(end, d["offset_bytes"] + d["size_bytes"])
+            spare = d.get("spare_offset")
+            if spare is not None:
+                end = max(end, spare + d["size_bytes"])
         return end
 
     def close(self):
@@ -273,17 +285,23 @@ class BinaryFile:
             self._write_dataset(name, x, chunks)
 
     def _write_dataset(self, name: str, x: PencilArray, chunks: bool):
-        # Rewriting an existing dataset of identical size reuses its file
-        # region instead of orphaning it and appending — keeps repeated
-        # checkpoint rewrites from growing the file monotonically (the
-        # HDF5 driver gets this for free from h5py's in-place datasets).
-        # Deterministic across processes: both name and size derive from
-        # the (synchronized) sidecar + pencil math.  Crash trade-off
-        # documented on BinaryDriver.reuse_regions.
+        # Rewriting an existing dataset of identical size ping-pongs
+        # between two regions: the new bytes go to the SPARE region (the
+        # previous version's old slot, or a fresh one on the first
+        # rewrite), never the region the current sidecar references, so
+        # a crash before the sidecar flush leaves the prior checkpoint
+        # fully readable.  Deterministic across processes: name, size and
+        # spare offsets all derive from the (synchronized) sidecar +
+        # pencil math.  Growth is bounded at 2x per dataset (vs the
+        # monotonic growth of reuse_regions=False).
         prev = None if not self.reuse_regions else next(
             (d for d in self._meta["datasets"] if d["name"] == name), None)
+        spare = None
         if prev is not None and prev["size_bytes"] == x.sizeof_global():
-            offset = prev["offset_bytes"]
+            spare = prev["offset_bytes"]  # becomes the next spare
+            offset = prev.get("spare_offset")
+            if offset is None:
+                offset = self._end_offset()
         else:
             offset = self._end_offset()
         dtype = np.dtype(x.dtype)
@@ -297,6 +315,8 @@ class BinaryFile:
             "size_bytes": x.sizeof_global(),
             "metadata": metadata(x),
         }
+        if spare is not None:
+            entry["spare_offset"] = spare
         if chunks:
             entry["chunk_map"] = self._write_chunks(x, offset, dtype)
         else:
@@ -304,9 +324,17 @@ class BinaryFile:
         self._meta["datasets"] = [
             d for d in self._meta["datasets"] if d["name"] != name
         ] + [entry]
-        # Every process tracks metadata (offsets stay deterministic), but
-        # only process 0 touches the sidecar file; a cross-host barrier
-        # orders the data writes before any subsequent reader.
+        # Commit ordering (what makes the ping-pong rewrite actually
+        # crash-consistent): (1) every process's data bytes reach disk
+        # (fsync is per-inode, so one fd suffices per process), (2) a
+        # cross-host barrier proves ALL processes finished step 1, (3)
+        # only then does process 0 durably flush the sidecar that
+        # references the new region, (4) a final barrier orders the
+        # flush before any peer reads.  Flushing before (2) would let a
+        # crash commit a sidecar pointing at a peer's half-written bytes.
+        with open(self.filename, "rb+") as f:
+            os.fsync(f.fileno())
+        sync_global_devices("pa_io_data")
         if self._is_proc0:
             self._flush_meta()
         sync_global_devices("pa_io_write")

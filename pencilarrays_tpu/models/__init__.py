@@ -1,7 +1,15 @@
 from .spectral import NavierStokesSpectral, taylor_green
 from .diffusion import DiffusionSpectral
 from .ode import integrate, rk23_step
-from .attention import dense_attention, ring_attention, ulysses_attention
+from .attention import (
+    dense_attention,
+    flash_attention,
+    from_zigzag,
+    ring_attention,
+    to_zigzag,
+    ulysses_attention,
+    zigzag_indices,
+)
 
 __all__ = [
     "DiffusionSpectral",
@@ -10,6 +18,10 @@ __all__ = [
     "integrate",
     "rk23_step",
     "dense_attention",
+    "flash_attention",
     "ring_attention",
     "ulysses_attention",
+    "to_zigzag",
+    "from_zigzag",
+    "zigzag_indices",
 ]

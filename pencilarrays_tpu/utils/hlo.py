@@ -50,7 +50,9 @@ _APP_RE = re.compile(
     r"(?P<op>" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?\("
 )
 
-_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+# Each dim may carry a bounded-dynamic `<=` prefix (e.g. ``f32[<=8,4]``);
+# pricing uses the bound, which upper-bounds the wire bytes.
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[((?:<=|[0-9,])*)\]")
 
 
 def shape_bytes(shape_str: str) -> int:
@@ -62,9 +64,24 @@ def shape_bytes(shape_str: str) -> int:
         n = 1
         for d in dims.split(","):
             if d:
-                n *= int(d)
+                n *= int(d.lstrip("<="))
         total += n * _DTYPE_BYTES[dtype]
     return total
+
+
+def largest_tensor_elems(hlo: str) -> int:
+    """Element count of the largest single shape component anywhere in
+    the HLO text — the memory-contract probe the attention tests use to
+    assert a flash program never materializes an ``S x S`` score
+    matrix."""
+    biggest = 0
+    for _, dims in _SHAPE_RE.findall(hlo):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d.lstrip("<="))
+        biggest = max(biggest, n)
+    return biggest
 
 
 def collective_stats(hlo: str) -> dict:

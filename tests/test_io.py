@@ -344,9 +344,10 @@ def test_orbax_roundtrip(tmp_path, pen, topo):
         np.testing.assert_array_equal(gather(z), u)
 
 def test_rewrite_reuses_offset(tmp_path, pen):
-    """Rewriting a same-size dataset reuses its file region instead of
-    orphaning it (ADVICE r1: monotonic file growth under checkpoint
-    rewrites); other datasets survive the rewrite."""
+    """Rewriting a same-size dataset ping-pongs between two regions
+    (ADVICE r1+r2: bounded file growth under checkpoint rotation AND
+    crash safety — the sidecar's current region is never overwritten);
+    other datasets survive the rewrite."""
     u, x = make_data(pen, seed=1)
     v, y = make_data(pen, seed=2)
     w, z = make_data(pen, seed=3)
@@ -354,13 +355,36 @@ def test_rewrite_reuses_offset(tmp_path, pen):
     with open_file(BinaryDriver(), path, write=True, create=True) as f:
         f.write("u", x)
         f.write("v", y)
-    size0 = os.path.getsize(path)
     with open_file(BinaryDriver(), path, append=True, write=True) as f:
-        f.write("u", z)  # same name, same size -> in-place
-    assert os.path.getsize(path) == size0
+        f.write("u", y)  # first rewrite allocates the spare region
+    size1 = os.path.getsize(path)
+    for arr in (z, x, y, z):  # further rewrites reuse the two regions
+        with open_file(BinaryDriver(), path, append=True, write=True) as f:
+            f.write("u", arr)
+    assert os.path.getsize(path) == size1
     with open_file(BinaryDriver(), path, read=True) as f:
         np.testing.assert_array_equal(gather(f.read("u", pen)), w)
         np.testing.assert_array_equal(gather(f.read("v", pen)), v)
+
+
+def test_rewrite_crash_leaves_old_checkpoint_intact(tmp_path, pen):
+    """Crash-consistency of the ping-pong rewrite: bytes referenced by
+    the PRE-rewrite sidecar are untouched by the rewrite, so a crash
+    before the sidecar flush (simulated by restoring the old sidecar)
+    still reads the previous checkpoint."""
+    import shutil
+
+    u, x = make_data(pen, seed=6)
+    w, z = make_data(pen, seed=7)
+    path = str(tmp_path / "crash.bin")
+    with open_file(BinaryDriver(), path, write=True, create=True) as f:
+        f.write("u", x)
+    shutil.copy(path + ".json", path + ".json.bak")  # pre-crash sidecar
+    with open_file(BinaryDriver(), path, append=True, write=True) as f:
+        f.write("u", z)  # rewrite fully lands (data + new sidecar)
+    shutil.copy(path + ".json.bak", path + ".json")  # "crash" rollback
+    with open_file(BinaryDriver(), path, read=True) as f:
+        np.testing.assert_array_equal(gather(f.read("u", pen)), u)
 
 
 def test_reuse_regions_opt_out(tmp_path, pen):
