@@ -53,6 +53,7 @@ from .parallel import (  # noqa: F401
     make_pencil,
     reshard,
     transpose,
+    transpose_cost,
 )
 from .ops.localgrid import LocalRectilinearGrid, localgrid  # noqa: F401
 from . import ops  # noqa: F401

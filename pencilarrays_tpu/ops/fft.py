@@ -375,7 +375,9 @@ class PencilFFTPlan:
             dec, perm = cfgs[d]
             if dec != cur.decomposition:
                 tgt = Pencil(topology, tuple(shape), dec, permutation=perm)
-                steps.append(("t", cur, tgt))
+                hop_dtype = (self.dtype_spectral if is_complex
+                             else self.dtype_physical)
+                steps.append(("t", cur, tgt, hop_dtype))
                 cur = tgt
             if d != min(pending):
                 continue  # path hop only; d's transform already applied
@@ -429,6 +431,32 @@ class PencilFFTPlan:
     def output_pencil(self) -> Pencil:
         """Configuration of the spectral (fully transformed) array."""
         return self._output_pencil
+
+    def collective_costs(self, extra_dims: Tuple[int, ...] = (), *,
+                         method: AbstractTransposeMethod = None) -> dict:
+        """Predicted per-chip collective cost of ONE :meth:`forward`
+        application (``{op: {"count", "bytes"}}``, the
+        ``utils.hlo.collective_stats`` schema).  Each hop is priced by
+        the analytic model (:func:`~pencilarrays_tpu.parallel.
+        transpositions.transpose_cost`) at the dtype the data carries at
+        that point of the schedule.  :meth:`backward` costs the same
+        (the hop shapes are symmetric).  Tests and the multichip dryrun
+        pin this EQUAL to the compiled HLO's measured stats — the
+        validated ICI byte model."""
+        from ..parallel.transpositions import transpose_cost
+
+        method = method if method is not None else self.method
+        total: dict = {}
+        for step in self._steps:
+            if step[0] != "t":
+                continue
+            _, src, dst, hop_dtype = step
+            for op, c in transpose_cost(src, dst, extra_dims, hop_dtype,
+                                        method).items():
+                e = total.setdefault(op, {"count": 0, "bytes": 0})
+                e["count"] += c["count"]
+                e["bytes"] += c["bytes"]
+        return total
 
     def allocate_input(self, extra_dims: Tuple[int, ...] = ()) -> PencilArray:
         return PencilArray.zeros(self.input_pencil, extra_dims,

@@ -19,6 +19,7 @@ from .transpositions import (
     assert_compatible,
     reshard,
     transpose,
+    transpose_cost,
 )
 from .gather import gather
 from .multiarrays import ManyPencilArray
@@ -38,6 +39,7 @@ __all__ = [
     "assert_compatible",
     "reshard",
     "transpose",
+    "transpose_cost",
     "gather",
     "Topology",
     "default_axis_names",

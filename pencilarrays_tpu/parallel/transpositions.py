@@ -80,6 +80,7 @@ __all__ = [
     "Ring",
     "Transposition",
     "transpose",
+    "transpose_cost",
     "reshard",
     "assert_compatible",
 ]
@@ -331,6 +332,73 @@ def _transpose_ring(data, pin: Pencil, pout: Pencil, R: int,
         return exchange
 
     return _exchange_transpose(data, pin, pout, R, extra_ndims, factory)
+
+
+# ---------------------------------------------------------------------------
+# analytic cost model
+# ---------------------------------------------------------------------------
+
+
+def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
+                   dtype=None, method: AbstractTransposeMethod = AllToAll()
+                   ) -> dict:
+    """Predicted per-chip collective cost of one transpose hop, in the
+    same ``{op: {"count", "bytes"}}`` schema ``utils.hlo.collective_stats``
+    measures from compiled HLO — so prediction and measurement are
+    directly comparable (and the tests pin them EQUAL, which is what
+    makes the byte model trustworthy).
+
+    The analytic shape: the exchanged operand is the logical-order local
+    block with the to-be-split dim ``b`` padded to its post-exchange
+    padded extent — extent ``padded_global[i] / P_i`` for every dim
+    decomposed in the input, ``pout.padded_global[b]`` for ``b``, true
+    extent for other local dims.  AllToAll prices one application at the
+    full block (the wire moves ``(P-1)/P`` of it; the self-share stays);
+    Ring prices ``G - 1`` single-tile ``ppermute`` rounds among the
+    ``G = max(S_a, S_b)`` nonempty ceil-rule participants.  This is the
+    TPU analog of the reference's per-peer send-size accounting
+    (``Transpositions.jl:383-389``).
+    """
+    import numpy as np
+
+    R = assert_compatible(pin, pout)
+    if R is None:
+        return {}
+    P = pin.topology.dims[R]
+    if P == 1:
+        return {}
+    a = pin.decomposition[R]
+    b = pout.decomposition[R]
+    ext = []
+    for i in range(pin.ndims):
+        if i == b:
+            ext.append(pout.padded_global_shape[b])
+        elif i in pin.decomposition:
+            j = pin.decomposition.index(i)
+            ext.append(pin.padded_global_shape[i] // pin.topology.dims[j])
+        else:
+            ext.append(pin.size_global()[i])
+    elems = int(np.prod(ext, dtype=np.int64))
+    for e in extra_dims:
+        elems *= int(e)
+    isize = np.dtype(dtype if dtype is not None else np.float32).itemsize
+    if isinstance(method, AllToAll):
+        return {"all-to-all": {"count": 1, "bytes": elems * isize}}
+    if isinstance(method, Ring):
+        n_a = pin.size_global()[a]
+        n_b = pin.size_global()[b]
+        a_blk = pin.padded_global_shape[a] // P
+        b_blk = pout.padded_global_shape[b] // P
+        G = max(-(-n_a // a_blk), -(-n_b // b_blk))
+        tile = elems // P
+        if G <= 1:
+            return {}
+        return {"collective-permute":
+                {"count": G - 1, "bytes": (G - 1) * tile * isize}}
+    raise ValueError(
+        f"no analytic cost model for method {method!r} (Gspmd collectives "
+        f"are chosen by the partitioner; measure them with "
+        f"utils.hlo.collective_stats instead)")
 
 
 # ---------------------------------------------------------------------------

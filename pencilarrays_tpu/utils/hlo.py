@@ -55,17 +55,23 @@ _APP_RE = re.compile(
 _SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[((?:<=|[0-9,])*)\]")
 
 
+def _dim_elems(dims_str: str) -> int:
+    """Element count of one ``[dims]`` string (bounded-dynamic ``<=``
+    prefixes priced at their bound)."""
+    n = 1
+    for d in dims_str.split(","):
+        if d:
+            n *= int(d.lstrip("<="))
+    return n
+
+
 def shape_bytes(shape_str: str) -> int:
     """Total bytes of every ``dtype[dims]`` component in an HLO shape string."""
     total = 0
     for dtype, dims in _SHAPE_RE.findall(shape_str):
         if dtype not in _DTYPE_BYTES:
             continue  # token[] etc. — zero-cost control types
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d.lstrip("<="))
-        total += n * _DTYPE_BYTES[dtype]
+        total += _dim_elems(dims) * _DTYPE_BYTES[dtype]
     return total
 
 
@@ -74,14 +80,8 @@ def largest_tensor_elems(hlo: str) -> int:
     the HLO text — the memory-contract probe the attention tests use to
     assert a flash program never materializes an ``S x S`` score
     matrix."""
-    biggest = 0
-    for _, dims in _SHAPE_RE.findall(hlo):
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d.lstrip("<="))
-        biggest = max(biggest, n)
-    return biggest
+    return max((_dim_elems(dims) for _, dims in _SHAPE_RE.findall(hlo)),
+               default=0)
 
 
 def collective_stats(hlo: str) -> dict:
