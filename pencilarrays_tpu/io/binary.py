@@ -269,12 +269,17 @@ class BinaryFile:
         self.close()
 
     # -- write ------------------------------------------------------------
-    def write(self, name: str, x: PencilArray, *, chunks: bool = False) -> None:
-        """``file[name] = x`` of the reference (``mpi_io.jl:170-189``)."""
+    def write(self, name: str, x, *, chunks: bool = False) -> None:
+        """``file[name] = x`` of the reference (``mpi_io.jl:170-189``).
+        ``x`` may be a tuple/list of same-pencil arrays — written as ONE
+        dataset with a trailing component dim (collection-level I/O);
+        :meth:`read` returns the tuple back."""
         if not self.writable:
             raise PermissionError("file not opened for writing")
         from ..utils.timers import timeit
+        from .core import pack_collection
 
+        x, ncomp = pack_collection(x)
         if self.uniquify_names:
             base, n = name, 1
             existing = {d["name"] for d in self._meta["datasets"]}
@@ -282,9 +287,10 @@ class BinaryFile:
                 n += 1
                 name = f"{base}({n})"
         with timeit(x.pencil.timer, "write parallel"):
-            self._write_dataset(name, x, chunks)
+            self._write_dataset(name, x, chunks, ncomp)
 
-    def _write_dataset(self, name: str, x: PencilArray, chunks: bool):
+    def _write_dataset(self, name: str, x: PencilArray, chunks: bool,
+                       ncomp: int = None):
         # Rewriting an existing dataset of identical size ping-pongs
         # between two regions: the new bytes go to the SPARE region (the
         # previous version's old slot, or a fresh one on the first
@@ -313,7 +319,7 @@ class BinaryFile:
             "dims_logical": list(x.pencil.size_global(LogicalOrder)),
             "layout": "chunks" if chunks else "discontiguous",
             "size_bytes": x.sizeof_global(),
-            "metadata": metadata(x),
+            "metadata": metadata(x, collection=ncomp),
         }
         if spare is not None:
             entry["spare_offset"] = spare
@@ -413,10 +419,13 @@ class BinaryFile:
 
     # -- read -------------------------------------------------------------
     def read(self, name: str, pencil: Pencil,
-             extra_dims: Tuple[int, ...] = None) -> PencilArray:
+             extra_dims: Tuple[int, ...] = None):
         """Read a dataset into a (possibly different) pencil configuration
         (reference ``read!``, ``mpi_io.jl:239-263``): dtype/dims/endianness
-        are verified against the sidecar (``mpi_io.jl:293-324``)."""
+        are verified against the sidecar (``mpi_io.jl:293-324``).
+        Collection datasets come back as the original tuple."""
+        from .core import maybe_unstack
+
         d = self.dataset_meta(name)
         if d["endianness"] != _endianness():
             raise ValueError(
@@ -451,8 +460,9 @@ class BinaryFile:
                     sl = tuple(slice(r.start, r.stop) for r in ranges)
                     return np.ascontiguousarray(mm[sl])
 
-            return _assemble_sharded(pencil, tuple(extra_dims), dtype,
-                                     block_reader)
+            return maybe_unstack(
+                _assemble_sharded(pencil, tuple(extra_dims), dtype,
+                                  block_reader), d["metadata"])
         # chunks: reassemble via the stored chunk map — works under ANY
         # target decomposition (slower than the matching-layout fast path
         # the reference also distinguishes).
@@ -473,7 +483,8 @@ class BinaryFile:
                     block, axes + tuple(range(n, n + len(extra_dims))))
             sl = tuple(slice(a, b) for a, b in ch["ranges_logical"])
             out[sl] = block
-        return PencilArray.from_global(pencil, out)
+        return maybe_unstack(PencilArray.from_global(pencil, out),
+                             d["metadata"])
 
     def read_raw(self, pencil: Pencil, dtype, *, offset: int = 0,
                  extra_dims: Tuple[int, ...] = ()) -> PencilArray:

@@ -44,15 +44,54 @@ def open_file(driver: ParallelIODriver, filename: str, **mode):
         f.close()
 
 
-def metadata(x: PencilArray) -> Dict:
+def metadata(x: PencilArray, collection: int = None) -> Dict:
     """Decomposition metadata stored next to each dataset
-    (reference ``PencilIO.metadata``, ``PencilIO.jl:53-65``)."""
+    (reference ``PencilIO.metadata``, ``PencilIO.jl:53-65``).
+    ``collection`` records that the trailing extra dim stacks that many
+    logical fields (collection-level I/O)."""
     pen = x.pencil
     perm = pen.permutation
-    return {
+    md = {
         "permutation": None if perm is NO_PERMUTATION or perm.is_identity()
         else list(perm.axes()),
         "extra_dims": list(x.extra_dims),
         "decomposed_dims": list(pen.decomposition),
         "process_dims": list(pen.topology.dims),
     }
+    if collection:
+        md["collection"] = int(collection)
+    return md
+
+
+def pack_collection(x):
+    """Normalize a driver ``write`` input: a tuple/list of same-pencil
+    arrays (reference ``PencilArrayCollection``, ``arrays.jl:183-195``)
+    stacks into ONE array with a trailing component dim — written as one
+    higher-dimensional dataset (``ext/PencilArraysHDF5Ext.jl:222-229``)
+    so a multi-field state (u, v, w, p) restarts consistently in one
+    call.  Returns ``(array, n_components or None)``."""
+    if isinstance(x, (tuple, list)):
+        if not x:
+            raise ValueError("cannot write an empty collection")
+        bad = [type(a).__name__ for a in x
+               if not isinstance(a, PencilArray)]
+        if bad:
+            raise TypeError(
+                f"collection elements must be PencilArrays sharing a "
+                f"pencil; got {bad}")
+        return PencilArray.stack(list(x)), len(x)
+    return x, None
+
+
+def maybe_unstack(x: PencilArray, md: Dict):
+    """Read-side inverse of :func:`pack_collection`: return a tuple of
+    components when the stored metadata marks a collection."""
+    n = (md or {}).get("collection")
+    if n:
+        comps = x.unstack()
+        if len(comps) != n:
+            raise ValueError(
+                f"collection metadata says {n} components, trailing dim "
+                f"has {len(comps)}")
+        return comps
+    return x

@@ -96,7 +96,13 @@ class OrbaxFile:
     def _meta_path(self, name: str) -> str:
         return os.path.join(self.path, name + ".meta.json")
 
-    def write(self, name: str, x: PencilArray) -> None:
+    def write(self, name: str, x) -> None:
+        """``x`` may be a tuple/list of same-pencil arrays — stored as
+        ONE stacked item (collection-level I/O); :meth:`read` returns
+        the tuple back."""
+        from .core import pack_collection
+
+        x, ncomp = pack_collection(x)
         if not self.writable:
             raise PermissionError("checkpoint not opened for writing")
         item = self._item_dir(name)
@@ -123,7 +129,7 @@ class OrbaxFile:
             "dtype": np.dtype(x.dtype).name,
             "dims_logical": list(x.pencil.size_global(LogicalOrder)),
             "dims_padded_memory": list(x.data.shape),
-            "metadata": metadata(x),
+            "metadata": metadata(x, collection=ncomp),
         }
         if self.async_write:
             self._pending_meta[name] = meta
@@ -133,7 +139,8 @@ class OrbaxFile:
                 json.dump(meta, f, indent=1)
 
     def read(self, name: str, pencil: Pencil,
-             extra_dims: Optional[Tuple[int, ...]] = None) -> PencilArray:
+             extra_dims: Optional[Tuple[int, ...]] = None):
+        """Collection datasets come back as the original tuple."""
         self.wait_until_finished()  # in-flight saves become durable first
         with open(self._meta_path(name)) as f:
             meta = json.load(f)
@@ -162,7 +169,10 @@ class OrbaxFile:
             )
         arr = arr[tuple(slice(0, d) for d in dims)
                   + (slice(None),) * len(extra_dims)]
-        return PencilArray.from_global(pencil, arr)
+        from .core import maybe_unstack
+
+        return maybe_unstack(PencilArray.from_global(pencil, arr),
+                             meta["metadata"])
 
     def datasets(self):
         return sorted(

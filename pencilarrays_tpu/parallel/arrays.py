@@ -482,6 +482,19 @@ class PencilArray:
         data = jnp.stack([c._data for c in components], axis=-1)
         return cls(first._pencil, data, first._extra_dims + (len(components),))
 
+    def unstack(self) -> Tuple["PencilArray", ...]:
+        """Split the trailing extra dim into a tuple of components — the
+        inverse of :meth:`stack` (and the read-side of collection-level
+        I/O, reference ``PencilArrayCollection`` datasets,
+        ``ext/PencilArraysHDF5Ext.jl:222-229``)."""
+        if not self._extra_dims:
+            raise ValueError("unstack: array has no extra dims")
+        n = self._extra_dims[-1]
+        return tuple(
+            PencilArray(self._pencil, self._data[..., i],
+                        self._extra_dims[:-1])
+            for i in range(n))
+
     # -- arithmetic (memory-order, parent-level: broadcast.jl parity) -----
     def _binop(self, other, op):
         if isinstance(other, PencilArray):

@@ -402,3 +402,72 @@ def test_reuse_regions_opt_out(tmp_path, pen):
     assert os.path.getsize(path) == 2 * size0  # appended, not reused
     with open_file(BinaryDriver(), path, read=True) as f:
         np.testing.assert_array_equal(gather(f.read("u", pen)), w)
+
+
+def test_collection_io_binary(tmp_path, topo, pen):
+    """A (u, v, w, p) state writes as ONE dataset and restarts — under a
+    DIFFERENT decomposition — in one call (collection-level I/O,
+    reference ext/PencilArraysHDF5Ext.jl:222-229)."""
+    fields = [make_data(pen, seed=20 + i) for i in range(4)]
+    path = str(tmp_path / "coll.bin")
+    with open_file(BinaryDriver(), path, write=True, create=True) as f:
+        f.write("state", tuple(x for _, x in fields))
+    pen2 = Pencil(topo, pen.size_global(), (0, 1))
+    with open_file(BinaryDriver(), path, read=True) as f:
+        back = f.read("state", pen2)
+    assert isinstance(back, tuple) and len(back) == 4
+    for (u, _), b in zip(fields, back):
+        assert b.extra_dims == ()
+        np.testing.assert_array_equal(gather(b), u)
+
+
+def test_collection_io_binary_chunks_and_extra_dims(tmp_path, pen):
+    """Collections of fields that THEMSELVES have extra dims, through
+    the chunked layout."""
+    fields = [make_data(pen, extra=(2,), seed=30 + i) for i in range(3)]
+    path = str(tmp_path / "collc.bin")
+    with open_file(BinaryDriver(), path, write=True, create=True) as f:
+        f.write("state", [x for _, x in fields], chunks=True)
+    with open_file(BinaryDriver(), path, read=True) as f:
+        back = f.read("state", pen)
+    assert isinstance(back, tuple) and len(back) == 3
+    for (u, _), b in zip(fields, back):
+        assert b.extra_dims == (2,)
+        np.testing.assert_array_equal(gather(b), u)
+
+
+def test_collection_io_hdf5(tmp_path, topo, pen):
+    pytest.importorskip("h5py")
+    from pencilarrays_tpu.io import HDF5Driver
+
+    fields = [make_data(pen, seed=40 + i) for i in range(4)]
+    path = str(tmp_path / "coll.h5")
+    with open_file(HDF5Driver(), path, write=True, create=True) as f:
+        f.write("state", tuple(x for _, x in fields))
+    pen2 = Pencil(topo, pen.size_global(), (0, 2))
+    with open_file(HDF5Driver(), path, read=True) as f:
+        back = f.read("state", pen2)
+    assert isinstance(back, tuple) and len(back) == 4
+    for (u, _), b in zip(fields, back):
+        np.testing.assert_array_equal(gather(b), u)
+    # single-array rewrite under the same name clears the marker
+    with open_file(HDF5Driver(), path, append=True, write=True) as f:
+        f.write("state", fields[0][1])
+    with open_file(HDF5Driver(), path, read=True) as f:
+        one = f.read("state", pen)
+    assert not isinstance(one, tuple)
+
+
+def test_collection_io_orbax(tmp_path, topo, pen):
+    if not has_orbax():
+        pytest.skip("orbax not available")
+    fields = [make_data(pen, seed=50 + i) for i in range(3)]
+    path = str(tmp_path / "coll_orbax")
+    with open_file(OrbaxDriver(), path, write=True, create=True) as f:
+        f.write("state", tuple(x for _, x in fields))
+    pen2 = Pencil(topo, pen.size_global(), (0, 1))
+    with open_file(OrbaxDriver(), path, read=True) as f:
+        back = f.read("state", pen2)
+    assert isinstance(back, tuple) and len(back) == 3
+    for (u, _), b in zip(fields, back):
+        np.testing.assert_array_equal(gather(b), u)
