@@ -53,14 +53,36 @@ def _endianness() -> str:
     return sys.byteorder  # "little" on TPU hosts
 
 
-def iter_local_blocks(x: PencilArray, order=LogicalOrder):
+def iter_local_blocks(x, order=LogicalOrder, with_coords: bool = False):
     """Yield per-shard tuples for THIS process: with ``order=LogicalOrder``
     (default) ``(start, block)`` where ``start`` is the logical-order
     global corner and ``block`` the true-size logical-order data; with
     ``order=MemoryOrder`` ``(coords, block)`` with the block left in
-    memory order (no transpose).  One host copy per shard, no device
-    compute — shared by every driver's write path."""
+    memory order (no transpose).  ``with_coords=True`` prepends the
+    topology coords to the LogicalOrder tuples (``(coords, start,
+    block)``).  One host copy per shard, no device compute — shared by
+    every driver's write path.
+
+    A :class:`~pencilarrays_tpu.io.core.CollectionView` streams its
+    components' blocks zipped and HOST-stacked along the trailing
+    component dim — the whole point of the view: collection writes never
+    materialize a stacked duplicate in device memory."""
     from ..parallel.arrays import _inv_axes
+    from .core import CollectionView
+
+    if isinstance(x, CollectionView):
+        its = [iter_local_blocks(c, order, with_coords)
+               for c in x.components]
+        for tups in zip(*its):
+            key = tups[0][:-1]
+            assert all(t[:-1] == key for t in tups), \
+                "component shard iteration order diverged"
+            blk = np.stack([t[-1] for t in tups], axis=-1)
+            blk = blk.astype(x.dtype, copy=False)
+            if order is LogicalOrder:
+                key = key[:-1] + (key[-1] + (0,),)  # start gains comp 0
+            yield key + (blk,)
+        return
 
     pen = x.pencil
     topo = pen.topology
@@ -81,7 +103,10 @@ def iter_local_blocks(x: PencilArray, order=LogicalOrder):
             continue
         block = np.transpose(raw[sl], inv)  # memory -> logical order
         start = tuple(r.start for r in rr) + (0,) * nd_extra
-        yield start, block
+        if with_coords:
+            yield coords, start, block
+        else:
+            yield start, block
 
 
 def _assemble_sharded(pencil: Pencil, extra_dims: Tuple[int, ...], dtype,

@@ -63,13 +63,50 @@ def metadata(x: PencilArray, collection: int = None) -> Dict:
     return md
 
 
+class CollectionView:
+    """A zero-copy stand-in for ``PencilArray.stack(components)`` that
+    the write paths consume: it exposes the stacked array's descriptor
+    surface (pencil, dtype, ``extra_dims + (n,)``, global sizes) while
+    the actual stacking happens per BLOCK on the host during
+    ``iter_local_blocks`` — never a full stacked duplicate in device
+    memory (which would double peak HBM at exactly the checkpoint
+    moment the collection feature targets)."""
+
+    def __init__(self, components):
+        first = components[0]
+        for c in components[1:]:
+            if not isinstance(c, PencilArray) or c.pencil != first.pencil \
+                    or c.extra_dims != first.extra_dims:
+                raise ValueError(
+                    "collection components must share pencil/extra dims")
+        import numpy as _np
+
+        self.components = tuple(components)
+        self.pencil = first.pencil
+        self.extra_dims = first.extra_dims + (len(components),)
+        self.dtype = _np.result_type(*(c.dtype for c in components))
+
+    @property
+    def ndims_extra(self) -> int:
+        return len(self.extra_dims)
+
+    def sizeof_global(self) -> int:
+        import numpy as _np
+
+        n = int(_np.prod(self.pencil.size_global(), dtype=_np.int64))
+        for e in self.extra_dims:
+            n *= int(e)
+        return n * _np.dtype(self.dtype).itemsize
+
+
 def pack_collection(x):
     """Normalize a driver ``write`` input: a tuple/list of same-pencil
     arrays (reference ``PencilArrayCollection``, ``arrays.jl:183-195``)
-    stacks into ONE array with a trailing component dim — written as one
-    higher-dimensional dataset (``ext/PencilArraysHDF5Ext.jl:222-229``)
-    so a multi-field state (u, v, w, p) restarts consistently in one
-    call.  Returns ``(array, n_components or None)``."""
+    becomes ONE dataset with a trailing component dim
+    (``ext/PencilArraysHDF5Ext.jl:222-229``) so a multi-field state
+    (u, v, w, p) restarts consistently in one call.  Returns
+    ``(PencilArray | CollectionView, n_components or None)`` — the view
+    streams per-component blocks, no stacked device copy."""
     if isinstance(x, (tuple, list)):
         if not x:
             raise ValueError("cannot write an empty collection")
@@ -79,7 +116,7 @@ def pack_collection(x):
             raise TypeError(
                 f"collection elements must be PencilArrays sharing a "
                 f"pencil; got {bad}")
-        return PencilArray.stack(list(x)), len(x)
+        return CollectionView(list(x)), len(x)
     return x, None
 
 

@@ -240,7 +240,6 @@ class HDF5File:
         order); after the data barrier, process 0 rebuilds the master's
         virtual dataset from pencil math alone and a final barrier
         orders the commit before any reader."""
-        from ..parallel.arrays import _inv_axes
         from ..utils.timers import timeit
         from .binary import iter_local_blocks
 
@@ -248,11 +247,11 @@ class HDF5File:
             pen = x.pencil
             topo = pen.topology
             store_dt, marker = self._storage_dtype(x.dtype)
-            inv = _inv_axes(pen, x.ndims_extra)
             grp = self._f.require_group(name)
-            for coords, block_mem in iter_local_blocks(x, MemoryOrder):
+            for coords, _start, block in iter_local_blocks(
+                    x, with_coords=True):
                 rank = topo.rank(coords)
-                block = np.ascontiguousarray(np.transpose(block_mem, inv))
+                block = np.ascontiguousarray(block)
                 if marker:
                     block = block.view(store_dt)
                 ds = f"r{rank}"

@@ -123,12 +123,22 @@ class OrbaxFile:
         # replica); true shape travels in the metadata.  With async_write,
         # save() returns once devices are snapshotted and serialization
         # proceeds in background threads (call wait_until_finished/close
-        # before reading back).
-        self._ckpt.save(target, {"data": x.data})
+        # before reading back).  A collection saves its components as
+        # separate items of one checkpoint — never a stacked device copy
+        # (cast-per-component at most, when dtypes are mixed).
+        if ncomp:
+            common = np.dtype(x.dtype)
+            payload = {f"c{i}": c.data.astype(common)
+                       for i, c in enumerate(x.components)}
+            padded_shape = list(payload["c0"].shape)
+        else:
+            payload = {"data": x.data}
+            padded_shape = list(x.data.shape)
+        self._ckpt.save(target, payload)
         meta = {
             "dtype": np.dtype(x.dtype).name,
             "dims_logical": list(x.pencil.size_global(LogicalOrder)),
-            "dims_padded_memory": list(x.data.shape),
+            "dims_padded_memory": padded_shape,
             "metadata": metadata(x, collection=ncomp),
         }
         if self.async_write:
@@ -154,25 +164,34 @@ class OrbaxFile:
             extra_dims = tuple(meta["metadata"]["extra_dims"])
         saved_perm = meta["metadata"]["permutation"]
         saved_pad = tuple(meta["dims_padded_memory"])
+        ncomp = meta["metadata"].get("collection")
+        dtype = np.dtype(meta["dtype"])
+        keys = [f"c{i}" for i in range(ncomp)] if ncomp else ["data"]
         restored = self._ckpt.restore(
             os.fspath(self._item_dir(name)),
-            {"data": np.empty(saved_pad, dtype=np.dtype(meta["dtype"]))},
-        )["data"]
-        # reconstruct logical array from saved layout, then re-lay out
-        arr = np.asarray(restored)
+            {k: np.empty(saved_pad, dtype=dtype) for k in keys},
+        )
         n = len(dims)
-        if saved_perm:
-            arr = np.transpose(
-                arr,
-                tuple(int(i) for i in np.argsort(saved_perm))
-                + tuple(range(n, n + len(extra_dims))),
-            )
-        arr = arr[tuple(slice(0, d) for d in dims)
-                  + (slice(None),) * len(extra_dims)]
-        from .core import maybe_unstack
+        comp_extra = extra_dims[:-1] if ncomp else extra_dims
 
-        return maybe_unstack(PencilArray.from_global(pencil, arr),
-                             meta["metadata"])
+        def reconstruct(raw):
+            # saved layout -> logical true shape -> target pencil
+            arr = np.asarray(raw)
+            if saved_perm:
+                arr = np.transpose(
+                    arr,
+                    tuple(int(i) for i in np.argsort(saved_perm))
+                    + tuple(range(n, n + len(comp_extra))),
+                )
+            arr = arr[tuple(slice(0, d) for d in dims)
+                      + (slice(None),) * len(comp_extra)]
+            return PencilArray.from_global(pencil, arr)
+
+        if ncomp:
+            # per-component assembly: the restart never holds a stacked
+            # duplicate on device either
+            return tuple(reconstruct(restored[k]) for k in keys)
+        return reconstruct(restored["data"])
 
     def datasets(self):
         return sorted(

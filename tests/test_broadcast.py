@@ -141,16 +141,75 @@ def test_broadcast_zero_extra_collectives(pen):
         assert not re.findall(rf" {op}\(", hlo), op
 
 
-def test_jnp_escape_hatch(pen):
-    """jnp.* has no third-party dispatch: jnp.cos(u) works via
-    __jax_array__ but returns a plain logical-order jax.Array
-    (documented divergence; use np.cos(u) or u.map(jnp.cos) to stay
-    wrapped)."""
+def test_jnp_escape_hatch_warns_once(pen):
+    """jnp.* has no third-party dispatch: jnp.cos(u) unwraps to a plain
+    logical-order jax.Array — allowed, but LOUD (round-3 fix of the
+    silent-unwrap trap): one warning per process, pointing at the
+    wrapped spellings."""
+    import warnings
+
+    from pencilarrays_tpu.parallel import arrays as arrays_mod
+
     u, x = make(pen, 10)
-    y = jnp.cos(x)
+    arrays_mod._unwrap_warned = False
+    with pytest.warns(UserWarning, match="pencil is dropped"):
+        y = jnp.cos(x)
     assert not isinstance(y, PencilArray)
     assert y.shape == x.shape  # true logical shape
     np.testing.assert_allclose(np.asarray(y), np.cos(u), rtol=1e-12)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # second use: silent
+        jnp.sin(x)
+
+
+def test_jnp_unwrap_policy_error(pen, topo, monkeypatch):
+    """The policy binds at TRACE time (jnp jit-caches per signature, and
+    the unwrap is baked into the compiled artifact on cache hits), so
+    each policy is probed with a FRESH pencil signature."""
+    monkeypatch.setenv("PENCILARRAYS_TPU_UNWRAP", "error")
+    x_err = PencilArray.zeros(Pencil(topo, (10, 14, 6), (1, 2)))
+    with pytest.raises(TypeError, match="pencil is dropped"):
+        jnp.cos(x_err)
+    monkeypatch.setenv("PENCILARRAYS_TPU_UNWRAP", "allow")
+    import warnings
+
+    from pencilarrays_tpu.parallel import arrays as arrays_mod
+
+    arrays_mod._unwrap_warned = False
+    x_ok = PencilArray.zeros(Pencil(topo, (6, 10, 14), (1, 2)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # silent, by request
+        assert not isinstance(jnp.cos(x_ok), PencilArray)
+
+
+def test_wrapped_numpy_namespace(pen):
+    """pencilarrays_tpu.numpy: elementwise jnp functions that STAY
+    wrapped (run on memory-order parents, zero collectives); reductions
+    redirect to the masked ops module."""
+    import pencilarrays_tpu.numpy as pnp
+
+    u, x = make(pen, 12)
+    v, y = make(pen, 13)
+    out = pnp.cos(x)
+    assert isinstance(out, PencilArray) and out.pencil == x.pencil
+    np.testing.assert_allclose(gather(out), np.cos(u), rtol=1e-12)
+    np.testing.assert_allclose(gather(pnp.add(x, y)), u + v, rtol=1e-12)
+    # mixed raw operand aligns to the logical shape
+    row = np.arange(u.shape[-1], dtype=u.dtype)
+    np.testing.assert_allclose(gather(pnp.multiply(x, row)), u * row,
+                               rtol=1e-12)
+    # where with scalar branch
+    np.testing.assert_allclose(gather(pnp.where(pnp.greater(x, 0), x, 0.0)),
+                               np.where(u > 0, u, 0.0), rtol=1e-12)
+    with pytest.raises(ValueError, match="different pencils"):
+        pnp.add(x, PencilArray.zeros(pen.replace(decomp_dims=(0, 1)),
+                                     x.extra_dims, x.dtype))
+    with pytest.raises(AttributeError, match="ops.sum"):
+        pnp.sum(x)
+    with pytest.raises(AttributeError, match="elementwise"):
+        pnp.einsum
+    # no PencilArray operands: plain jnp passthrough
+    assert float(pnp.cos(0.0)) == 1.0
 
 
 def test_gufunc_and_multi_output_rejected(pen):

@@ -471,3 +471,22 @@ def test_collection_io_orbax(tmp_path, topo, pen):
     assert isinstance(back, tuple) and len(back) == 3
     for (u, _), b in zip(fields, back):
         np.testing.assert_array_equal(gather(b), u)
+
+
+def test_collection_write_streams_host_side(pen):
+    """Collection writes go through a CollectionView whose blocks are
+    HOST-stacked per shard — no stacked duplicate of the state ever
+    exists in device memory (round-3 review finding)."""
+    from pencilarrays_tpu.io.binary import iter_local_blocks
+    from pencilarrays_tpu.io.core import CollectionView, pack_collection
+
+    fields = [make_data(pen, seed=60 + i)[1] for i in range(3)]
+    view, n = pack_collection(tuple(fields))
+    assert isinstance(view, CollectionView) and n == 3
+    assert view.extra_dims == (3,)
+    blocks = list(iter_local_blocks(view))
+    assert blocks, "no local blocks"
+    for start, b in blocks:
+        assert isinstance(b, np.ndarray)  # host memory, not jax.Array
+        assert b.shape[-1] == 3
+        assert start[-1] == 0
