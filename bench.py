@@ -29,6 +29,15 @@ import json
 REF_GRID_US = 212.889  # benchmarks/grids.jl:115 (NoPermutation broadcast)
 
 
+def _spread():
+    """k1-arm worst/best repeat ratio of the measurement just taken
+    (variance-aware capture: a parity claim is only as good as this
+    number is close to 1)."""
+    from pencilarrays_tpu.utils.benchtime import last_spread
+
+    return last_spread()["k1_worst_over_best"]
+
+
 def bench_grid_broadcast(jax, jnp, np, pa, timeit):
     topo = pa.Topology((1,), devices=jax.devices()[:1])
     shape = (60, 110, 21)
@@ -46,8 +55,10 @@ def bench_grid_broadcast(jax, jnp, np, pa, timeit):
         return a + gx + 2.0 * gy * jnp.cos(gz + eps)
 
     dt_us = timeit(body, u.data, k0=10, k1=10010) * 1e6
+    spread = _spread()
     return {"us": round(dt_us, 3),
-            "vs_reference": round(REF_GRID_US / dt_us, 2)}
+            "vs_reference": round(REF_GRID_US / dt_us, 2),
+            "timing_spread": spread}
 
 
 def bench_transpose_hop(jax, jnp, np, pa, timeit):
@@ -74,19 +85,19 @@ def bench_transpose_hop(jax, jnp, np, pa, timeit):
 
     x = jnp.zeros((n, n, n), jnp.float32)
     t_fw = timeit(fw, x, k0=10, k1=110)
+    spread = _spread()
     t_raw = timeit(raw, x, k0=10, k1=110)
     return {
         "framework_gb_s": round(nbytes / t_fw / 1e9, 1),
         "raw_xla_gb_s": round(nbytes / t_raw / 1e9, 1),
         "ratio_vs_raw_xla": round(t_raw / t_fw, 3),
+        "timing_spread": spread,
     }
 
 
-def bench_fft(jax, jnp, np, pa, timeit):
-    """PencilFFTPlan r2c round trip vs raw jnp.fft round trip, 256^3 f32."""
+def _bench_fft_n(jax, jnp, np, pa, timeit, n, k0, k1):
     from pencilarrays_tpu.ops.fft import PencilFFTPlan
 
-    n = 256
     topo = pa.Topology((1,), devices=jax.devices()[:1])
     plan = PencilFFTPlan(topo, (n, n, n), real=True, dtype=jnp.float32)
     u = plan.allocate_input()
@@ -100,8 +111,9 @@ def bench_fft(jax, jnp, np, pa, timeit):
         return jnp.fft.irfftn(y, s=(n, n, n)).astype(jnp.float32)
 
     x = u.data
-    t_fw = timeit(fw, x, k0=2, k1=42)
-    t_raw = timeit(raw, x, k0=2, k1=42)
+    t_fw = timeit(fw, x, k0=k0, k1=k1)
+    spread = _spread()
+    t_raw = timeit(raw, x, k0=k0, k1=k1)
     # 2 transforms x 5 N^3 log2(N^3) real flops (rough FFT flop model)
     flops = 2 * 5 * n ** 3 * np.log2(float(n) ** 3)
     return {
@@ -109,7 +121,119 @@ def bench_fft(jax, jnp, np, pa, timeit):
         "raw_xla_gflops": round(flops / t_raw / 1e9, 1),
         "ratio_vs_raw_xla": round(t_raw / t_fw, 3),
         "framework_seconds": t_fw,
+        "timing_spread": spread,
     }
+
+
+def bench_fft(jax, jnp, np, pa, timeit):
+    """PencilFFTPlan r2c round trip vs raw jnp.fft round trip, 256^3 f32."""
+    return _bench_fft_n(jax, jnp, np, pa, timeit, 256, k0=2, k1=42)
+
+
+def bench_fft_512(jax, jnp, np, pa, timeit):
+    """BASELINE config 3: 512^3 f32 r2c round trip (the named headline
+    size, not an extrapolation from 256^3)."""
+    return _bench_fft_n(jax, jnp, np, pa, timeit, 512, k0=2, k1=12)
+
+
+def bench_transpose_4d(jax, jnp, np, pa, timeit):
+    """BASELINE config 4: 4-D ComplexF32 array (N=4, M=2) with
+    non-trivial permutations, transpose ROUND TRIP (x->y->x), vs a raw
+    ``jnp.transpose`` pair moving the same bytes (cf. reference
+    ``test/pencils.jl:341-357``; single chip exercises the permuted
+    pack/unpack path — the exchange itself is costed on the virtual mesh
+    in MULTICHIP_COSTS.json)."""
+    shape = (128, 128, 128, 16)  # c64: 268 MB
+    topo = pa.Topology((1, 1), devices=jax.devices()[:1])
+    pen_a = pa.Pencil(topo, shape, (1, 2),
+                      permutation=pa.Permutation(2, 3, 1, 0))
+    pen_b = pa.Pencil(topo, shape, (1, 3),
+                      permutation=pa.Permutation(3, 1, 2, 0))
+
+    def fw(d):
+        a = pa.PencilArray(pen_a, d + d.ravel()[0] * 1e-30)
+        return pa.transpose(pa.transpose(a, pen_b), pen_a).data
+
+    def raw(d):
+        # same data volume through two period-free 4-D permutes
+        y = jnp.transpose(d + d.ravel()[0] * 1e-30, (2, 3, 1, 0))
+        return jnp.transpose(y, (3, 2, 0, 1))
+
+    import math
+
+    # complex buffers must be CREATED on device (eager complex host
+    # transfer is UNIMPLEMENTED through the axon tunnel)
+    czeros = jax.jit(lambda s: jnp.zeros(s, jnp.complex64),
+                     static_argnums=0)
+    x = czeros(pa.Permutation(2, 3, 1, 0).apply(shape))
+    nbytes = 2 * 2 * 8 * math.prod(shape)  # 2 permutes x (read + write)
+    t_fw = timeit(fw, x, k0=4, k1=24)
+    spread = _spread()
+    t_raw = timeit(raw, czeros(shape), k0=4, k1=24)
+    return {
+        "framework_gb_s": round(nbytes / t_fw / 1e9, 1),
+        "raw_xla_gb_s": round(nbytes / t_raw / 1e9, 1),
+        "ratio_vs_raw_xla": round(t_raw / t_fw, 3),
+        "timing_spread": spread,
+    }
+
+
+def bench_ns_step(jax, jnp, np, pa, timeit):
+    """BASELINE config 5 (single-chip scale): 256^3 pseudo-spectral NS
+    RK2 step on the framework vs the same physics written on raw
+    jnp.fft (zero framework involvement)."""
+    from benchmarks import suite
+    from pencilarrays_tpu.models import NavierStokesSpectral, taylor_green
+
+    n = 256
+    topo = pa.Topology((1,), devices=jax.devices()[:1])
+    model = NavierStokesSpectral(topo, n, viscosity=1e-3, dtype=jnp.float32)
+    uh = taylor_green(model)
+
+    def step(d):
+        return model.step(pa.PencilArray(uh.pencil, d, (3,)), 1e-3).data
+
+    t_fw = timeit(step, uh.data, k0=2, k1=12)
+    spread = _spread()
+    t_raw = timeit(suite._raw_ns_step_fn(n, 1e-3), suite._raw_ns_state(n),
+                   k0=2, k1=12)
+    return {
+        "framework_ms": round(t_fw * 1e3, 3),
+        "raw_xla_ms": round(t_raw * 1e3, 3),
+        "ratio_vs_raw_xla": round(t_raw / t_fw, 3),
+        "steps_per_s": round(1.0 / t_fw, 1),
+        "timing_spread": spread,
+    }
+
+
+def bench_fft512_peak_hbm(jax, jnp, np, pa, timeit):
+    """Donation through the 512^3 plan chain: peak device memory of the
+    compiled forward with vs without input donation
+    (``compiled.memory_analysis()``)."""
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+
+    n = 512
+    topo = pa.Topology((1,), devices=jax.devices()[:1])
+    plan = PencilFFTPlan(topo, (n, n, n), real=True, dtype=jnp.float32)
+    u = plan.allocate_input()
+
+    def fw(d):
+        return plan.forward(pa.PencilArray(plan.input_pencil, d)).data
+
+    def peak(donate):
+        c = jax.jit(fw, donate_argnums=(0,) if donate else ()).lower(
+            u.data).compile()
+        m = c.memory_analysis()
+        if m is None:
+            return None
+        return int(m.temp_size_in_bytes + m.output_size_in_bytes
+                   + m.argument_size_in_bytes - m.alias_size_in_bytes)
+
+    no, yes = peak(False), peak(True)
+    out = {"no_donation_bytes": no, "donated_bytes": yes}
+    if no and yes:
+        out["saved_mb"] = round((no - yes) / 1e6, 1)
+    return out
 
 
 def main():
@@ -126,8 +250,12 @@ def main():
     failures = {}
     for key, fn in [
         ("fft_r2c_256", bench_fft),
+        ("fft_r2c_512", bench_fft_512),
         ("transpose_hop_256", bench_transpose_hop),
+        ("transpose_4d_c64_roundtrip", bench_transpose_4d),
+        ("ns_step_256", bench_ns_step),
         ("grid_broadcast_60x110x21_f64", bench_grid_broadcast),
+        ("fft512_peak_hbm", bench_fft512_peak_hbm),
     ]:
         try:
             out[key] = fn(jax, jnp, np, pa, device_seconds_per_iter)

@@ -1,0 +1,2 @@
+"""Benchmark package: the extended suite (BENCH_DETAILS.json) and shared
+raw-XLA baseline helpers importable by the driver-facing bench.py."""

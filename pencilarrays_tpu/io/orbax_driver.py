@@ -166,13 +166,22 @@ class OrbaxFile:
         saved_pad = tuple(meta["dims_padded_memory"])
         ncomp = meta["metadata"].get("collection")
         dtype = np.dtype(meta["dtype"])
-        keys = [f"c{i}" for i in range(ncomp)] if ncomp else ["data"]
+        n = len(dims)
+        # Legacy collection checkpoints (pre round-3) stored ONE stacked
+        # array under "data"; the saved padded shape then carries the
+        # trailing component dim, which distinguishes the formats.
+        legacy_stacked = (ncomp
+                          and len(saved_pad) == n + len(extra_dims))
+        if legacy_stacked:
+            keys = ["data"]
+        else:
+            keys = [f"c{i}" for i in range(ncomp)] if ncomp else ["data"]
         restored = self._ckpt.restore(
             os.fspath(self._item_dir(name)),
             {k: np.empty(saved_pad, dtype=dtype) for k in keys},
         )
-        n = len(dims)
-        comp_extra = extra_dims[:-1] if ncomp else extra_dims
+        comp_extra = extra_dims[:-1] if (ncomp and not legacy_stacked) \
+            else extra_dims
 
         def reconstruct(raw):
             # saved layout -> logical true shape -> target pencil
@@ -187,6 +196,8 @@ class OrbaxFile:
                       + (slice(None),) * len(comp_extra)]
             return PencilArray.from_global(pencil, arr)
 
+        if legacy_stacked:
+            return reconstruct(restored["data"]).unstack()
         if ncomp:
             # per-component assembly: the restart never holds a stacked
             # duplicate on device either

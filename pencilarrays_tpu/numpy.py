@@ -77,6 +77,15 @@ def _wrap(name):
         conv = [convert(a, lead) for a in args]
         kconv = {k: convert(v, lead) for k, v in kwargs.items()}
         out = fn(*conv, **kconv)
+        if getattr(out, "shape", None) != lead.data.shape:
+            # e.g. single-argument where() returns index tuples — and
+            # indices over the padded memory-order parent would be wrong
+            # anyway; only parent-shaped elementwise results are valid
+            raise TypeError(
+                f"{name}: this call form is not elementwise over the "
+                f"pencil parent (result {type(out).__name__} vs parent "
+                f"shape {lead.data.shape}); operate on u.logical() "
+                f"explicitly")
         return PencilArray(lead.pencil, out, lead.extra_dims)
 
     call.__name__ = name

@@ -467,9 +467,28 @@ class PencilFFTPlan:
                                  self.dtype_spectral)
 
     # -- transforms -------------------------------------------------------
-    def forward(self, u: PencilArray) -> PencilArray:
+    @staticmethod
+    def _hop_donate(x: PencilArray, owned: bool) -> bool:
+        """Donate a hop's input buffer when it is an intermediate this
+        plan created (``owned``) and we are NOT tracing — under an outer
+        ``jit`` the whole chain is one XLA program whose buffer reuse the
+        compiler already owns, and an inner-jit donation hint would only
+        warn.  Eagerly, per-hop donation lets XLA alias the exchange
+        in/out buffers, the analog of the reference's in-place
+        ``ManyPencilArray`` transposes (``multiarrays.jl:106-130``).
+        Donation is live on CPU too (verified: buffers invalidate, no
+        warnings), so the virtual-mesh tests exercise this path."""
+        import jax.core
+
+        return owned and not isinstance(x.data, jax.core.Tracer)
+
+    def forward(self, u: PencilArray, *, donate: bool = False
+                ) -> PencilArray:
         """Physical -> spectral: interpret the static schedule (batched
-        local transforms + single-hop transposes)."""
+        local transforms + single-hop transposes).  ``donate=True``
+        additionally donates the INPUT array's buffer to the first hop
+        (``u`` becomes invalid, like ``transpose(donate=True)``);
+        intermediates are always donated when running eagerly."""
         if u.pencil != self.input_pencil:
             raise ValueError(
                 f"input must live on plan.input_pencil "
@@ -477,21 +496,26 @@ class PencilFFTPlan:
             )
         nd_extra = u.ndims_extra
         x = u
+        owned = donate
         for step in self._steps:
             if step[0] == "t":
-                x = transpose(x, step[2], method=self.method)
+                x = transpose(x, step[2], method=self.method,
+                              donate=self._hop_donate(x, owned))
             else:
                 _, pre, post, ops, pre_complex = step
                 data = _stage_fn(pre, nd_extra, ops, False, pre_complex)(
                     x.data)
                 x = PencilArray(post, data, x.extra_dims)
+            owned = True  # every step output is plan-owned
         if x.dtype != self.dtype_spectral:
             x = PencilArray(x.pencil, x.data.astype(self.dtype_spectral),
                             x.extra_dims)
         return x
 
-    def backward(self, uh: PencilArray) -> PencilArray:
-        """Spectral -> physical (inverse transforms, reverse schedule)."""
+    def backward(self, uh: PencilArray, *, donate: bool = False
+                 ) -> PencilArray:
+        """Spectral -> physical (inverse transforms, reverse schedule).
+        ``donate`` as in :meth:`forward`."""
         if uh.pencil != self.output_pencil:
             raise ValueError(
                 f"input must live on plan.output_pencil "
@@ -499,14 +523,17 @@ class PencilFFTPlan:
             )
         nd_extra = uh.ndims_extra
         x = uh
+        owned = donate
         for step in reversed(self._steps):
             if step[0] == "t":
-                x = transpose(x, step[1], method=self.method)
+                x = transpose(x, step[1], method=self.method,
+                              donate=self._hop_donate(x, owned))
             else:
                 _, pre, post, ops, pre_complex = step
                 data = _stage_fn(post, nd_extra, ops, True, pre_complex)(
                     x.data)
                 x = PencilArray(pre, data, x.extra_dims)
+            owned = True
         if x.dtype != self.dtype_physical:
             x = PencilArray(x.pencil, x.data.astype(self.dtype_physical),
                             x.extra_dims)

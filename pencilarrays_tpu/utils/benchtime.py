@@ -12,6 +12,11 @@ wall-clock timing measures dispatch, not kernels.  The protocol here:
    swamping the difference) fall back to the conservative per-iteration
    upper bound ``t(k1)/k1`` instead of reporting absurd throughput.
 
+The observed per-repeat spread rides along (``last_spread``): single
+numbers through a shared tunnel are only trustworthy with their
+variance attached, so the bench artifact records it per metric and
+parity/speedup claims can be checked against the noise floor.
+
 Used by ``bench.py`` and ``benchmarks/suite.py``.
 """
 
@@ -20,7 +25,16 @@ from __future__ import annotations
 import time
 from typing import Callable
 
-__all__ = ["device_seconds_per_iter"]
+__all__ = ["device_seconds_per_iter", "last_spread"]
+
+_LAST_SPREAD: dict = {"k1_worst_over_best": None}
+
+
+def last_spread() -> dict:
+    """Per-repeat variance of the most recent measurement: the k1 arm's
+    worst/best wall-clock ratio (1.0 = perfectly stable; tunnel noise
+    shows up here first)."""
+    return dict(_LAST_SPREAD)
 
 
 def device_seconds_per_iter(body: Callable, x0, *, k0: int, k1: int,
@@ -36,15 +50,18 @@ def device_seconds_per_iter(body: Callable, x0, *, k0: int, k1: int,
             return jnp.sum(jnp.abs(out)).astype(jnp.float32)
 
         float(run(x0))  # compile + warm
-        best = float("inf")
+        best, worst = float("inf"), 0.0
         for _ in range(repeats):
             t0 = time.perf_counter()
             float(run(x0))
-            best = min(best, time.perf_counter() - t0)
-        return best
+            dt = time.perf_counter() - t0
+            best = min(best, dt)
+            worst = max(worst, dt)
+        return best, worst
 
-    t_k0 = timed(k0)
-    t_k1 = timed(k1)
+    t_k0, _ = timed(k0)
+    t_k1, w_k1 = timed(k1)
+    _LAST_SPREAD["k1_worst_over_best"] = round(w_k1 / t_k1, 3) if t_k1 else None
     slope = (t_k1 - t_k0) / (k1 - k0)
     upper = t_k1 / k1  # includes amortized dispatch: always >= true slope
     if slope <= 0 or slope < 1e-3 * upper:

@@ -206,6 +206,11 @@ def test_wrapped_numpy_namespace(pen):
                                      x.extra_dims, x.dtype))
     with pytest.raises(AttributeError, match="ops.sum"):
         pnp.sum(x)
+    # single-argument where returns index tuples, not an elementwise
+    # result — rejected loudly (indices over the padded parent would be
+    # wrong anyway)
+    with pytest.raises(TypeError, match="not elementwise"):
+        pnp.where(pnp.greater(x, 0))
     with pytest.raises(AttributeError, match="elementwise"):
         pnp.einsum
     # no PencilArray operands: plain jnp passthrough

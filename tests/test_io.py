@@ -490,3 +490,28 @@ def test_collection_write_streams_host_side(pen):
         assert isinstance(b, np.ndarray)  # host memory, not jax.Array
         assert b.shape[-1] == 3
         assert start[-1] == 0
+
+
+def test_orbax_legacy_stacked_collection_readable(tmp_path, topo, pen):
+    """Pre-round-3 orbax collection checkpoints stored ONE stacked array
+    under 'data'; the reader detects that layout (padded shape carries
+    the trailing component dim) and still restores the tuple."""
+    if not has_orbax():
+        pytest.skip("orbax not available")
+    import json as _json
+
+    fields = [make_data(pen, seed=70 + i) for i in range(2)]
+    stacked = PencilArray.stack([x for _, x in fields])
+    path = str(tmp_path / "legacy_orbax")
+    with open_file(OrbaxDriver(), path, write=True, create=True) as f:
+        f.write("state", stacked)  # plain stacked write, 'data' item
+    # forge the legacy metadata: mark it a collection
+    mp = os.path.join(path, "state.meta.json")
+    meta = _json.load(open(mp))
+    meta["metadata"]["collection"] = 2
+    _json.dump(meta, open(mp, "w"))
+    with open_file(OrbaxDriver(), path, read=True) as f:
+        back = f.read("state", pen)
+    assert isinstance(back, tuple) and len(back) == 2
+    for (u, _), b in zip(fields, back):
+        np.testing.assert_array_equal(gather(b), u)
