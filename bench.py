@@ -92,6 +92,7 @@ def bench_transpose_hop(jax, jnp, np, pa, timeit):
         "raw_xla_gb_s": round(nbytes / t_raw / 1e9, 1),
         "ratio_vs_raw_xla": round(t_raw / t_fw, 3),
         "timing_spread": spread,
+        "timing_spread_raw": _spread(),
     }
 
 
@@ -122,6 +123,7 @@ def _bench_fft_n(jax, jnp, np, pa, timeit, n, k0, k1):
         "ratio_vs_raw_xla": round(t_raw / t_fw, 3),
         "framework_seconds": t_fw,
         "timing_spread": spread,
+        "timing_spread_raw": _spread(),
     }
 
 
@@ -137,27 +139,30 @@ def bench_fft_512(jax, jnp, np, pa, timeit):
 
 
 def bench_transpose_4d(jax, jnp, np, pa, timeit):
-    """BASELINE config 4: 4-D ComplexF32 array (N=4, M=2) with
-    non-trivial permutations, transpose ROUND TRIP (x->y->x), vs a raw
-    ``jnp.transpose`` pair moving the same bytes (cf. reference
+    """BASELINE config 4: 4-D ComplexF32 array (N=4, M=2) with a
+    non-trivial permutation, per-HOP bandwidth vs a raw
+    ``jnp.transpose`` moving the same bytes (cf. reference
     ``test/pencils.jl:341-357``; single chip exercises the permuted
     pack/unpack path — the exchange itself is costed on the virtual mesh
-    in MULTICHIP_COSTS.json)."""
-    shape = (128, 128, 128, 16)  # c64: 268 MB
+    in MULTICHIP_COSTS.json).
+
+    One hop per iteration on a 4-cube with a PERIOD-4 permutation:
+    a literal x->y->x round trip composes to the identity and XLA folds
+    both transposes away (same reason the 3-D hop bench uses a period-3
+    cube permutation) — the round trip is 2x the hop by construction.
+    """
+    shape = (64, 64, 64, 64)  # c64 4-cube: 134 MB
     topo = pa.Topology((1, 1), devices=jax.devices()[:1])
-    pen_a = pa.Pencil(topo, shape, (1, 2),
-                      permutation=pa.Permutation(2, 3, 1, 0))
+    pen_a = pa.Pencil(topo, shape, (1, 2))
     pen_b = pa.Pencil(topo, shape, (1, 3),
-                      permutation=pa.Permutation(3, 1, 2, 0))
+                      permutation=pa.Permutation(1, 2, 3, 0))
 
     def fw(d):
         a = pa.PencilArray(pen_a, d + d.ravel()[0] * 1e-30)
-        return pa.transpose(pa.transpose(a, pen_b), pen_a).data
+        return pa.transpose(a, pen_b).data  # cube: carry shape unchanged
 
     def raw(d):
-        # same data volume through two period-free 4-D permutes
-        y = jnp.transpose(d + d.ravel()[0] * 1e-30, (2, 3, 1, 0))
-        return jnp.transpose(y, (3, 2, 0, 1))
+        return jnp.transpose(d + d.ravel()[0] * 1e-30, (1, 2, 3, 0))
 
     import math
 
@@ -165,16 +170,18 @@ def bench_transpose_4d(jax, jnp, np, pa, timeit):
     # transfer is UNIMPLEMENTED through the axon tunnel)
     czeros = jax.jit(lambda s: jnp.zeros(s, jnp.complex64),
                      static_argnums=0)
-    x = czeros(pa.Permutation(2, 3, 1, 0).apply(shape))
-    nbytes = 2 * 2 * 8 * math.prod(shape)  # 2 permutes x (read + write)
-    t_fw = timeit(fw, x, k0=4, k1=24)
+    x = czeros(shape)
+    nbytes = 2 * 8 * math.prod(shape)  # read + write per permute
+    t_fw = timeit(fw, x, k0=4, k1=44)
     spread = _spread()
-    t_raw = timeit(raw, czeros(shape), k0=4, k1=24)
+    t_raw = timeit(raw, czeros(shape), k0=4, k1=44)
     return {
         "framework_gb_s": round(nbytes / t_fw / 1e9, 1),
         "raw_xla_gb_s": round(nbytes / t_raw / 1e9, 1),
         "ratio_vs_raw_xla": round(t_raw / t_fw, 3),
+        "roundtrip_ms": round(2 * t_fw * 1e3, 3),
         "timing_spread": spread,
+        "timing_spread_raw": _spread(),
     }
 
 
@@ -203,13 +210,19 @@ def bench_ns_step(jax, jnp, np, pa, timeit):
         "ratio_vs_raw_xla": round(t_raw / t_fw, 3),
         "steps_per_s": round(1.0 / t_fw, 1),
         "timing_spread": spread,
+        "timing_spread_raw": _spread(),
     }
 
 
 def bench_fft512_peak_hbm(jax, jnp, np, pa, timeit):
-    """Donation through the 512^3 plan chain: peak device memory of the
-    compiled forward with vs without input donation
-    (``compiled.memory_analysis()``)."""
+    """Donation through the 512^3 plan chain: device memory of the
+    compiled ROUND TRIP with vs without input donation
+    (``compiled.memory_analysis()``).  The round trip is the honest
+    single-chip measurement: forward alone cannot alias (the r2c output
+    has a different byte size, and one chip has no intermediate hops),
+    while the round trip's matching in/out shapes let XLA write the
+    result into the donated input — the 2x-state saving the eager
+    per-hop donation delivers on multi-chip chains."""
     from pencilarrays_tpu.ops.fft import PencilFFTPlan
 
     n = 512
@@ -217,11 +230,12 @@ def bench_fft512_peak_hbm(jax, jnp, np, pa, timeit):
     plan = PencilFFTPlan(topo, (n, n, n), real=True, dtype=jnp.float32)
     u = plan.allocate_input()
 
-    def fw(d):
-        return plan.forward(pa.PencilArray(plan.input_pencil, d)).data
+    def rt(d):
+        a = pa.PencilArray(plan.input_pencil, d)
+        return plan.backward(plan.forward(a)).data
 
-    def peak(donate):
-        c = jax.jit(fw, donate_argnums=(0,) if donate else ()).lower(
+    def mem(donate):
+        c = jax.jit(rt, donate_argnums=(0,) if donate else ()).lower(
             u.data).compile()
         m = c.memory_analysis()
         if m is None:
@@ -229,7 +243,7 @@ def bench_fft512_peak_hbm(jax, jnp, np, pa, timeit):
         return int(m.temp_size_in_bytes + m.output_size_in_bytes
                    + m.argument_size_in_bytes - m.alias_size_in_bytes)
 
-    no, yes = peak(False), peak(True)
+    no, yes = mem(False), mem(True)
     out = {"no_donation_bytes": no, "donated_bytes": yes}
     if no and yes:
         out["saved_mb"] = round((no - yes) / 1e6, 1)
@@ -252,7 +266,7 @@ def main():
         ("fft_r2c_256", bench_fft),
         ("fft_r2c_512", bench_fft_512),
         ("transpose_hop_256", bench_transpose_hop),
-        ("transpose_4d_c64_roundtrip", bench_transpose_4d),
+        ("transpose_4d_c64_hop", bench_transpose_4d),
         ("ns_step_256", bench_ns_step),
         ("grid_broadcast_60x110x21_f64", bench_grid_broadcast),
         ("fft512_peak_hbm", bench_fft512_peak_hbm),

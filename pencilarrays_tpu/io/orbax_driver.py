@@ -170,8 +170,11 @@ class OrbaxFile:
         # Legacy collection checkpoints (pre round-3) stored ONE stacked
         # array under "data"; the saved padded shape then carries the
         # trailing component dim, which distinguishes the formats.
+        # Detection uses the WRITE-time metadata extra dims (fixed on
+        # disk), never the caller-overridable extra_dims parameter.
+        stored_extra = meta["metadata"]["extra_dims"]
         legacy_stacked = (ncomp
-                          and len(saved_pad) == n + len(extra_dims))
+                          and len(saved_pad) == n + len(stored_extra))
         if legacy_stacked:
             keys = ["data"]
         else:

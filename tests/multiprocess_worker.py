@@ -116,6 +116,16 @@ def main():
     assert np.allclose(out_r, expect, rtol=2e-4, atol=2e-5), "ring attn"
     assert np.allclose(out_u, expect, rtol=2e-4, atol=2e-5), "ulysses attn"
 
+    # zigzag causal ring across the process boundary (round 3)
+    from pencilarrays_tpu.models import from_zigzag, to_zigzag
+
+    expect_c = np.asarray(dense_attention(
+        jnp.asarray(qn), jnp.asarray(kn), jnp.asarray(vn), causal=True))
+    out_z = pa.gather(from_zigzag(ring_attention(
+        to_zigzag(qa), to_zigzag(ka), to_zigzag(va),
+        causal=True, zigzag=True)))
+    assert np.allclose(out_z, expect_c, rtol=2e-4, atol=2e-5), "zigzag attn"
+
     pa.distributed.sync_global_devices("done")
     print(f"WORKER_OK pid={pid} sum={total:.6f}")
 

@@ -507,9 +507,11 @@ def test_orbax_legacy_stacked_collection_readable(tmp_path, topo, pen):
         f.write("state", stacked)  # plain stacked write, 'data' item
     # forge the legacy metadata: mark it a collection
     mp = os.path.join(path, "state.meta.json")
-    meta = _json.load(open(mp))
+    with open(mp) as fh:
+        meta = _json.load(fh)
     meta["metadata"]["collection"] = 2
-    _json.dump(meta, open(mp, "w"))
+    with open(mp, "w") as fh:
+        _json.dump(meta, fh)
     with open_file(OrbaxDriver(), path, read=True) as f:
         back = f.read("state", pen)
     assert isinstance(back, tuple) and len(back) == 2
