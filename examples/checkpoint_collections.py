@@ -1,0 +1,74 @@
+"""Collection-level checkpoint/restart — runnable demo.
+
+Run (CPU virtual mesh):
+
+    python examples/checkpoint_collections.py
+
+A (u, v, w, p) multi-field state is written as ONE dataset per driver
+(trailing component dim — reference ``PencilArrayCollection`` datasets,
+``ext/PencilArraysHDF5Ext.jl:222-229``) and restarted under a DIFFERENT
+decomposition in one call.  Checkpoint rotation on the binary driver is
+crash-consistent: rewrites ping-pong between two file regions and the
+sidecar flush is the commit point, so file size stays bounded and the
+previous checkpoint survives any crash mid-write.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import pencilarrays_tpu as pa
+from pencilarrays_tpu.io import BinaryDriver, HDF5Driver, has_hdf5, open_file
+
+shape = (24, 18, 12)
+topo = pa.Topology((2, 4))
+pen = pa.Pencil(topo, shape, (1, 2))
+rng = np.random.default_rng(0)
+state = tuple(
+    pa.PencilArray.from_global(pen, rng.standard_normal(shape).astype("f4"))
+    for _ in range(4))  # (u, v, w, p)
+
+workdir = tempfile.mkdtemp()
+path = os.path.join(workdir, "flow.bin")
+
+# -- write the whole state as ONE dataset, rotate it three times ----------
+with open_file(BinaryDriver(), path, write=True, create=True) as f:
+    f.write("state", state)
+size_after_first = os.path.getsize(path)
+for step in range(3):
+    bumped = tuple(x * (1.0 + step) for x in state)
+    with open_file(BinaryDriver(), path, append=True, write=True) as f:
+        f.write("state", bumped)  # crash-safe ping-pong rewrite
+size_final = os.path.getsize(path)
+assert size_final <= 2 * size_after_first + 4096, "rotation must stay bounded"
+
+# -- restart under a DIFFERENT decomposition, one call --------------------
+pen2 = pa.Pencil(pa.Topology((8,)), shape, (0,))
+with open_file(BinaryDriver(), path, read=True) as f:
+    u, v, w, p = f.read("state", pen2)
+np.testing.assert_allclose(pa.gather(u), 3.0 * pa.gather(state[0]), rtol=1e-6)
+print(f"binary: 4-field state rotated 3x (file bounded at "
+      f"{size_final / 1e3:.0f} kB) and restarted on a slab topology")
+
+# -- same collection contract on HDF5 (plain h5py-readable) ---------------
+if has_hdf5():
+    h5 = os.path.join(workdir, "flow.h5")
+    with open_file(HDF5Driver(), h5, write=True, create=True) as f:
+        f.write("state", state)
+    with open_file(HDF5Driver(), h5, read=True) as f:
+        u2, *_ = f.read("state", pen2)
+    np.testing.assert_array_equal(pa.gather(u2), pa.gather(state[0]))
+    import h5py
+
+    with h5py.File(h5, "r") as mf:  # one ecosystem-readable dataset
+        assert mf["state"].shape == shape + (4,)
+    print("hdf5: same state as one (24, 18, 12, 4) dataset, h5py-readable")
+
+print("collection checkpoint/restart OK")

@@ -54,3 +54,15 @@ rtol, atol = ((5e-3, 5e-4) if jax.default_backend() == "tpu"
 np.testing.assert_allclose(pa.gather(out_u), expect, rtol=rtol, atol=atol)
 np.testing.assert_allclose(pa.gather(out_r), expect, rtol=rtol, atol=atol)
 print(f"ulysses == ring == dense attention for S={S} over {P} devices")
+
+# -- zigzag causal ring (round 3): ~half the causal FLOPs -----------------
+from pencilarrays_tpu.models import from_zigzag, to_zigzag
+
+qz, kz, vz = map(to_zigzag, (q, k, v))   # device i holds blocks (i, 2P-1-i)
+out_z = from_zigzag(ring_attention(qz, kz, vz, causal=True, zigzag=True))
+expect_c = np.asarray(dense_attention(
+    jnp.asarray(pa.gather(q)), jnp.asarray(pa.gather(k)),
+    jnp.asarray(pa.gather(v)), causal=True))
+np.testing.assert_allclose(pa.gather(out_z), expect_c, rtol=rtol, atol=atol)
+print(f"zigzag causal ring == dense causal (balanced schedule, "
+      f"~(4P+2)/(8P) = {(4 * P + 2) / (8 * P):.2f}x the naive FLOPs)")
