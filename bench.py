@@ -250,7 +250,34 @@ def bench_fft512_peak_hbm(jax, jnp, np, pa, timeit):
     return out
 
 
+def _start_watchdog(seconds: float = 1500.0):
+    """Guarantee ONE JSON line even if the TPU tunnel wedges.
+
+    ``jax.devices()`` through a dead tunnel blocks forever and cannot be
+    interrupted from Python; without this, a wedged chip turns the whole
+    bench into a silent driver timeout.  The watchdog emits a parseable
+    failure line and hard-exits instead.  1500 s comfortably covers a
+    healthy full run (512^3 compiles included)."""
+    import os
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": "fft_r2c_roundtrip_256_gflops_per_chip",
+            "value": None, "unit": "gflops", "vs_baseline": None,
+            "failures": {"watchdog": "bench exceeded its deadline "
+                         "(TPU tunnel unresponsive?)"}}), flush=True)
+        os._exit(1)  # nonzero: the line is parseable but the run failed
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
+    watchdog = _start_watchdog()
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -286,6 +313,7 @@ def main():
     }
     if failures:
         line["failures"] = failures
+    watchdog.cancel()
     print(json.dumps(line))
 
 
