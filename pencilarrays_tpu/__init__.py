@@ -35,7 +35,9 @@ from .utils.permuted_indices import (  # noqa: F401
 from .parallel import (  # noqa: F401
     AllToAll,
     Alltoallv,
+    Auto,
     PointToPoint,
+    resolve_method,
     Ring,
     Gspmd,
     IndexOrder,

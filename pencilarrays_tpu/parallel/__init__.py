@@ -12,12 +12,14 @@ from .arrays import PencilArray, global_view
 from .transpositions import (
     AllToAll,
     Alltoallv,
+    Auto,
     PointToPoint,
     Ring,
     Gspmd,
     Transposition,
     assert_compatible,
     reshard,
+    resolve_method,
     transpose,
     transpose_cost,
 )
@@ -28,7 +30,9 @@ from . import distributed
 __all__ = [
     "ManyPencilArray",
     "Alltoallv",
+    "Auto",
     "PointToPoint",
+    "resolve_method",
     "Ring",
     "distributed",
     "PencilArray",

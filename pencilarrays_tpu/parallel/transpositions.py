@@ -75,12 +75,14 @@ def _maybe_pallas_transpose(a, axes, platform: str):
 __all__ = [
     "AllToAll",
     "Alltoallv",
+    "Auto",
     "Gspmd",
     "PointToPoint",
     "Ring",
     "Transposition",
     "transpose",
     "transpose_cost",
+    "resolve_method",
     "reshard",
     "assert_compatible",
 ]
@@ -117,6 +119,45 @@ class Ring(AbstractTransposeMethod):
 # reference method-name aliases (Transpositions.jl:17-24)
 PointToPoint = Ring
 Alltoallv = AllToAll
+
+
+@dataclass(frozen=True)
+class Auto(AbstractTransposeMethod):
+    """Pick the exchange method per (pin, pout) configuration — the
+    planner role FFTW's ``ESTIMATE``/``MEASURE`` flags play for the
+    reference's FFT consumer (PencilFFTs lets callers sweep methods by
+    hand; here the framework chooses).
+
+    ``mode="estimate"`` (default): decide from the *validated* analytic
+    byte model (:func:`transpose_cost` — prediction is test-pinned equal
+    to compiled-HLO measurement).  :class:`Ring` is chosen exactly when
+    its ragged-aware round elision moves fewer modeled wire bytes than
+    one fused ``all_to_all``, charging each serialized ppermute round a
+    latency toll of ``latency_bytes`` bytes-equivalent:
+
+    ``(G-1) * (latency_bytes + tile)  <  latency_bytes + (P-1) * tile``
+
+    With divisible extents ``G == P`` and AllToAll always wins (one
+    fused collective, same bytes); strong raggedness (``G << P``) tips
+    to Ring once tiles outweigh per-round latency.
+
+    ``mode="measure"``: FFTW_MEASURE-style — compile both candidates for
+    the actual configuration and time a forward+back pair on device
+    (hardened K-differenced protocol, ``utils/benchtime.py``), caching
+    the winner per configuration for the life of the process.
+
+    Either way the data movement is bit-identical across candidates
+    (test-pinned), so Auto never changes results — only scheduling.
+    """
+
+    mode: str = "estimate"
+    latency_bytes: int = 128 * 1024
+
+    def __post_init__(self):
+        if self.mode not in ("estimate", "measure"):
+            raise ValueError(
+                f"Auto mode must be 'estimate' or 'measure', got "
+                f"{self.mode!r}")
 
 
 def assert_compatible(pin: Pencil, pout: Pencil) -> Optional[int]:
@@ -362,6 +403,8 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
     import numpy as np
 
     R = assert_compatible(pin, pout)
+    if isinstance(method, Auto):
+        method = resolve_method(pin, pout, extra_dims, dtype, method)
     if R is None:
         return {}
     P = pin.topology.dims[R]
@@ -399,6 +442,80 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
         f"no analytic cost model for method {method!r} (Gspmd collectives "
         f"are chosen by the partitioner; measure them with "
         f"utils.hlo.collective_stats instead)")
+
+
+# ---------------------------------------------------------------------------
+# automatic method selection
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=512)
+def _measured_choice(pin: Pencil, pout: Pencil, R: int, extra_dims: tuple,
+                     dtype_str: str) -> AbstractTransposeMethod:
+    """Time both explicit candidates on the actual configuration and cache
+    the winner (FFTW_MEASURE analog).  The timed body is a forward+back
+    pair — shape-preserving, so the hardened in-jit K-differenced
+    protocol (``utils/benchtime.py``) applies directly."""
+    import numpy as np
+
+    from ..utils.benchtime import device_seconds_per_iter
+
+    from ..ops.pallas_kernels import pallas_enabled
+
+    dtype = np.dtype(dtype_str)
+    x0 = PencilArray.zeros(pin, extra_dims, dtype).data
+    extra_ndims = len(extra_dims)
+    candidates = (AllToAll(), Ring())
+    best, best_t = 0, float("inf")
+    for i, cand in enumerate(candidates):
+        fwd = _compiled_transpose(pin, pout, R, extra_ndims, cand,
+                                  _pallas=pallas_enabled())
+        bwd = _compiled_transpose(pout, pin, R, extra_ndims, cand,
+                                  _pallas=pallas_enabled())
+        t = device_seconds_per_iter(lambda d: bwd(fwd(d)), x0,
+                                    k0=1, k1=4, repeats=3)
+        if t < best_t:
+            best, best_t = i, t
+    if jax.process_count() > 1:
+        # Multi-controller: every process MUST run the same collective
+        # program — local timing noise could split the vote, issuing
+        # ppermute rounds on one host and all_to_all on another (pod
+        # deadlock).  Process 0's winner is authoritative.
+        from jax.experimental import multihost_utils
+
+        best = int(multihost_utils.broadcast_one_to_all(
+            jnp.int32(best)))
+    return candidates[best]
+
+
+def resolve_method(pin: Pencil, pout: Pencil,
+                   extra_dims: Tuple[int, ...] = (), dtype=None,
+                   method: AbstractTransposeMethod = Auto()
+                   ) -> AbstractTransposeMethod:
+    """Resolve :class:`Auto` to a concrete method for one hop (concrete
+    methods pass through unchanged).  See :class:`Auto` for the decision
+    rule; different hops of one FFT plan may resolve differently."""
+    if not isinstance(method, Auto):
+        return method
+    R = assert_compatible(pin, pout)
+    if R is None or pin.topology.dims[R] == 1:
+        return AllToAll()  # local permute / trivial axis: method is moot
+    if method.mode == "measure":
+        import numpy as np
+
+        dt = np.dtype(dtype if dtype is not None else np.float32)
+        return _measured_choice(pin, pout, R, tuple(extra_dims), dt.str)
+    P = pin.topology.dims[R]
+    ring = transpose_cost(pin, pout, tuple(extra_dims), dtype, Ring())
+    if not ring:
+        return AllToAll()  # G <= 1: nothing on the wire either way
+    rc = ring["collective-permute"]
+    tile = rc["bytes"] // rc["count"]
+    rounds = rc["count"]  # G - 1
+    L = method.latency_bytes
+    score_ring = rounds * (L + tile)
+    score_a2a = L + (P - 1) * tile
+    return Ring() if score_ring < score_a2a else AllToAll()
 
 
 # ---------------------------------------------------------------------------
@@ -479,6 +596,8 @@ def transpose(src: PencilArray, dest: Pencil, *,
     """
     pin = src.pencil
     R = assert_compatible(pin, dest)
+    if isinstance(method, Auto):
+        method = resolve_method(pin, dest, src.extra_dims, src.dtype, method)
     from ..ops.pallas_kernels import pallas_enabled
 
     with timeit(pin.timer, "transpose!"):

@@ -1,0 +1,125 @@
+"""Automatic transpose-method selection (``Auto``).
+
+The reference leaves the ``PointToPoint()`` vs ``Alltoallv()`` choice to
+the caller (``Transpositions.jl:17-24``); PencilFFTs users sweep it by
+hand.  Here the framework can choose — ``mode="estimate"`` from the
+validated analytic byte model, ``mode="measure"`` FFTW_MEASURE-style on
+the actual configuration.  These tests pin the decision rule and that
+Auto never changes results.
+"""
+
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu import (
+    AllToAll,
+    Auto,
+    Pencil,
+    PencilArray,
+    Ring,
+    Topology,
+    gather,
+    resolve_method,
+    transpose,
+)
+from pencilarrays_tpu.parallel.transpositions import _measured_choice
+
+
+def _pair(topo, shape):
+    pin = Pencil(topo, shape, (0,))
+    pout = pin.replace(decomp_dims=(1,))
+    return pin, pout
+
+
+def test_estimate_dense_prefers_all_to_all(devices):
+    # divisible extents: G == P, ring moves the same bytes in P-1
+    # serialized rounds -> one fused collective wins at any latency toll
+    topo = Topology((8,))
+    pin, pout = _pair(topo, (32, 32, 4))
+    assert resolve_method(pin, pout, (), np.float32,
+                          Auto(latency_bytes=0)) == AllToAll()
+    assert resolve_method(pin, pout, (), np.float32, Auto()) == AllToAll()
+
+
+def test_estimate_ragged_prefers_ring_when_bytes_dominate(devices):
+    # n = 9 over P = 8: only G = 5 ceil-blocks are nonempty, the ring
+    # runs 4 rounds vs 7 tiles of all_to_all wire -> Ring wins once the
+    # per-round latency toll is off
+    topo = Topology((8,))
+    pin, pout = _pair(topo, (9, 9, 4))
+    assert resolve_method(pin, pout, (), np.float32,
+                          Auto(latency_bytes=0)) == Ring()
+    # same configuration, latency-dominant regime (tiles are ~64 bytes):
+    # serializing 4 rounds cannot pay for itself
+    assert resolve_method(pin, pout, (), np.float32,
+                          Auto(latency_bytes=128 * 1024)) == AllToAll()
+
+
+def test_estimate_concrete_methods_pass_through(devices):
+    topo = Topology((8,))
+    pin, pout = _pair(topo, (9, 9, 4))
+    assert resolve_method(pin, pout, (), np.float32, Ring()) == Ring()
+    assert resolve_method(pin, pout, (), np.float32,
+                          AllToAll()) == AllToAll()
+
+
+def test_auto_transpose_matches_ground_truth(devices):
+    topo = Topology((8,))
+    shape = (9, 9, 4)
+    u = (np.arange(np.prod(shape), dtype=np.float64).reshape(shape) + 1) / 3
+    pin, pout = _pair(topo, shape)
+    x = PencilArray.from_global(pin, u)
+    for method in (Auto(), Auto(latency_bytes=0)):
+        y = transpose(x, pout, method=method)
+        np.testing.assert_array_equal(gather(y), u)
+
+
+def test_auto_validates_mode():
+    with pytest.raises(ValueError, match="estimate"):
+        Auto(mode="guess")
+
+
+def test_measure_mode_picks_and_caches(devices):
+    topo = Topology((4, 2))
+    shape = (12, 10, 8)
+    pin = Pencil(topo, shape, (1, 2))
+    pout = pin.replace(decomp_dims=(0, 2))
+    m = resolve_method(pin, pout, (), np.float32, Auto(mode="measure"))
+    assert m in (AllToAll(), Ring())
+    # cached: same configuration resolves to the same object without
+    # re-measuring
+    before = _measured_choice.cache_info().hits
+    m2 = resolve_method(pin, pout, (), np.float32, Auto(mode="measure"))
+    assert m2 == m
+    assert _measured_choice.cache_info().hits == before + 1
+    # and the measured choice produces correct data
+    u = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    x = PencilArray.from_global(pin, u)
+    y = transpose(x, pout, method=Auto(mode="measure"))
+    np.testing.assert_array_equal(gather(y), u)
+
+
+def test_transpose_cost_resolves_auto(devices):
+    topo = Topology((8,))
+    pin, pout = _pair(topo, (9, 9, 4))
+    c_auto = pa.transpose_cost(pin, pout, (), np.float32,
+                               Auto(latency_bytes=0))
+    c_ring = pa.transpose_cost(pin, pout, (), np.float32, Ring())
+    assert c_auto == c_ring
+
+
+def test_fft_plan_accepts_auto(devices):
+    from pencilarrays_tpu import PencilFFTPlan
+
+    topo = Topology((4, 2))
+    plan = PencilFFTPlan(topo, (12, 10, 8), real=True,
+                         method=Auto(latency_bytes=0))
+    u = np.random.default_rng(7).standard_normal((12, 10, 8)).astype(
+        np.float32)
+    x = PencilArray.from_global(plan.input_pencil, u)
+    uh = plan.forward(x)
+    expect = np.fft.fftn(np.fft.rfft(u, axis=0), axes=(1, 2))
+    np.testing.assert_allclose(gather(uh), expect, rtol=2e-4, atol=2e-4)
+    back = plan.backward(uh)
+    np.testing.assert_allclose(gather(back), u, rtol=2e-4, atol=2e-4)
