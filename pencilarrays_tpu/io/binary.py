@@ -391,13 +391,22 @@ class BinaryFile:
         # host memory.
         use_native = native.available()
         if use_native:
+            # Two levels of parallelism share one budget: blocks across
+            # the pool here, rows across C-side threads within a block
+            # (the single-chip case has ONE local block, where only the
+            # inner level can help).
+            # x may be a CollectionView (no .data); local block count is
+            # the process's addressable device count either way
+            nblocks = max(1, len(x.pencil.mesh.local_devices))
+            inner = max(1, native.default_threads() // min(nblocks, 8))
+
             def write_block(start_block):
                 start, block = start_block
                 # native strided scatter (the MPI create_subarray+write_all
                 # analog): GIL-released pwrite runs
                 native.scatter_write(self.filename, offset,
                                      np.ascontiguousarray(block), shape,
-                                     start)
+                                     start, nthreads=inner)
 
             with ThreadPoolExecutor(max_workers=8) as ex:
                 list(ex.map(write_block, iter_local_blocks(x)))

@@ -19,7 +19,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["available", "scatter_write", "gather_read"]
+__all__ = ["available", "default_threads", "scatter_write", "gather_read"]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -38,7 +38,8 @@ def _build() -> bool:
     # concurrent processes (multi-host shared FS, parallel test workers)
     # never dlopen a half-written .so.
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
@@ -66,16 +67,22 @@ def _load() -> Optional[ctypes.CDLL]:
                 return None
         try:
             lib = ctypes.CDLL(_SO)
-        except OSError:
-            _failed = True
-            return None
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        for fn in (lib.pa_scatter_write, lib.pa_gather_read):
-            fn.restype = ctypes.c_int
-            fn.argtypes = [
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            base = [
                 ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
                 ctypes.c_int32, i64p, i64p, i64p, ctypes.c_void_p,
             ]
+            for fn in (lib.pa_scatter_write, lib.pa_gather_read):
+                fn.restype = ctypes.c_int
+                fn.argtypes = base
+            for fn in (lib.pa_scatter_write_mt, lib.pa_gather_read_mt):
+                fn.restype = ctypes.c_int
+                fn.argtypes = base + [ctypes.c_int32]
+        except (OSError, AttributeError):
+            # AttributeError: a stale .so (preserved mtimes) predating a
+            # symbol — fall back to the memmap path rather than crash
+            _failed = True
+            return None
         _lib = lib
         return _lib
 
@@ -88,33 +95,64 @@ def _as_i64(seq: Sequence[int]):
     return (ctypes.c_int64 * len(seq))(*[int(v) for v in seq])
 
 
+def default_threads() -> int:
+    """Worker count for within-block row parallelism: the C side splits a
+    block's strided runs across up to this many threads (each with its own
+    fd), capped by a 4 MiB/thread floor.
+
+    Measured verdict (this image's overlay FS, 512 MB blocks, interleaved
+    repeats): run-coalescing is the reliable win (contiguous blocks
+    collapse to one large sequential write, 1.06 -> 1.70 GB/s) while
+    thread fan-out is consistently SLOWER (strided 512 MB: 535 ms at 1
+    thread vs 638/699 ms at 4/8 — concurrent pwrites defeat the page
+    cache's write-behind).  Default is therefore 1; set
+    ``PENCILARRAYS_TPU_IO_THREADS`` on parallel filesystems (Lustre,
+    GPFS, striped NFS) where independent streams genuinely overlap."""
+    env = os.environ.get("PENCILARRAYS_TPU_IO_THREADS")
+    if env:
+        try:
+            return max(1, min(16, int(env)))
+        except ValueError:
+            import warnings
+
+            warnings.warn(
+                f"PENCILARRAYS_TPU_IO_THREADS={env!r} is not an integer; "
+                f"using 1")
+            return 1
+    return 1
+
+
 def scatter_write(path: str, base_offset: int, block: np.ndarray,
-                  gdims: Sequence[int], start: Sequence[int]) -> None:
+                  gdims: Sequence[int], start: Sequence[int],
+                  nthreads: int = None) -> None:
     """Write a contiguous row-major ``block`` at corner ``start`` of the
     global row-major array of shape ``gdims`` stored at ``base_offset``."""
     lib = _load()
     assert lib is not None, "native library unavailable"
     block = np.ascontiguousarray(block)
-    rc = lib.pa_scatter_write(
+    rc = lib.pa_scatter_write_mt(
         path.encode(), base_offset, block.dtype.itemsize, block.ndim,
         _as_i64(gdims), _as_i64(start), _as_i64(block.shape),
         block.ctypes.data_as(ctypes.c_void_p),
+        int(nthreads if nthreads is not None else default_threads()),
     )
     if rc != 0:
         raise OSError(-rc, f"pa_scatter_write failed ({os.strerror(-rc)})")
 
 
 def gather_read(path: str, base_offset: int, dtype, gdims: Sequence[int],
-                start: Sequence[int], bdims: Sequence[int]) -> np.ndarray:
+                start: Sequence[int], bdims: Sequence[int],
+                nthreads: int = None) -> np.ndarray:
     """Read the block at corner ``start`` of shape ``bdims`` into a
     contiguous array."""
     lib = _load()
     assert lib is not None, "native library unavailable"
     out = np.empty(tuple(int(b) for b in bdims), dtype=np.dtype(dtype))
-    rc = lib.pa_gather_read(
+    rc = lib.pa_gather_read_mt(
         path.encode(), base_offset, out.dtype.itemsize, out.ndim,
         _as_i64(gdims), _as_i64(start), _as_i64(bdims),
         out.ctypes.data_as(ctypes.c_void_p),
+        int(nthreads if nthreads is not None else default_threads()),
     )
     if rc != 0:
         raise OSError(-rc, f"pa_gather_read failed ({os.strerror(-rc)})")

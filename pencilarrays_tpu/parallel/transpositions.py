@@ -468,10 +468,13 @@ def _measured_choice(pin: Pencil, pout: Pencil, R: int, extra_dims: tuple,
     candidates = (AllToAll(), Ring())
     best, best_t = 0, float("inf")
     for i, cand in enumerate(candidates):
-        fwd = _compiled_transpose(pin, pout, R, extra_ndims, cand,
-                                  _pallas=pallas_enabled())
-        bwd = _compiled_transpose(pout, pin, R, extra_ndims, cand,
-                                  _pallas=pallas_enabled())
+        # positional args only: lru_cache keys kwargs differently, and
+        # transpose() looks this executable up positionally — the winner
+        # must be a cache HIT there, not a recompile
+        fwd = _compiled_transpose(pin, pout, R, extra_ndims, cand, False,
+                                  pallas_enabled())
+        bwd = _compiled_transpose(pout, pin, R, extra_ndims, cand, False,
+                                  pallas_enabled())
         t = device_seconds_per_iter(lambda d: bwd(fwd(d)), x0,
                                     k0=1, k1=4, repeats=3)
         if t < best_t:
