@@ -65,7 +65,7 @@ def _load() -> Optional[ctypes.CDLL]:
             if not _build():
                 _failed = True
                 return None
-        try:
+        def _bind():
             lib = ctypes.CDLL(_SO)
             i64p = ctypes.POINTER(ctypes.c_int64)
             base = [
@@ -78,12 +78,22 @@ def _load() -> Optional[ctypes.CDLL]:
             for fn in (lib.pa_scatter_write_mt, lib.pa_gather_read_mt):
                 fn.restype = ctypes.c_int
                 fn.argtypes = base + [ctypes.c_int32]
+            return lib
+
+        try:
+            _lib = _bind()
         except (OSError, AttributeError):
-            # AttributeError: a stale .so (preserved mtimes) predating a
-            # symbol — fall back to the memmap path rather than crash
-            _failed = True
-            return None
-        _lib = lib
+            # A stale .so can pass the mtime check with preserved mtimes
+            # (cp -p / image layers) yet predate a symbol: rebuild once
+            # and retry before conceding to the memmap fallback.
+            if not _build():
+                _failed = True
+                return None
+            try:
+                _lib = _bind()
+            except (OSError, AttributeError):
+                _failed = True
+                return None
         return _lib
 
 

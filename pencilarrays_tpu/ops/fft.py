@@ -397,7 +397,14 @@ class PencilFFTPlan:
             if any(kinds[p] in ("fft", "rfft") for p in batch):
                 is_complex = True
             if tuple(shape) != pre.size_global():
-                cur = Pencil(topology, tuple(shape), dec, permutation=perm)
+                # A local transform never moves data: the post-stage
+                # pencil must keep PRE's decomposition/permutation, not
+                # this chain slot's.  (They differ when an elided hop
+                # leaves the data in an earlier stage's configuration —
+                # e.g. transforms=("none","rfft","fft") on a 1-D mesh,
+                # where stage 1 executes in stage 0's memory order.)
+                cur = Pencil(topology, tuple(shape), pre.decomposition,
+                             permutation=pre.permutation)
             steps.append(("f", pre, cur, tuple(ops), pre_complex))
             pending = [p for p in pending if p not in batch]
         self._steps = tuple(steps)

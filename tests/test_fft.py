@@ -441,3 +441,24 @@ def test_ring_method_plan_end_to_end(topo):
     np.testing.assert_allclose(gather(xh_r), expect, rtol=1e-9, atol=1e-8)
     np.testing.assert_allclose(gather(plan_r.backward(xh_r)), u,
                                rtol=1e-10, atol=1e-10)
+
+
+def test_elided_hop_rfft_keeps_memory_order(devices):
+    """Regression (found by the fuzz sweep): with a 'none' leading dim on
+    a 1-D mesh the stage-1 hop is elided, so the rfft executes in stage
+    0's memory order — the post-shrinkage pencil must keep THAT
+    permutation, not the chain slot's (the bug produced a transposed
+    block shape and a construction-time ValueError)."""
+    from pencilarrays_tpu import Topology
+
+    topo1 = Topology((8,))
+    shape = (8, 7, 13)
+    kinds = ("none", "rfft", "fft")
+    plan = PencilFFTPlan(topo1, shape, transforms=kinds, dtype=jnp.float64)
+    u = np.random.default_rng(77).standard_normal(shape)
+    x = PencilArray.from_global(plan.input_pencil, u)
+    uh = plan.forward(x)
+    expect = np.fft.fft(np.fft.rfft(u, axis=1), axis=2)
+    np.testing.assert_allclose(gather(uh), expect, rtol=1e-9, atol=1e-8)
+    np.testing.assert_allclose(gather(plan.backward(uh)), u,
+                               rtol=1e-10, atol=1e-10)
