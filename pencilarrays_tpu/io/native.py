@@ -60,8 +60,9 @@ def _load() -> Optional[ctypes.CDLL]:
         if not os.path.exists(_SRC):
             _failed = True
             return None
-        if (not os.path.exists(_SO)
-                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        fresh_build = (not os.path.exists(_SO)
+                       or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if fresh_build:
             if not _build():
                 _failed = True
                 return None
@@ -85,8 +86,9 @@ def _load() -> Optional[ctypes.CDLL]:
         except (OSError, AttributeError):
             # A stale .so can pass the mtime check with preserved mtimes
             # (cp -p / image layers) yet predate a symbol: rebuild once
-            # and retry before conceding to the memmap fallback.
-            if not _build():
+            # and retry before conceding to the memmap fallback.  If we
+            # JUST built, recompiling identical source cannot help.
+            if fresh_build or not _build():
                 _failed = True
                 return None
             try:
