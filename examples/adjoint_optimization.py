@@ -1,0 +1,80 @@
+"""Adjoint optimization through the distributed stack — gradient descent
+on an initial condition so its low-pass-filtered field matches a target.
+
+Demonstrates the capability the reference's MPI buffers cannot express:
+``jax.grad`` differentiates THROUGH the multi-collective FFT plan and the
+masked reductions, returning the cotangent as a PencilArray on the same
+pencil (see docs/Autodiff.md).
+
+Run anywhere:  python examples/adjoint_optimization.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+
+# Select the backend BEFORE any device query (a query initializes and
+# pins the backend; a later config update is silently ignored).  Default
+# is the 8-virtual-device CPU mesh — the distributed path this example
+# demonstrates; set PA_EXAMPLE_BACKEND=native to run on the machine's
+# real accelerator(s) instead.
+if os.environ.get("PA_EXAMPLE_BACKEND", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import pencilarrays_tpu as pa
+
+topo = pa.Topology((2, 4)) if len(jax.devices()) >= 8 else pa.Topology(
+    (1,) * 1)
+shape = (32, 24, 20)
+plan = pa.PencilFFTPlan(topo, shape, real=True, dtype=jnp.float32)
+
+rng = np.random.default_rng(0)
+target = pa.PencilArray.from_global(
+    plan.input_pencil, rng.standard_normal(shape).astype(np.float32))
+
+
+def lowpass(u: pa.PencilArray) -> pa.PencilArray:
+    """Keep only modes |k| < cutoff — forward, mask, backward."""
+    uh = plan.forward(u)
+    kx, ky, kz = plan.wavenumbers()
+    keep = (jnp.abs(kx) < 6) & (jnp.abs(ky) < 5) & (jnp.abs(kz) < 5)
+    return plan.backward(
+        pa.PencilArray(uh.pencil, uh.data * keep, uh.extra_dims))
+
+
+# the target's filtered field is a constant of the optimization: compute
+# it once instead of re-running a full FFT round trip every step
+target_lp = lowpass(target)
+
+
+@jax.jit
+def loss_and_grad(u: pa.PencilArray):
+    def loss(v):
+        d = lowpass(v) - target_lp
+        return pa.ops.sum(d * d)
+
+    return jax.value_and_grad(loss)(u)
+
+
+u = pa.PencilArray.zeros(plan.input_pencil, dtype=jnp.float32)
+print(f"devices={len(jax.devices())}  mesh={topo.dims}  shape={shape}")
+l0 = None
+for step in range(40):
+    l, g = loss_and_grad(u)
+    if l0 is None:
+        l0 = float(l)
+    u = pa.PencilArray(u.pencil, u.data - 0.4 * g.data, u.extra_dims)
+    if step % 10 == 0:
+        print(f"  step {step:3d}  loss {float(l):.6f}")
+print(f"loss {l0:.4f} -> {float(l):.8f}; grad type: {type(g).__name__} "
+      f"on pencil decomp {g.pencil.decomposition}")
+assert float(l) < 1e-3 * l0
+print("adjoint optimization converged OK")

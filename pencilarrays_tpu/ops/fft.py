@@ -86,7 +86,7 @@ def _idst(blk, axis):
 
 @lru_cache(maxsize=512)
 def _stage_fn(pen: Pencil, extra_ndims: int, ops: tuple, inverse: bool,
-              pre_complex: bool):
+              pre_complex: bool, norm: str):
     """Cached batched local-transform callable for one schedule step.
 
     ``ops`` is a tuple of ``(kind, mem_axis, n_logical)`` — every
@@ -104,6 +104,12 @@ def _stage_fn(pen: Pencil, extra_ndims: int, ops: tuple, inverse: bool,
     four = tuple(op for op in ops if op[0] in ("fft", "rfft"))
     rf = tuple(op for op in four if op[0] == "rfft")
     cax = tuple(ax for k, ax, n in four if k == "fft")
+    # Fourier-dim normalization (r2r kinds are always ortho): "none"
+    # means unnormalized BOTH ways — jnp spells that forward
+    # norm="backward" (no scaling) + inverse norm="forward" (inverse
+    # scaling lives on the forward it didn't run with).
+    fwd_norm = "backward" if norm == "none" else norm
+    inv_norm = "forward" if norm == "none" else norm
 
     if not inverse:
         def op(blk):
@@ -112,18 +118,20 @@ def _stage_fn(pen: Pencil, extra_ndims: int, ops: tuple, inverse: bool,
                        else _dst(blk, ax))
             if rf:
                 # rfftn transforms its LAST listed axis real-to-complex
-                blk = jnp.fft.rfftn(blk, axes=cax + (rf[0][1],))
+                blk = jnp.fft.rfftn(blk, axes=cax + (rf[0][1],),
+                                    norm=fwd_norm)
             elif cax:
-                blk = jnp.fft.fftn(blk, axes=cax)
+                blk = jnp.fft.fftn(blk, axes=cax, norm=fwd_norm)
             return blk
     else:
         def op(blk):
             if rf:
                 _, ax, n = rf[0]
                 s = tuple(m for k, a, m in four if k == "fft") + (n,)
-                blk = jnp.fft.irfftn(blk, s=s, axes=cax + (ax,))
+                blk = jnp.fft.irfftn(blk, s=s, axes=cax + (ax,),
+                                     norm=inv_norm)
             elif cax:
-                blk = jnp.fft.ifftn(blk, axes=cax)
+                blk = jnp.fft.ifftn(blk, axes=cax, norm=inv_norm)
             if not pre_complex and jnp.iscomplexobj(blk):
                 # forward promoted real->complex here; the spectrum is
                 # conjugate-symmetric, imag is numerically zero
@@ -257,15 +265,19 @@ class PencilFFTPlan:
     ``real=True`` = ``("rfft", "fft", ...)``; ``transform="dct"`` =
     all-DCT.
 
-    Normalization follows ``jnp.fft`` defaults: unnormalized forward,
-    ``1/n``-scaled inverse (R2R kinds are ortho-normalized both ways),
-    so ``backward(forward(u)) == u``.
+    Normalization defaults to ``jnp.fft`` semantics — unnormalized
+    forward, ``1/n``-scaled inverse, ``backward(forward(u)) == u`` —
+    and is selectable via ``normalization`` ("backward" | "ortho" |
+    "forward" | "none"); ``"none"`` is PencilFFTs' unnormalized-BFFT
+    convention with :meth:`scale_factor`.  R2R kinds are
+    ortho-normalized in every mode.
     """
 
     def __init__(self, topology: Topology, global_shape: Sequence[int], *,
                  real: bool = False, dtype=None, permute: bool = True,
                  transform="fft", transforms: Sequence[str] = None,
-                 method: AbstractTransposeMethod = AllToAll()):
+                 method: AbstractTransposeMethod = AllToAll(),
+                 normalization: str = "backward"):
         global_shape = tuple(int(n) for n in global_shape)
         N = len(global_shape)
         M = topology.ndims
@@ -329,6 +341,18 @@ class PencilFFTPlan:
         self.shape_physical = global_shape
         self.method = method
         self.permute = permute
+        # Fourier-dim normalization (PencilFFTs' fft normalization
+        # taxonomy; its unnormalized-backward BFFT + scale_factor(plan)
+        # convention is ``normalization="none"``): "backward" (default,
+        # jnp semantics: bare forward, 1/n inverse), "ortho", "forward",
+        # or "none" (bare BOTH ways; ``backward(forward(u)) ==
+        # scale_factor() * u``).  R2R kinds (dct/dst) stay
+        # ortho-normalized in every mode.
+        if normalization not in ("backward", "ortho", "forward", "none"):
+            raise ValueError(
+                f"normalization must be 'backward', 'ortho', 'forward' or "
+                f"'none', got {normalization!r}")
+        self.normalization = normalization
 
         # -- dtypes -------------------------------------------------------
         needs_real = any(k in ("rfft", "dct", "dst") for k in kinds)
@@ -518,8 +542,8 @@ class PencilFFTPlan:
                               donate=self._hop_donate(x, owned))
             else:
                 _, pre, post, ops, pre_complex = step
-                data = _stage_fn(pre, nd_extra, ops, False, pre_complex)(
-                    x.data)
+                data = _stage_fn(pre, nd_extra, ops, False, pre_complex,
+                                 self.normalization)(x.data)
                 x = PencilArray(post, data, x.extra_dims)
             owned = True  # every step output is plan-owned
         if x.dtype != self.dtype_spectral:
@@ -545,14 +569,28 @@ class PencilFFTPlan:
                               donate=self._hop_donate(x, owned))
             else:
                 _, pre, post, ops, pre_complex = step
-                data = _stage_fn(post, nd_extra, ops, True, pre_complex)(
-                    x.data)
+                data = _stage_fn(post, nd_extra, ops, True, pre_complex,
+                                 self.normalization)(x.data)
                 x = PencilArray(pre, data, x.extra_dims)
             owned = True
         if x.dtype != self.dtype_physical:
             x = PencilArray(x.pencil, x.data.astype(self.dtype_physical),
                             x.extra_dims)
         return x
+
+    def scale_factor(self) -> float:
+        """Global normalization factor of a full round trip:
+        ``backward(forward(u)) == scale_factor() * u``.  1 except for
+        ``normalization="none"``, where it is the product of the
+        transformed Fourier extents — the PencilFFTs ``scale_factor``
+        convention for unnormalized (BFFT-style) plans."""
+        if self.normalization != "none":
+            return 1.0
+        out = 1.0
+        for n, k in zip(self.shape_physical, self.transforms):
+            if k in ("fft", "rfft"):
+                out *= float(n)
+        return out
 
     # -- spectral helpers -------------------------------------------------
     def frequencies(self, d: int, *, spacing: float = 1.0):

@@ -462,3 +462,43 @@ def test_elided_hop_rfft_keeps_memory_order(devices):
     np.testing.assert_allclose(gather(uh), expect, rtol=1e-9, atol=1e-8)
     np.testing.assert_allclose(gather(plan.backward(uh)), u,
                                rtol=1e-10, atol=1e-10)
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward", "none"])
+def test_normalization_modes(topo, norm):
+    """PencilFFTs normalization taxonomy: values match numpy's norm= for
+    the Fourier dims; round trip is identity scaled by scale_factor()
+    (1 except for 'none', the unnormalized-BFFT convention)."""
+    shape = (12, 10, 8)
+    u = np.random.default_rng(31).standard_normal(shape)
+    plan = PencilFFTPlan(topo, shape, real=True, dtype=jnp.float64,
+                         normalization=norm)
+    x = PencilArray.from_global(plan.input_pencil, u)
+    uh = plan.forward(x)
+    np_norm = None if norm in ("backward", "none") else norm
+    expect = np.fft.fftn(np.fft.rfft(u, axis=0, norm=np_norm),
+                         axes=(1, 2), norm=np_norm)
+    np.testing.assert_allclose(gather(uh), expect, rtol=1e-9, atol=1e-9)
+    back = plan.backward(uh)
+    s = plan.scale_factor()
+    assert s == (float(np.prod(shape)) if norm == "none" else 1.0)
+    np.testing.assert_allclose(gather(back), s * u, rtol=1e-9, atol=1e-7)
+
+
+def test_normalization_ortho_parseval(topo):
+    """ortho mode preserves the L2 norm through an all-fft plan."""
+    shape = (8, 12, 10)
+    rng = np.random.default_rng(32)
+    u = rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+    plan = PencilFFTPlan(topo, shape, dtype=jnp.complex128,
+                         normalization="ortho")
+    x = PencilArray.from_global(plan.input_pencil, u)
+    uh = plan.forward(x)
+    np.testing.assert_allclose(
+        float(jnp.sum(jnp.abs(gather(uh)) ** 2)),
+        float(np.sum(np.abs(u) ** 2)), rtol=1e-10)
+
+
+def test_normalization_validated(topo):
+    with pytest.raises(ValueError, match="normalization"):
+        PencilFFTPlan(topo, (8, 8, 8), normalization="weird")
