@@ -219,17 +219,33 @@ def taylor_green(model: NavierStokesSpectral) -> PencilArray:
 
     pen = model.plan.input_pencil
     n = model.shape
-    coords = [np.arange(ni) * (2 * np.pi / ni) for ni in n]
+    # Coordinates in the plan's real dtype: under jax_enable_x64 a bare
+    # np.arange is f64, and f64 compute is UNIMPLEMENTED on TPU.
+    rd = model.plan.dtype_real
+    coords = [(np.arange(ni) * (2 * np.pi / ni)).astype(rd) for ni in n]
     g = localgrid(pen, coords)
     x, y, z = g.components()
-    ux = jnp.cos(x) * jnp.sin(y) * jnp.sin(z)
-    uy = -jnp.sin(x) * jnp.cos(y) * jnp.sin(z)
-    uz = jnp.zeros(jnp.broadcast_shapes(ux.shape, x.shape))
     target = pen.padded_size_global(MemoryOrder) + (3,)
-    u = jnp.stack([jnp.broadcast_to(ux, target[:-1]),
-                   jnp.broadcast_to(uy, target[:-1]),
-                   jnp.broadcast_to(uz, target[:-1])], axis=-1)
-    u = jax.lax.with_sharding_constraint(
-        u.astype(model.plan.dtype_physical), pen.sharding(1))
-    phys = PencilArray(pen, u, (3,))
-    return model.from_physical(phys)
+
+    # ONE traced program: grid broadcast + forward transform + Leray
+    # projection compile together.  TPU-first (everything fuses; a
+    # single remote compile instead of one per eager op on tunneled
+    # backends), and it keeps f64 out: a bare jnp.zeros is f64 under
+    # jax_enable_x64 and would promote the stack to f64 — unsupported
+    # on TPU hardware.
+    _ = model._ks  # warm the cached_property OUTSIDE the trace: filled
+    #               inside jit it would cache tracers (leak on next use)
+
+    @jax.jit
+    def init(x, y, z):
+        ux = jnp.cos(x) * jnp.sin(y) * jnp.sin(z)
+        uy = -jnp.sin(x) * jnp.cos(y) * jnp.sin(z)
+        uz = jnp.zeros(jnp.broadcast_shapes(ux.shape, x.shape), ux.dtype)
+        u = jnp.stack([jnp.broadcast_to(ux, target[:-1]),
+                       jnp.broadcast_to(uy, target[:-1]),
+                       jnp.broadcast_to(uz, target[:-1])], axis=-1)
+        u = jax.lax.with_sharding_constraint(
+            u.astype(model.plan.dtype_physical), pen.sharding(1))
+        return model.from_physical(PencilArray(pen, u, (3,))).data
+
+    return PencilArray(model.plan.output_pencil, init(x, y, z), (3,))

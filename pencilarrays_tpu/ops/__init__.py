@@ -1,6 +1,14 @@
 from . import reductions
+from . import spectral_ops
 from .localgrid import LocalRectilinearGrid, localgrid
 from .random import normal, uniform
+from .spectral_ops import (
+    curl,
+    divergence,
+    gradient,
+    laplacian,
+    solve_poisson,
+)
 from .reductions import (
     extrema,
     all,
@@ -18,6 +26,12 @@ from .reductions import (
 
 __all__ = [
     "reductions",
+    "spectral_ops",
+    "curl",
+    "divergence",
+    "gradient",
+    "laplacian",
+    "solve_poisson",
     "extrema",
     "LocalRectilinearGrid",
     "localgrid",

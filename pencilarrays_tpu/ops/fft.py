@@ -104,12 +104,22 @@ def _stage_fn(pen: Pencil, extra_ndims: int, ops: tuple, inverse: bool,
     four = tuple(op for op in ops if op[0] in ("fft", "rfft"))
     rf = tuple(op for op in four if op[0] == "rfft")
     cax = tuple(ax for k, ax, n in four if k == "fft")
-    # Fourier-dim normalization (r2r kinds are always ortho): "none"
-    # means unnormalized BOTH ways — jnp spells that forward
-    # norm="backward" (no scaling) + inverse norm="forward" (inverse
-    # scaling lives on the forward it didn't run with).
-    fwd_norm = "backward" if norm == "none" else norm
-    inv_norm = "forward" if norm == "none" else norm
+    # Fourier-dim normalization (r2r kinds are always ortho).  The
+    # scaling is applied HERE with weak-typed python floats, never via
+    # jnp.fft's ``norm=``: jnp's norm path materializes the factor as an
+    # f64 array under jax_enable_x64, promoting c64 data to c128 — which
+    # TPU does not support at all.  Bare transforms (norm=None) are
+    # "backward" semantics: unscaled forward, 1/P inverse; the factors
+    # below move between conventions (P = product of this stage's
+    # logical Fourier extents; factors multiply across stages to the
+    # full-transform convention).
+    P_stage = 1.0
+    for k, ax, n in four:
+        P_stage *= float(n)
+    fwd_scale = {"backward": 1.0, "none": 1.0, "forward": 1.0 / P_stage,
+                 "ortho": P_stage ** -0.5}[norm]
+    inv_scale = {"backward": 1.0, "none": P_stage, "forward": P_stage,
+                 "ortho": P_stage ** 0.5}[norm]
 
     if not inverse:
         def op(blk):
@@ -118,20 +128,22 @@ def _stage_fn(pen: Pencil, extra_ndims: int, ops: tuple, inverse: bool,
                        else _dst(blk, ax))
             if rf:
                 # rfftn transforms its LAST listed axis real-to-complex
-                blk = jnp.fft.rfftn(blk, axes=cax + (rf[0][1],),
-                                    norm=fwd_norm)
+                blk = jnp.fft.rfftn(blk, axes=cax + (rf[0][1],))
             elif cax:
-                blk = jnp.fft.fftn(blk, axes=cax, norm=fwd_norm)
+                blk = jnp.fft.fftn(blk, axes=cax)
+            if four and fwd_scale != 1.0:
+                blk = blk * fwd_scale
             return blk
     else:
         def op(blk):
             if rf:
                 _, ax, n = rf[0]
                 s = tuple(m for k, a, m in four if k == "fft") + (n,)
-                blk = jnp.fft.irfftn(blk, s=s, axes=cax + (ax,),
-                                     norm=inv_norm)
+                blk = jnp.fft.irfftn(blk, s=s, axes=cax + (ax,))
             elif cax:
-                blk = jnp.fft.ifftn(blk, axes=cax, norm=inv_norm)
+                blk = jnp.fft.ifftn(blk, axes=cax)
+            if four and inv_scale != 1.0:
+                blk = blk * inv_scale
             if not pre_complex and jnp.iscomplexobj(blk):
                 # forward promoted real->complex here; the spectrum is
                 # conjugate-symmetric, imag is numerically zero
@@ -593,25 +605,36 @@ class PencilFFTPlan:
         return out
 
     # -- spectral helpers -------------------------------------------------
+    @property
+    def dtype_real(self):
+        """Real dtype matching the plan's arithmetic (f32 for c64 etc.).
+        Frequency/wavenumber components carry it so that spectral-
+        coefficient products NEVER promote: under ``jax_enable_x64`` a
+        default-f64 wavenumber times c64 data silently becomes c128 —
+        which TPU does not support at all ("Element type C128")."""
+        return jnp.dtype(jnp.zeros((), self.dtype_spectral).real.dtype)
+
     def frequencies(self, d: int, *, spacing: float = 1.0):
         """Global frequency vector of logical dim ``d`` in CYCLES per
         unit for every transform kind (scale by ``2*pi`` for angular
         wavenumbers, as with ``fftfreq``): ``fftfreq``/``rfftfreq`` for
         Fourier dims; for ``'dct'`` mode ``j`` (the basis function
         ``cos(pi j (x+1/2)/n)``) has angular wavenumber
-        ``pi j/(n spacing)``, i.e. ``j/(2 n spacing)`` cycles."""
+        ``pi j/(n spacing)``, i.e. ``j/(2 n spacing)`` cycles.  Returned
+        in the plan's :attr:`dtype_real`."""
         n = self.shape_physical[d]
         k = self.transforms[d]
+        rd = self.dtype_real
         if k == "none":
             raise ValueError(f"dim {d} has transform 'none': no frequencies")
         if k == "dct":
-            return jnp.arange(n) / (2.0 * n * spacing)
+            return (jnp.arange(n) / (2.0 * n * spacing)).astype(rd)
         if k == "dst":
             # DST-II mode j is sin(pi (j+1) (x+1/2)/n): angular pi(j+1)/n
-            return (jnp.arange(n) + 1.0) / (2.0 * n * spacing)
+            return ((jnp.arange(n) + 1.0) / (2.0 * n * spacing)).astype(rd)
         if k == "rfft":
-            return jnp.fft.rfftfreq(n, d=spacing)
-        return jnp.fft.fftfreq(n, d=spacing)
+            return jnp.fft.rfftfreq(n, d=spacing).astype(rd)
+        return jnp.fft.fftfreq(n, d=spacing).astype(rd)
 
     def wavenumbers(self, order: type = MemoryOrder):
         """Broadcast-shaped mode-number components of the OUTPUT pencil —
@@ -629,7 +652,7 @@ class PencilFFTPlan:
         def mode_vector(d):
             # one definition serves both orders
             if self.transforms[d] == "none":
-                return jnp.zeros(self.shape_spectral[d])
+                return jnp.zeros(self.shape_spectral[d], self.dtype_real)
             return self.frequencies(d) * self.shape_physical[d]
 
         if order is LogicalOrder:

@@ -1,0 +1,97 @@
+"""Compile/trace caching — the TPU analog of the reference's pervasive
+``@inferred`` type-stability assertions (``test/pencils.jl:544-567``):
+there, type instability silently costs every call; here, a cache-key
+defect silently re-traces and re-compiles every call.  These tests pin
+that repeated use hits the caches.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from pencilarrays_tpu import (
+    AllToAll,
+    Pencil,
+    PencilArray,
+    PencilFFTPlan,
+    Permutation,
+    Ring,
+    Topology,
+    transpose,
+)
+from pencilarrays_tpu.parallel.transpositions import _compiled_transpose
+
+
+@pytest.fixture
+def topo(devices):
+    return Topology((2, 4))
+
+
+def test_eager_transpose_reuses_executable(topo):
+    shape = (12, 10, 8)
+    pen = Pencil(topo, shape, (1, 2), permutation=Permutation(2, 0, 1))
+    pen_y = pen.replace(decomp_dims=(0, 2))
+    u = np.random.default_rng(0).standard_normal(shape)
+    x = PencilArray.from_global(pen, u)
+
+    transpose(x, pen_y)  # populate
+    before = _compiled_transpose.cache_info()
+    for _ in range(5):
+        transpose(x, pen_y)
+    after = _compiled_transpose.cache_info()
+    assert after.misses == before.misses, "eager transpose re-traced"
+    assert after.hits == before.hits + 5
+
+
+def test_equal_pencils_share_cache_key(topo):
+    """Pencils are value-hashable: an INDEPENDENTLY constructed equal
+    pencil must hit the same compiled executable (no identity keying)."""
+    shape = (12, 10, 8)
+    pen_a = Pencil(topo, shape, (1, 2))
+    pen_b = Pencil(topo, shape, (1, 2))  # distinct object, equal value
+    assert pen_a == pen_b and hash(pen_a) == hash(pen_b)
+    dst_a = pen_a.replace(decomp_dims=(0, 2))
+    dst_b = pen_b.replace(decomp_dims=(0, 2))
+    u = np.random.default_rng(1).standard_normal(shape)
+    transpose(PencilArray.from_global(pen_a, u), dst_a)
+    before = _compiled_transpose.cache_info()
+    transpose(PencilArray.from_global(pen_b, u), dst_b)
+    assert _compiled_transpose.cache_info().misses == before.misses
+
+
+def test_methods_have_distinct_cache_keys(topo):
+    """Frozen-dataclass methods key the cache by VALUE: AllToAll() !=
+    Ring() but AllToAll() == AllToAll()."""
+    shape = (12, 10, 8)
+    pen = Pencil(topo, shape, (1, 2))
+    dst = pen.replace(decomp_dims=(0, 2))
+    u = np.random.default_rng(2).standard_normal(shape)
+    x = PencilArray.from_global(pen, u)
+    transpose(x, dst, method=AllToAll())
+    before = _compiled_transpose.cache_info()
+    transpose(x, dst, method=Ring())     # new key: must miss
+    mid = _compiled_transpose.cache_info()
+    assert mid.misses == before.misses + 1
+    transpose(x, dst, method=Ring())     # same value: must hit
+    assert _compiled_transpose.cache_info().misses == mid.misses
+
+
+def test_jitted_plan_traces_once(topo):
+    """A jitted closure over a plan is traced once across repeated calls
+    (trace counter via a side-effect probe, the jax-recommended trick)."""
+    shape = (12, 10, 8)
+    plan = PencilFFTPlan(topo, shape, real=True, dtype=np.float64)
+    traces = []
+
+    @jax.jit
+    def fwd(data):
+        traces.append(1)
+        return plan.forward(PencilArray(plan.input_pencil, data)).data
+
+    u = np.random.default_rng(3).standard_normal(shape)
+    x = PencilArray.from_global(plan.input_pencil, u)
+    r1 = fwd(x.data)
+    for _ in range(4):
+        r2 = fwd(x.data)
+    assert len(traces) == 1, f"jitted plan re-traced {len(traces)} times"
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))

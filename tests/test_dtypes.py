@@ -95,3 +95,60 @@ def test_bool_any_all_ragged(topo):
     u2 = np.zeros(shape, dtype=bool)
     u2[8, 10, 12] = True
     assert bool(ops.any(PencilArray.from_global(pen, u2)))
+
+
+def _jaxpr_dtypes(fn, *args):
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    seen = set()
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            # outvars only: weak-typed python-scalar INPUTS (e.g. dt)
+            # appear as f64 consts under x64 but never promote results
+            for v in eqn.outvars:
+                aval = getattr(v, "aval", None)
+                if aval is not None and hasattr(aval, "dtype"):
+                    seen.add(str(aval.dtype))
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+
+    walk(closed.jaxpr)
+    return seen
+
+
+def test_f32_plan_never_promotes_under_x64(topo):
+    """TPU-compat invariant (found on hardware: "Element type C128 is
+    not supported on TPU"): under jax_enable_x64 — which the test env
+    and bench enable — an f32 plan's traced programs must contain NO
+    f64/c128 values.  Promotion vectors pinned here: jnp.fft's norm=
+    scale factor, default-f64 wavenumbers, bare jnp.zeros."""
+    from pencilarrays_tpu import PencilFFTPlan
+
+    shape = (8, 6, 10)
+    for norm in ("backward", "ortho", "none"):
+        plan = PencilFFTPlan(topo, shape, real=True, dtype=jnp.float32,
+                             normalization=norm)
+        x = PencilArray.zeros(plan.input_pencil, (), jnp.float32)
+        bad = {"float64", "complex128"} & _jaxpr_dtypes(
+            lambda d: plan.forward(
+                PencilArray(plan.input_pencil, d)).data, x.data)
+        assert not bad, f"norm={norm} promotes to {bad}"
+        assert plan.dtype_real == jnp.float32
+        for k in plan.wavenumbers():
+            assert k.dtype == jnp.float32
+
+
+def test_f32_ns_model_never_promotes_under_x64(topo):
+    from pencilarrays_tpu.models import NavierStokesSpectral, taylor_green
+
+    model = NavierStokesSpectral(topo, 8, viscosity=1e-2,
+                                 dtype=jnp.float32)
+    uh = taylor_green(model)
+    assert uh.data.dtype == jnp.complex64
+    bad = {"float64", "complex128"} & _jaxpr_dtypes(
+        lambda d: model.step(
+            PencilArray(uh.pencil, d, (3,)), 1e-3).data, uh.data)
+    assert not bad, f"NS step promotes to {bad}"
