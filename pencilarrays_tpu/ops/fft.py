@@ -612,7 +612,11 @@ class PencilFFTPlan:
         coefficient products NEVER promote: under ``jax_enable_x64`` a
         default-f64 wavenumber times c64 data silently becomes c128 —
         which TPU does not support at all ("Element type C128")."""
-        return jnp.dtype(jnp.zeros((), self.dtype_spectral).real.dtype)
+        import numpy as np
+
+        # host-side dtype math only: no device allocation per access
+        return jnp.dtype(np.empty(0, np.dtype(self.dtype_spectral)
+                                  ).real.dtype)
 
     def frequencies(self, d: int, *, spacing: float = 1.0):
         """Global frequency vector of logical dim ``d`` in CYCLES per

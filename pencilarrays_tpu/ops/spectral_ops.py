@@ -47,19 +47,27 @@ def _angular_ks(plan, lengths):
 def _check_spectral(plan, uh: PencilArray, ncomp: int = 0):
     if uh.pencil != plan.output_pencil:
         raise ValueError("operand must live on plan.output_pencil")
-    if ncomp and uh.extra_dims[-1:] != (ncomp,):
+    if ncomp and uh.extra_dims != (ncomp,):
         raise ValueError(
-            f"expected a vector field with trailing extra dim {ncomp}, "
-            f"got extra_dims={uh.extra_dims}")
+            f"expected a vector field with extra_dims=({ncomp},), got "
+            f"extra_dims={uh.extra_dims}")
+
+
+def _aligned(k, fh: PencilArray):
+    """A wavenumber component broadcastable against ``fh`` including its
+    extra dims (raw operands align from the TAIL of logical shape +
+    extra_dims, so component/batch axes need explicit singletons)."""
+    return k[(...,) + (None,) * fh.ndims_extra]
 
 
 def gradient(plan, fh: PencilArray, *,
              lengths: Sequence[float] = None) -> PencilArray:
-    """Spectral gradient of a scalar field: ``(i k_d f^)_d`` stacked into
-    a trailing component dim of size N."""
+    """Spectral gradient: ``(i k_d f^)_d`` stacked into a NEW trailing
+    component dim of size N (existing extra dims are treated as batch
+    dims and broadcast)."""
     _check_spectral(plan, fh)
     ks = _angular_ks(plan, lengths)
-    comps = [fh * (1j * k) for k in ks]
+    comps = [fh * (1j * _aligned(k, fh)) for k in ks]
     return PencilArray.stack(comps)
 
 
@@ -93,16 +101,13 @@ def curl(plan, uh: PencilArray, *,
 
 
 def _k2_for(plan, fh: PencilArray, lengths):
-    """|k|^2 broadcast-aligned to ``fh`` including its extra dims
-    (PencilArray broadcasting aligns raw operands from the TAIL of
-    logical shape + extra_dims, so component axes need explicit
-    singleton dims — the ``mask[..., None]`` pattern of
-    ``models/spectral.py``)."""
+    """|k|^2 broadcast-aligned to ``fh`` including its extra dims (the
+    ``mask[..., None]`` pattern of ``models/spectral.py``)."""
     ks = _angular_ks(plan, lengths)
     k2 = None
     for k in ks:
         k2 = k * k if k2 is None else k2 + k * k
-    return k2[(...,) + (None,) * fh.ndims_extra]
+    return _aligned(k2, fh)
 
 
 def laplacian(plan, fh: PencilArray, *,

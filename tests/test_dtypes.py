@@ -128,7 +128,7 @@ def test_f32_plan_never_promotes_under_x64(topo):
     from pencilarrays_tpu import PencilFFTPlan
 
     shape = (8, 6, 10)
-    for norm in ("backward", "ortho", "none"):
+    for norm in ("backward", "ortho", "forward", "none"):
         plan = PencilFFTPlan(topo, shape, real=True, dtype=jnp.float32,
                              normalization=norm)
         x = PencilArray.zeros(plan.input_pencil, (), jnp.float32)

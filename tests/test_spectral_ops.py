@@ -148,3 +148,21 @@ def test_laplacian_on_vector_field(topo):
         np.testing.assert_allclose(
             gather(plan.backward(back.component(d))), c - c.mean(),
             atol=1e-9)
+
+
+def test_gradient_with_batch_extra_dims(topo):
+    """Batch extra dims broadcast; components stack into a NEW trailing
+    dim (regression: unaligned wavenumbers silently differentiated the
+    wrong axis when a batch extent matched a spectral extent)."""
+    plan = _plan(topo)
+    X, Y, Z = _grid(N)
+    fields = [np.sin(X), np.cos(Y) * np.sin(Z)]
+    fh = PencilArray.stack([
+        plan.forward(PencilArray.from_global(plan.input_pencil, f))
+        for f in fields])  # extra_dims (2,): a batch of scalars
+    gh = gradient(plan, fh)
+    assert gh.extra_dims == (2, 3)
+    gx0 = gather(plan.backward(gh.component(0, 0)))
+    np.testing.assert_allclose(gx0, np.cos(X), atol=1e-9)
+    gy1 = gather(plan.backward(gh.component(1, 1)))
+    np.testing.assert_allclose(gy1, -np.sin(Y) * np.sin(Z), atol=1e-9)
