@@ -1,0 +1,55 @@
+"""One-config pallas-vs-XLA flash attention probe on the current backend.
+
+Usage: python benchmarks/_attn_probe.py S H D dtype causal [outfile]
+Appends one JSON line per run.  Used to produce ATTENTION_SWEEP.json.
+"""
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from pencilarrays_tpu.models.attention import _flash_xla
+from pencilarrays_tpu.ops.flash_pallas import pallas_flash_attention
+from pencilarrays_tpu.utils.benchtime import device_seconds_per_iter, last_spread
+
+
+def main():
+    S, H, D = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    dtype = jnp.dtype(sys.argv[4])
+    causal = sys.argv[5] == "1"
+    outfile = sys.argv[6] if len(sys.argv) > 6 else None
+
+    mk = jax.jit(lambda key: jax.random.normal(key, (S, H, D), dtype))
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q, k, v = mk(kq), mk(kk), mk(kv)
+    flops = 4 * S * S * H * D * (0.5 if causal else 1.0)
+
+    def pall(d):
+        return pallas_flash_attention(d, k, v, causal=causal)
+
+    def xla(d):
+        return _flash_xla(d, k, v, causal=causal, chunk=None,
+                          q_offset=0, kv_offset=0)
+
+    t_p = device_seconds_per_iter(pall, q, k0=1, k1=7, repeats=3)
+    sp_p = last_spread()["k1_worst_over_best"]
+    t_x = device_seconds_per_iter(xla, q, k0=1, k1=7, repeats=3)
+    sp_x = last_spread()["k1_worst_over_best"]
+    rec = {"S": S, "H": H, "D": D, "dtype": jnp.dtype(dtype).name,
+           "causal": causal, "backend": jax.default_backend(),
+           "pallas_ms": round(t_p * 1e3, 3), "xla_ms": round(t_x * 1e3, 3),
+           "pallas_tflops": round(flops / t_p / 1e12, 2),
+           "xla_tflops": round(flops / t_x / 1e12, 2),
+           "speedup": round(t_x / t_p, 3),
+           "spread_pallas": sp_p, "spread_xla": sp_x}
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if outfile:
+        with open(outfile, "a") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -51,7 +51,9 @@ flash-attention cross-length convention) is ``q_offset = Skv - Sq``.
 
 from __future__ import annotations
 
+import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -161,7 +163,7 @@ def _scores(qb, kb):
 
 
 def flash_attention(q, k, v, *, causal: bool = False, chunk: int = None,
-                    q_offset=0, kv_offset=0):
+                    q_offset=0, kv_offset=0, impl: str = "auto"):
     """Blockwise (FlashAttention-style) softmax attention on raw
     ``(S, H, *batch, D)`` arrays — memory ``O(Sq x chunk)``, the full
     ``Sq x Skv`` score matrix never exists.
@@ -171,7 +173,69 @@ def flash_attention(q, k, v, *, causal: bool = False, chunk: int = None,
     A query row whose visible-key set is empty returns an unspecified
     finite value (same as a fully-masked softmax row in the dense
     reference).
+
+    ``impl`` selects the local kernel: ``"xla"`` is the ``lax.scan``
+    streaming path (differentiable, any backend); ``"pallas"`` is the
+    hand-tiled VMEM-resident TPU kernel (:mod:`..ops.flash_pallas`),
+    whose backward recomputes through the XLA path via ``custom_vjp``;
+    ``"auto"`` (default) uses Pallas on TPU when
+    :func:`..ops.flash_pallas.supported` accepts the case and
+    ``PENCILARRAYS_TPU_PALLAS_ATTENTION`` is not ``0``.
     """
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown flash impl {impl!r}")
+    if impl != "xla" and _use_pallas_flash(
+        q, k, v, q_offset, kv_offset, force=(impl == "pallas")):
+        return _flash_pallas_vjp(q, k, v, causal, q_offset, kv_offset)
+    return _flash_xla(q, k, v, causal=causal, chunk=chunk,
+                      q_offset=q_offset, kv_offset=kv_offset)
+
+
+def _use_pallas_flash(q, k, v, q_offset, kv_offset, *, force: bool) -> bool:
+    from ..ops import flash_pallas
+
+    ok = (q.dtype == k.dtype == v.dtype) and flash_pallas.supported(
+        q.shape[0], k.shape[0], q.shape[-1], q.dtype,
+        q_offset=q_offset, kv_offset=kv_offset)
+    if force:
+        if not ok:
+            raise ValueError(
+                "impl='pallas' but flash_pallas.supported() rejects this "
+                "case (traced offsets, unsupported dtype, or tiny shape)")
+        return True
+    if os.environ.get("PENCILARRAYS_TPU_PALLAS_ATTENTION", "1") == "0":
+        return False
+    return ok and jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_pallas_vjp(q, k, v, causal, q_offset, kv_offset):
+    from ..ops.flash_pallas import pallas_flash_attention
+
+    return pallas_flash_attention(q, k, v, causal=causal,
+                                  q_offset=q_offset, kv_offset=kv_offset)
+
+
+def _flash_pallas_fwd(q, k, v, causal, q_offset, kv_offset):
+    return (_flash_pallas_vjp(q, k, v, causal, q_offset, kv_offset),
+            (q, k, v))
+
+
+def _flash_pallas_bwd(causal, q_offset, kv_offset, res, g):
+    # flash backward = streaming recompute; route it through the XLA
+    # scan path, whose VJP is exactly that (no O(S^2) residuals)
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _flash_xla(q_, k_, v_, causal=causal,
+                                      chunk=None, q_offset=q_offset,
+                                      kv_offset=kv_offset), q, k, v)
+    return vjp(g)
+
+
+_flash_pallas_vjp.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
+
+
+def _flash_xla(q, k, v, *, causal, chunk, q_offset, kv_offset):
     out_shape, out_dtype = q.shape, q.dtype
     q, k, v = _fold_batch(q), _fold_batch(k), _fold_batch(v)
     sq, h, b, d = q.shape
@@ -243,8 +307,8 @@ def dense_attention(q, k, v, *, causal: bool = False, q_offset=0,
 
 
 def ulysses_attention(q: PencilArray, k: PencilArray, v: PencilArray,
-                      *, causal: bool = False,
-                      chunk: int = None) -> PencilArray:
+                      *, causal: bool = False, chunk: int = None,
+                      impl: str = "auto") -> PencilArray:
     """Sequence-parallel attention via the all-to-all head/sequence
     reshard (DeepSpeed-Ulysses), as two framework transposes.
 
@@ -271,11 +335,21 @@ def ulysses_attention(q: PencilArray, k: PencilArray, v: PencilArray,
 
     def local_attn(blk):  # blk: (S, H/P, *batch, D, 3), full S local
         out = flash_attention(blk[..., 0], blk[..., 1], blk[..., 2],
-                              causal=causal, chunk=chunk)
+                              causal=causal, chunk=chunk, impl=impl)
         return out[..., None]  # keep the qkv axis for spec symmetry
 
+    # check_vma=False only when the Pallas local kernel may actually run
+    # (pallas_call outputs carry no varying-mesh-axes metadata, which the
+    # static check rejects — same convention as transpositions.py)
+    s_glob = pen_seq.size_global()[0]
+    pallas_may_run = impl != "xla" and _use_pallas_flash(
+        jax.ShapeDtypeStruct((s_glob, 1, q.extra_dims[-1]), q.dtype),
+        jax.ShapeDtypeStruct((s_glob, 1, q.extra_dims[-1]), k.dtype),
+        jax.ShapeDtypeStruct((s_glob, 1, q.extra_dims[-1]), v.dtype),
+        0, 0, force=(impl == "pallas"))
     fn = jax.shard_map(local_attn, mesh=pen_heads.mesh,
-                       in_specs=spec, out_specs=spec)
+                       in_specs=spec, out_specs=spec,
+                       check_vma=not pallas_may_run)
     out_h = PencilArray(pen_heads, fn(qkv_h.data)[..., 0], q.extra_dims)
     return transpose(out_h, pen_seq)  # back: S sharded, H local
 

@@ -1,0 +1,227 @@
+"""Pallas TPU flash-attention kernel — the hot local op of both
+sequence-parallel schemes, hand-tiled.
+
+The XLA path (``models.attention.flash_attention``) streams k/v chunks
+with a ``lax.scan``; each chunk's score block and exp round-trip through
+HBM between scan steps.  This kernel keeps the whole inner loop —
+``q @ k^T``, the running-max softmax statistics, and ``p @ v`` — in VMEM
+across the key-block grid dimension, so the only HBM traffic is the
+q/k/v/out blocks themselves (the FlashAttention tiling argument, mapped
+onto the Mosaic pipeline: scores hit the MXU at (block_q x block_k),
+statistics live in VMEM scratch carried across the innermost grid dim).
+
+Where the permute kernel experiment concluded XLA owns *data movement*
+(``pallas_kernels.py``), attention is the opposite regime — a
+compute-dense fusion XLA will not synthesize from a scan — which is why
+this kernel is worth having while the permute kernel is a demonstrator.
+
+Layout contract: raw arrays shaped ``(S, H, *batch, D)`` (the attention
+module's public layout); the wrapper folds to ``(H*B, S, D)`` for the
+kernel grid ``(H*B, Sq-blocks, Skv-blocks)``.  Sequence lengths need NOT
+divide the block sizes: both are padded and the kernel masks the key
+tail by global position (same mask path as causal).  Causal masking is
+start-aligned global-position, matching ``dense_attention``
+(``q_offset``/``kv_offset`` must be static Python ints here; traced
+offsets fall back to the XLA path).
+
+Differentiation: the kernel is forward-only; ``models.attention``
+wraps it in a ``jax.custom_vjp`` whose backward recomputes through the
+XLA scan path (standard flash practice: the backward is itself a
+streaming recompute, so nothing extra is stored).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["pallas_flash_attention", "supported"]
+
+_DEF_BLOCK_Q = 256
+_DEF_BLOCK_K = 256
+_NEG = float(jnp.finfo(jnp.float32).min) / 2  # matches attention._neg_value
+
+
+def supported(sq: int, skv: int, d: int, dtype, *, q_offset, kv_offset,
+              platform: Optional[str] = None) -> bool:
+    """Whether the Pallas kernel handles this case.
+
+    Requirements: static integer offsets (the grid-skip predicate and the
+    mask are built from them at trace time), f32/bf16 element type, a
+    head dim that tiles the lane axis without pathological padding, and —
+    on real accelerators — enough rows for the tiling to pay for itself
+    (tiny shapes go through the XLA scan path, which XLA fuses fine).
+    """
+    if not (isinstance(q_offset, int) and isinstance(kv_offset, int)):
+        return False
+    dt = jnp.dtype(dtype)
+    if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        return False
+    if d % 8 != 0 or d > 1024:
+        return False
+    if platform is None:
+        platform = jax.default_backend()
+    if platform not in ("tpu", "cpu"):
+        return False  # native Mosaic is TPU-only; cpu runs interpret mode
+    if platform != "cpu" and (sq < 128 or skv < 128):
+        return False
+    return True
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale: float, causal: bool, q_off: int, kv_off: int,
+                  skv: int, bq: int, bk: int, nk: int, out_dtype):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0]                                    # (bq, D)
+        k = k_ref[0]                                    # (bk, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+
+        tail_pad = skv % bk != 0
+        if causal or tail_pad:
+            cols = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)                 # local key index
+            valid = cols < skv
+            if causal:
+                rows = q_off + i * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0)             # global q position
+                valid = jnp.logical_and(valid, rows >= kv_off + cols)
+            s = jnp.where(valid, s, _NEG)
+
+        m_prev = m_ref[:, :1]                           # (bq, 1)
+        blk_m = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, blk_m)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)                  # (bq, 1)
+        l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, D)
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # skip blocks with no visible keys — the wedge above the
+        # diagonal.  (Predication skips the FLOPs; the block fetch is
+        # pipelined regardless.  Padded key tails are handled by the
+        # ``cols < skv`` mask, not skipped: the last key block always
+        # contains at least one real key.)
+        pl.when(q_off + (i + 1) * bq - 1 >= kv_off + j * bk)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        # a q row whose visible-key set is empty has l == 0; the dense
+        # reference returns an unspecified finite value there — keep it
+        # finite rather than 0/0
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l).astype(out_dtype)
+
+
+# imported lazily so module import never requires a Pallas-capable jax
+pl = None
+
+
+def _ensure_pallas():
+    global pl
+    if pl is None:
+        from jax.experimental import pallas as _pl
+        pl = _pl
+    return pl
+
+
+def _pad_to(x: jax.Array, axis: int, target: int) -> jax.Array:
+    pad = target - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                           causal: bool = False, q_offset: int = 0,
+                           kv_offset: int = 0,
+                           block_q: int = _DEF_BLOCK_Q,
+                           block_k: int = _DEF_BLOCK_K,
+                           interpret: Optional[bool] = None) -> jax.Array:
+    """Flash attention on ``(S, H, *batch, D)`` arrays as one Pallas
+    kernel per (head x batch) slice.  Forward only — see module
+    docstring for the VJP wiring.  Callers should gate on
+    :func:`supported`.  ``interpret=None`` auto-selects interpreter mode
+    on CPU (the virtual-mesh test backend) and native Mosaic elsewhere.
+    """
+    _ensure_pallas()
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    if not (isinstance(q_offset, int) and isinstance(kv_offset, int)):
+        raise ValueError("pallas path needs static integer offsets")
+    out_shape, out_dtype = q.shape, q.dtype
+    sq, h = q.shape[:2]
+    d = q.shape[-1]
+    skv = k.shape[0]
+
+    def fold(x):  # (S, H, *batch, D) -> (H*B, S, D)
+        s = x.shape[0]
+        x = x.reshape(s, h, -1, d)
+        return jnp.moveaxis(x, 0, 2).reshape(-1, s, d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    hb = qf.shape[0]
+
+    bq = min(block_q, -(-sq // 8) * 8)
+    bk = min(block_k, -(-skv // 128) * 128)
+    nq = -(-sq // bq)
+    nk = -(-skv // bk)
+    qf = _pad_to(qf, 1, nq * bq)
+    kf = _pad_to(kf, 1, nk * bk)
+    vf = _pad_to(vf, 1, nk * bk)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=1.0 / math.sqrt(d), causal=causal,
+        q_off=q_offset, kv_off=kv_offset, skv=skv, bq=bq, bk=bk, nk=nk,
+        out_dtype=out_dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((hb, nq * bq, d), out_dtype),
+        grid=(hb, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda hbi, i, j: (hbi, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda hbi, i, j: (hbi, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda hbi, i, j: (hbi, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda hbi, i, j: (hbi, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max m
+            pltpu.VMEM((bq, 128), jnp.float32),   # running denominator l
+            pltpu.VMEM((bq, d), jnp.float32),     # numerator accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out[:, :sq]                                   # drop q padding
+    out = out.reshape(h, -1, sq, d)
+    return jnp.moveaxis(out, 2, 0).reshape(out_shape)

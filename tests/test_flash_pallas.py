@@ -1,0 +1,189 @@
+"""Pallas flash-attention kernel tests (interpret mode on the CPU mesh).
+
+The kernel must match :func:`dense_attention` bitwise-close under true
+f32 matmuls, across unaligned lengths (block padding + key-tail
+masking), causal wedges, cross-length offsets, and bf16 inputs; its
+``custom_vjp`` backward must match the XLA scan path's gradient.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pencilarrays_tpu.models.attention import (
+    _flash_xla,
+    dense_attention,
+    flash_attention,
+)
+from pencilarrays_tpu.ops.flash_pallas import pallas_flash_attention, supported
+
+
+def _qkv(rng, sq, skv, h, b, d, dtype=jnp.float32):
+    shape_q = (sq, h, b, d) if b else (sq, h, d)
+    shape_k = (skv, h, b, d) if b else (skv, h, d)
+    q = jnp.asarray(rng.standard_normal(shape_q), dtype)
+    k = jnp.asarray(rng.standard_normal(shape_k), dtype)
+    v = jnp.asarray(rng.standard_normal(shape_k), dtype)
+    return q, k, v
+
+
+def test_supported_predicate():
+    f32 = jnp.float32
+    assert supported(256, 256, 64, f32, q_offset=0, kv_offset=0)
+    assert supported(256, 256, 64, jnp.bfloat16, q_offset=0, kv_offset=0)
+    # traced offsets need the XLA path (mask built at trace time)
+    assert not supported(256, 256, 64, f32,
+                         q_offset=jnp.int32(0), kv_offset=0)
+    assert not supported(256, 256, 64, jnp.float64,
+                         q_offset=0, kv_offset=0)
+    assert not supported(256, 256, 60, f32, q_offset=0, kv_offset=0)
+    # tiny shapes: XLA path on real accelerators, accepted on CPU tests
+    assert not supported(64, 64, 64, f32, q_offset=0, kv_offset=0,
+                         platform="tpu")
+    assert supported(64, 64, 64, f32, q_offset=0, kv_offset=0,
+                     platform="cpu")
+
+
+@pytest.mark.parametrize("sq,skv,h,b,d", [
+    (128, 128, 2, 0, 32),     # aligned, no batch dim
+    (80, 80, 3, 2, 16),       # unaligned rows + key tail padding
+    (300, 140, 1, 1, 64),     # cross-length, multiple k blocks w/ pad
+    (16, 520, 2, 0, 8),       # skv > block, ragged tail
+])
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_dense(sq, skv, h, b, d, causal):
+    rng = np.random.default_rng(7)
+    q, k, v = _qkv(rng, sq, skv, h, b, d)
+    with jax.default_matmul_precision("float32"):
+        ref = dense_attention(q, k, v, causal=causal)
+        got = pallas_flash_attention(q, k, v, causal=causal,
+                                     interpret=True, block_q=64,
+                                     block_k=128)
+    # start-aligned convention: every row sees key 0, so no rows are
+    # unspecified here (offsets are exercised in test_offsets_match_dense)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=5e-6, rtol=5e-6)
+
+
+@pytest.mark.parametrize("q_off,kv_off", [(5, 0), (0, 3), (17, 9)])
+def test_offsets_match_dense(q_off, kv_off):
+    rng = np.random.default_rng(3)
+    q, k, v = _qkv(rng, 72, 96, 2, 1, 16)
+    with jax.default_matmul_precision("float32"):
+        ref = dense_attention(q, k, v, causal=True,
+                              q_offset=q_off, kv_offset=kv_off)
+        got = pallas_flash_attention(q, k, v, causal=True,
+                                     q_offset=q_off, kv_offset=kv_off,
+                                     interpret=True, block_q=32,
+                                     block_k=128)
+    # rows whose visible-key set is empty are unspecified in both
+    rows_ok = (q_off + np.arange(72)) >= kv_off
+    np.testing.assert_allclose(np.asarray(got)[rows_ok],
+                               np.asarray(ref)[rows_ok],
+                               atol=5e-6, rtol=5e-6)
+
+
+def test_bf16():
+    rng = np.random.default_rng(11)
+    q, k, v = _qkv(rng, 128, 128, 2, 1, 32, jnp.bfloat16)
+    ref = dense_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32))
+    got = pallas_flash_attention(q, k, v, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+
+def test_fully_masked_rows_finite():
+    """q rows before the kv origin see no keys; output must stay finite
+    (the dense reference's unspecified-but-finite contract)."""
+    rng = np.random.default_rng(5)
+    q, k, v = _qkv(rng, 16, 16, 1, 0, 8)
+    got = pallas_flash_attention(q, k, v, causal=True, kv_offset=8,
+                                 interpret=True)
+    assert bool(jnp.isfinite(got).all())
+
+
+def test_flash_attention_impl_routing():
+    rng = np.random.default_rng(2)
+    q, k, v = _qkv(rng, 64, 64, 2, 1, 16)
+    with jax.default_matmul_precision("float32"):
+        ref = flash_attention(q, k, v, impl="xla")
+        got = flash_attention(q, k, v, impl="pallas")  # interpret on CPU
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=5e-6, rtol=5e-6)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v.astype(jnp.float64), impl="pallas")
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, impl="nope")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_custom_vjp_matches_xla_grad(causal):
+    rng = np.random.default_rng(9)
+    q, k, v = _qkv(rng, 48, 48, 2, 1, 16)
+    ct = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+
+    def loss_pallas(q_, k_, v_):
+        return jnp.sum(flash_attention(q_, k_, v_, causal=causal,
+                                       impl="pallas") * ct)
+
+    def loss_xla(q_, k_, v_):
+        return jnp.sum(_flash_xla(q_, k_, v_, causal=causal, chunk=None,
+                                  q_offset=0, kv_offset=0) * ct)
+
+    with jax.default_matmul_precision("float32"):
+        gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_pallas_impl_on_mesh(devices):
+    """The Ulysses wiring for the Pallas local kernel: the outer
+    ``_use_pallas_flash`` probe must agree with the inner decision (so
+    ``check_vma`` is set consistently), and the forward + grad through
+    the ``custom_vjp`` must match the XLA impl on the virtual mesh."""
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.models import dense_attention, ulysses_attention
+
+    P = 4
+    topo = pa.Topology((P,), devices=devices[:P])
+    S, H, D = 32, 8, 16
+    pen = pa.Pencil(topo, (S, H), (0,))
+    rng = np.random.default_rng(21)
+
+    def mk():
+        return pa.PencilArray.from_global(
+            pen, rng.standard_normal((S, H, D)).astype(np.float32),
+            extra_ndims=1)
+
+    q, k, v = mk(), mk(), mk()
+    with jax.default_matmul_precision("float32"):
+        ref = dense_attention(np.asarray(pa.gather(q)),
+                              np.asarray(pa.gather(k)),
+                              np.asarray(pa.gather(v)))
+        out = ulysses_attention(q, k, v, impl="pallas")
+        np.testing.assert_allclose(np.asarray(pa.gather(out)),
+                                   np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+        def loss(data, impl):
+            u = pa.PencilArray(pen, data, (D,))
+            o = ulysses_attention(u, k, v, impl=impl)
+            return jnp.sum(o.data ** 2)
+
+        gp = jax.grad(lambda d: loss(d, "pallas"))(q.data)
+        gx = jax.grad(lambda d: loss(d, "xla"))(q.data)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_jit_and_shapes_preserved():
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, 40, 40, 2, 3, 8)
+    f = jax.jit(lambda q_, k_, v_: flash_attention(q_, k_, v_,
+                                                   impl="pallas"))
+    out = f(q, k, v)
+    assert out.shape == q.shape and out.dtype == q.dtype
