@@ -1,5 +1,6 @@
 from .spectral import NavierStokesSpectral, taylor_green
 from .diffusion import DiffusionSpectral
+from .heat_fd import HeatFD
 from .ode import integrate, rk23_step
 from .attention import (
     dense_attention,
@@ -13,6 +14,7 @@ from .attention import (
 
 __all__ = [
     "DiffusionSpectral",
+    "HeatFD",
     "NavierStokesSpectral",
     "taylor_green",
     "integrate",

@@ -340,13 +340,14 @@ def ulysses_attention(q: PencilArray, k: PencilArray, v: PencilArray,
 
     # check_vma=False only when the Pallas local kernel may actually run
     # (pallas_call outputs carry no varying-mesh-axes metadata, which the
-    # static check rejects — same convention as transpositions.py)
+    # static check rejects — same convention as transpositions.py).  The
+    # probe must mirror what the INNER step will see: stack() promotes
+    # q/k/v to one result dtype, so probe with that, not the raw dtypes.
     s_glob = pen_seq.size_global()[0]
+    stacked_dt = jnp.result_type(q.dtype, k.dtype, v.dtype)
+    probe = jax.ShapeDtypeStruct((s_glob, 1, q.extra_dims[-1]), stacked_dt)
     pallas_may_run = impl != "xla" and _use_pallas_flash(
-        jax.ShapeDtypeStruct((s_glob, 1, q.extra_dims[-1]), q.dtype),
-        jax.ShapeDtypeStruct((s_glob, 1, q.extra_dims[-1]), k.dtype),
-        jax.ShapeDtypeStruct((s_glob, 1, q.extra_dims[-1]), v.dtype),
-        0, 0, force=(impl == "pallas"))
+        probe, probe, probe, 0, 0, force=(impl == "pallas"))
     fn = jax.shard_map(local_attn, mesh=pen_heads.mesh,
                        in_specs=spec, out_specs=spec,
                        check_vma=not pallas_may_run)

@@ -1,6 +1,14 @@
 from . import reductions
 from . import spectral_ops
+from . import stencil
 from .localgrid import LocalRectilinearGrid, localgrid
+from .stencil import (
+    diff,
+    fd_divergence,
+    fd_gradient,
+    fd_laplacian,
+    shift,
+)
 from .random import normal, uniform
 from .spectral_ops import (
     curl,
@@ -27,6 +35,12 @@ from .reductions import (
 __all__ = [
     "reductions",
     "spectral_ops",
+    "stencil",
+    "diff",
+    "fd_divergence",
+    "fd_gradient",
+    "fd_laplacian",
+    "shift",
     "curl",
     "divergence",
     "gradient",

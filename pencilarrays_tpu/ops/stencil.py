@@ -1,0 +1,163 @@
+"""Finite-difference stencils on pencils — halo exchange the TPU way.
+
+MPI stencil codes pack ghost layers and post neighbor sends by hand.
+The TPU-first design does neither: a shifted view of a sharded global
+array (``jnp.roll`` / slice + concat under ``jit``) makes GSPMD insert
+the minimal boundary ``collective-permute`` between ring neighbors on
+the decomposed mesh axis — the halo exchange *is* the compiler's
+partitioning of the shift (guarded by ``tests/test_stencil.py``'s HLO
+budget: no all-gathers, neighbor permutes only).  On top of
+:func:`shift` this module provides the standard second-order centered
+difference operators, boundary-aware and differentiable, completing the
+grid toolbox next to the spectral operators (``ops/spectral_ops.py``).
+
+Layout subtlety: PencilArray data is stored in memory order with
+ceil-rule tail padding on decomposed dims (``parallel/arrays.py``
+storage contract).  A shift along a *padded* dim must not let values
+cross the pad gap, so the wrap is stitched from two whole-array rolls
+selected at the seam (keeping the constructors' zero-fill contract) —
+everything stays shape-preserving because GSPMD segfaults/all-gathers on
+unevenly-resharded slices; unpadded dims shift as one roll.  Either way
+the result keeps the input's pencil and sharding.
+
+The reference has no stencil layer (its grid utilities stop at
+coordinate broadcasts, ``src/LocalGrids``); this module is the analog of
+what its users hand-write with ``range_local`` + ghost cells, expressed
+as whole-array ops (cf. ``docs/src/PencilArrays.md`` usage notes).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.arrays import PencilArray
+
+__all__ = ["shift", "diff", "fd_gradient", "fd_divergence", "fd_laplacian"]
+
+_BOUNDARIES = ("periodic", "zero")
+
+
+def _mem_axis(pencil, axis: int) -> int:
+    perm = pencil.permutation
+    if perm.is_identity():
+        return axis
+    return perm.axes().index(axis)
+
+
+def _axis_index(shape, axis: int) -> jax.Array:
+    """Index-along-axis vector shaped for broadcasting against ``shape``
+    (the whole shift stays shape-preserving: rolls + masked selects,
+    never an unevenly-resharded slice, which GSPMD handles poorly)."""
+    s = [1] * len(shape)
+    s[axis] = shape[axis]
+    return jnp.arange(shape[axis], dtype=jnp.int32).reshape(s)
+
+
+def shift(u: PencilArray, axis: int, offset: int, *,
+          boundary: str = "periodic") -> PencilArray:
+    """``shift(u, axis, k)[..., i, ...] == u[..., i+k, ...]`` along a
+    logical spatial ``axis`` — data moves *toward lower indices* for
+    positive ``k`` (the upwind neighbor view).
+
+    ``boundary``: ``"periodic"`` wraps indices mod the true extent;
+    ``"zero"`` reads out-of-range positions as 0.  Works along any dim —
+    local, decomposed, padded, permuted; on a decomposed dim the
+    compiled program exchanges exactly the ``|k|``-deep boundary layer
+    with ring neighbors (GSPMD collective-permute).
+    """
+    if boundary not in _BOUNDARIES:
+        raise ValueError(f"boundary must be one of {_BOUNDARIES}")
+    pen = u.pencil
+    if not 0 <= axis < pen.ndims:
+        raise ValueError(f"axis {axis} out of range for {pen.ndims}-dim pencil")
+    k = int(offset)
+    n = pen.size_global()[axis]
+    npad = pen.padded_global_shape[axis]
+    ax = _mem_axis(pen, axis)
+    data = u.data
+    zero = jnp.zeros((), data.dtype)
+    if boundary == "periodic":
+        if npad == n:
+            out = jnp.roll(data, -k, axis=ax)
+        else:
+            # result[i] = data[(i+k) mod n] inside the true extent n of
+            # the padded dim: (i+r) mod n is i+r below the seam at
+            # n-r and i+r-n above it — two rolls select-stitched at the
+            # seam, tail padding re-zeroed
+            r = k % n
+            idx = _axis_index(data.shape, ax)
+            lo = jnp.roll(data, -r, axis=ax)
+            hi = jnp.roll(data, n - r, axis=ax)
+            out = jnp.where(idx < n - r, lo, hi)
+            out = jnp.where(idx < n, out, zero)
+    else:
+        # result[i] = data[i+k] where 0 <= i+k < n, else 0; the rolled
+        # array equals data[i+k] on exactly that index window
+        rolled = jnp.roll(data, -k, axis=ax)
+        idx = _axis_index(data.shape, ax)
+        lo_i, hi_i = max(0, -k), min(n, n - k)
+        out = jnp.where((idx >= lo_i) & (idx < hi_i), rolled, zero)
+    out = jax.lax.with_sharding_constraint(out, pen.sharding(u.ndims_extra))
+    return PencilArray(pen, out, u.extra_dims)
+
+
+def diff(u: PencilArray, axis: int, *, order: int = 1,
+         spacing: float = 1.0, boundary: str = "periodic") -> PencilArray:
+    """Second-order centered finite difference along a logical axis.
+
+    ``order=1``: ``(u[i+1] - u[i-1]) / (2 h)``;
+    ``order=2``: ``(u[i+1] - 2 u[i] + u[i-1]) / h^2``.
+    """
+    up = shift(u, axis, +1, boundary=boundary)
+    dn = shift(u, axis, -1, boundary=boundary)
+    if order == 1:
+        return (up - dn) * (0.5 / spacing)
+    if order == 2:
+        return (up - u * 2.0 + dn) * (1.0 / spacing ** 2)
+    raise ValueError("order must be 1 or 2 (centered stencils)")
+
+
+def _spacings(pen, spacing) -> Tuple[float, ...]:
+    if isinstance(spacing, (int, float)):
+        return (float(spacing),) * pen.ndims
+    out = tuple(float(s) for s in spacing)
+    if len(out) != pen.ndims:
+        raise ValueError("need one spacing per spatial dim")
+    return out
+
+
+def fd_gradient(u: PencilArray, *, spacing=1.0,
+                boundary: str = "periodic") -> Tuple[PencilArray, ...]:
+    """Centered-difference gradient: one PencilArray per spatial dim
+    (the FD analog of ``ops.spectral_ops.gradient``)."""
+    hs = _spacings(u.pencil, spacing)
+    return tuple(diff(u, d, order=1, spacing=hs[d], boundary=boundary)
+                 for d in range(u.pencil.ndims))
+
+
+def fd_divergence(fields: Sequence[PencilArray], *, spacing=1.0,
+                  boundary: str = "periodic") -> PencilArray:
+    """Divergence of a vector field given as one PencilArray per dim."""
+    fields = tuple(fields)
+    pen = fields[0].pencil
+    if len(fields) != pen.ndims:
+        raise ValueError("need one field component per spatial dim")
+    hs = _spacings(pen, spacing)
+    out = diff(fields[0], 0, order=1, spacing=hs[0], boundary=boundary)
+    for d in range(1, pen.ndims):
+        out = out + diff(fields[d], d, order=1, spacing=hs[d],
+                         boundary=boundary)
+    return out
+
+
+def fd_laplacian(u: PencilArray, *, spacing=1.0,
+                 boundary: str = "periodic") -> PencilArray:
+    """Centered-difference Laplacian (sum of second differences)."""
+    hs = _spacings(u.pencil, spacing)
+    out = diff(u, 0, order=2, spacing=hs[0], boundary=boundary)
+    for d in range(1, u.pencil.ndims):
+        out = out + diff(u, d, order=2, spacing=hs[d], boundary=boundary)
+    return out

@@ -180,6 +180,35 @@ def test_ulysses_pallas_impl_on_mesh(devices):
                                    atol=1e-5, rtol=1e-5)
 
 
+def test_ulysses_pallas_mixed_dtypes(devices):
+    """The check_vma probe must mirror the inner decision: stack()
+    promotes mixed q/k/v dtypes to one result dtype, so bf16 k/v with
+    f32 q still routes through the Pallas kernel without tripping the
+    static varying-mesh-axes check."""
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.models import dense_attention, ulysses_attention
+
+    P = 2
+    topo = pa.Topology((P,), devices=devices[:P])
+    S, H, D = 16, 4, 8
+    pen = pa.Pencil(topo, (S, H), (0,))
+    rng = np.random.default_rng(13)
+
+    def mk(dtype):
+        u = pa.PencilArray.from_global(
+            pen, rng.standard_normal((S, H, D)).astype(np.float32),
+            extra_ndims=1)
+        return pa.PencilArray(pen, u.data.astype(dtype), (D,))
+
+    q, k, v = mk(jnp.float32), mk(jnp.bfloat16), mk(jnp.bfloat16)
+    out = ulysses_attention(q, k, v, impl="pallas")
+    ref = dense_attention(np.asarray(pa.gather(q), np.float32),
+                          np.asarray(pa.gather(k), np.float32),
+                          np.asarray(pa.gather(v), np.float32))
+    np.testing.assert_allclose(np.asarray(pa.gather(out)),
+                               np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+
 def test_jit_and_shapes_preserved():
     rng = np.random.default_rng(1)
     q, k, v = _qkv(rng, 40, 40, 2, 3, 8)
