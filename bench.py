@@ -250,6 +250,47 @@ def bench_fft512_peak_hbm(jax, jnp, np, pa, timeit):
     return out
 
 
+def bench_flash_attention(jax, jnp, np, pa, timeit):
+    """Pallas flash-attention kernel vs the XLA scan path, S=4096 H=8
+    D=128 f32 — the one hot op where a hand kernel beats XLA fusion
+    (``ratio_vs_xla_scan`` > 1 means the Pallas kernel wins); dense
+    attention at this size would hold an S x S score matrix per head.
+    """
+    from pencilarrays_tpu.models.attention import _flash_xla
+    from pencilarrays_tpu.ops.flash_pallas import (
+        pallas_flash_attention, supported)
+
+    S, H, D = 4096, 8, 128
+    # platform='tpu' explicitly: supported() accepts 'cpu' for the
+    # interpret-mode tests, but an interpreter-mode 4096^2 kernel would
+    # wedge the bench on a CPU-only host
+    if jax.default_backend() != "tpu" or not supported(
+            S, S, D, jnp.float32, q_offset=0, kv_offset=0, platform="tpu"):
+        return {"skipped": "pallas kernel needs a real TPU backend"}
+    mk = jax.jit(lambda key: jax.random.normal(key, (S, H, D), jnp.float32))
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q, k, v = mk(kq), mk(kk), mk(kv)
+    flops = 4 * S * S * H * D
+
+    def pall(d):
+        return pallas_flash_attention(d, k, v)
+
+    def xla(d):
+        return _flash_xla(d, k, v, causal=False, chunk=None,
+                          q_offset=0, kv_offset=0)
+
+    t_p = timeit(pall, q, k0=1, k1=7)
+    spread = _spread()
+    t_x = timeit(xla, q, k0=1, k1=7)
+    return {
+        "pallas_tflops": round(flops / t_p / 1e12, 2),
+        "xla_scan_tflops": round(flops / t_x / 1e12, 2),
+        "ratio_vs_xla_scan": round(t_x / t_p, 3),
+        "timing_spread": spread,
+        "timing_spread_raw": _spread(),
+    }
+
+
 def _start_watchdog(seconds: float = 1500.0):
     """Guarantee ONE JSON line even if the TPU tunnel wedges.
 
@@ -295,6 +336,7 @@ def main():
         ("transpose_hop_256", bench_transpose_hop),
         ("transpose_4d_c64_hop", bench_transpose_4d),
         ("ns_step_256", bench_ns_step),
+        ("flash_attention_4096", bench_flash_attention),
         ("grid_broadcast_60x110x21_f64", bench_grid_broadcast),
         ("fft512_peak_hbm", bench_fft512_peak_hbm),
     ]:
