@@ -194,9 +194,13 @@ def flash_attention(q, k, v, *, causal: bool = False, chunk: int = None,
 def _use_pallas_flash(q, k, v, q_offset, kv_offset, *, force: bool) -> bool:
     from ..ops import flash_pallas
 
-    ok = (q.dtype == k.dtype == v.dtype) and flash_pallas.supported(
-        q.shape[0], k.shape[0], q.shape[-1], q.dtype,
-        q_offset=q_offset, kv_offset=kv_offset)
+    # the public flash_attention path hashes offsets as nondiff custom_vjp
+    # args, so they must be static ints here (the kernel itself takes
+    # traced offsets — the ring partials path uses that)
+    ok = (isinstance(q_offset, int) and isinstance(kv_offset, int)
+          and q.dtype == k.dtype == v.dtype
+          and flash_pallas.supported(q.shape[0], k.shape[0],
+                                     q.shape[-1], q.dtype))
     if force:
         if not ok:
             raise ValueError(
@@ -233,6 +237,65 @@ def _flash_pallas_bwd(causal, q_offset, kv_offset, res, g):
 
 
 _flash_pallas_vjp.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
+
+
+def _merge_partials(a, b):
+    """Exact combine of flash statistics over disjoint key sets — the
+    flash-decoding merge.  Both operands in the accumulator-carry
+    convention (``m``/``l``: (H, B, Sq); ``acc``: (Sq, H, B, D))."""
+    m1, l1, acc1 = a
+    m2, l2, acc2 = b
+    m = jnp.maximum(m1, m2)
+    c1 = jnp.exp(m1 - m)
+    c2 = jnp.exp(m2 - m)
+    l = l1 * c1 + l2 * c2
+    cc1 = jnp.moveaxis(c1, -1, 0)[..., None]
+    cc2 = jnp.moveaxis(c2, -1, 0)[..., None]
+    return m, l, acc1 * cc1 + acc2 * cc2
+
+
+def _xla_partials(qb, kb, vb, offs_f, causal):
+    """One-block flash statistics on folded (S, H, B, D) arrays — the
+    XLA counterpart of the kernel's ``partials=True`` mode (used as the
+    backward recompute for its ``custom_vjp``)."""
+    d = qb.shape[-1]
+    offs = offs_f.astype(jnp.int32)
+    s = _scores(qb, kb) * (1.0 / math.sqrt(d))
+    if causal:
+        gq = offs[0] + jnp.arange(qb.shape[0])
+        gt = offs[1] + jnp.arange(kb.shape[0])
+        s = jnp.where((gq[:, None] >= gt[None, :])[None, None], s,
+                      _neg_value(s.dtype))
+    return _flash_update(None, s, vb)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash_partials_pallas(qb, kb, vb, offs_f, causal):
+    """Pallas partials with traced offsets (f32 so the VJP has a float
+    cotangent slot; the kernel reads them as int32 from SMEM)."""
+    from ..ops.flash_pallas import pallas_flash_attention
+
+    offs = offs_f.astype(jnp.int32)
+    return pallas_flash_attention(qb, kb, vb, causal=causal,
+                                  q_offset=offs[0], kv_offset=offs[1],
+                                  partials=True)
+
+
+def _flash_partials_fwd(qb, kb, vb, offs_f, causal):
+    return (_flash_partials_pallas(qb, kb, vb, offs_f, causal),
+            (qb, kb, vb, offs_f))
+
+
+def _flash_partials_bwd(causal, res, g):
+    qb, kb, vb, offs_f = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_partials(q_, k_, v_, offs_f, causal),
+        qb, kb, vb)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(offs_f)
+
+
+_flash_partials_pallas.defvjp(_flash_partials_fwd, _flash_partials_bwd)
 
 
 def _flash_xla(q, k, v, *, causal, chunk, q_offset, kv_offset):
@@ -400,9 +463,27 @@ def from_zigzag(x: PencilArray) -> PencilArray:
     return _zigzag_take(x, np.argsort(idx))
 
 
+def _ring_use_pallas(q, k, v, s_local, d, *, force: bool) -> bool:
+    """Mirror of :func:`_use_pallas_flash` for the ring local step —
+    offsets are traced there (SMEM), so only dtype/shape gates apply."""
+    from ..ops import flash_pallas
+
+    ok = (q.dtype == k.dtype == v.dtype
+          and flash_pallas.supported(s_local, s_local, d, q.dtype))
+    if force:
+        if not ok:
+            raise ValueError(
+                "impl='pallas' but flash_pallas.supported() rejects the "
+                "ring local block (unsupported dtype or tiny shape)")
+        return True
+    if os.environ.get("PENCILARRAYS_TPU_PALLAS_ATTENTION", "1") == "0":
+        return False
+    return ok and jax.default_backend() == "tpu"
+
+
 def ring_attention(q: PencilArray, k: PencilArray, v: PencilArray,
-                   *, causal: bool = False,
-                   zigzag: bool = False) -> PencilArray:
+                   *, causal: bool = False, zigzag: bool = False,
+                   impl: str = "auto") -> PencilArray:
     """Blockwise ring attention: k/v blocks rotate via ``ppermute`` with
     flash-style running max/denominator accumulation.  q/k/v as in
     :func:`ulysses_attention`; works for any H (heads stay local),
@@ -417,6 +498,8 @@ def ring_attention(q: PencilArray, k: PencilArray, v: PencilArray,
     exactly two strictly-past block pairs per device — no round ever
     computes a fully-masked block (the naive path's 2x waste).
     """
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown ring impl {impl!r}")
     pen_seq = _check_qkv(q, k, v)
     if pen_seq.decomposition != (0,):
         raise ValueError("ring: q/k/v must be sequence-decomposed")
@@ -432,20 +515,34 @@ def ring_attention(q: PencilArray, k: PencilArray, v: PencilArray,
     if zigzag and pen_seq.size_global()[0] % (2 * P):
         raise ValueError("zigzag needs S divisible by 2P")
 
-    local = (_zigzag_local_fn if (causal and zigzag and P > 1)
-             else _ring_local_fn)
+    use_zigzag = causal and zigzag and P > 1
+    if use_zigzag and impl == "pallas":
+        raise ValueError("the zigzag schedule's pair selection is not "
+                         "kernelized; use impl='auto' or 'xla'")
+    use_pallas = (not use_zigzag) and impl != "xla" and _ring_use_pallas(
+        q, k, v, pen_seq.size_global()[0] // P, d,
+        force=(impl == "pallas"))
+    local = _zigzag_local_fn if use_zigzag else _ring_local_fn
     fn = jax.shard_map(
         lambda qb, kb, vb: local(qb, kb, vb, axis=axis, P=P, d=d,
-                                 causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                                 causal=causal, use_pallas=use_pallas),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=not use_pallas)
     return PencilArray(pen_seq, fn(q.data, k.data, v.data), q.extra_dims)
 
 
-def _ring_local_fn(qb, kb, vb, *, axis, P, d, causal):
+def _ring_local_fn(qb, kb, vb, *, axis, P, d, causal, use_pallas=False):
     """Naive-placement ring: the local block is one contiguous sequence
-    chunk; every round flashes the full received k/v block (causal rounds
-    mask by global position — fully-future blocks still pay their
-    score/value FLOPs; use the zigzag path to avoid that)."""
+    chunk; every round flashes the full received k/v block.
+
+    ``use_pallas=False``: causal rounds mask by global position —
+    fully-future blocks still pay their score/value FLOPs (the zigzag
+    path avoids that).  ``use_pallas=True``: each round is ONE Pallas
+    kernel call in ``partials`` mode with the round's traced global
+    offsets (SMEM), merged exactly across rounds; the kernel's own
+    block-skip predication then prunes fully-future work at runtime,
+    so even the naive causal placement stops paying for masked blocks.
+    """
     out_shape, out_dtype = qb.shape, qb.dtype
     qb, kb, vb = _fold_batch(qb), _fold_batch(kb), _fold_batch(vb)
     scale = 1.0 / math.sqrt(d)
@@ -460,16 +557,24 @@ def _ring_local_fn(qb, kb, vb, *, axis, P, d, causal):
     cur_kv = jnp.concatenate([kb, vb], axis=-1)
     for r in range(P):
         cur_k, cur_v = cur_kv[..., :d], cur_kv[..., d:]
-        s = _scores(qb, cur_k) * scale               # (H, B, Sq, Skv)
-        if causal:
-            # after r forward shifts, this device holds k/v block
-            # (me - r) mod P; mask by GLOBAL positions
-            kv_blk = (me - jnp.int32(r)) % jnp.int32(P)
-            gq = me * s_blk + jnp.arange(s_blk)      # (Sq,)
-            gt = kv_blk * s_blk + jnp.arange(s_blk)  # (Skv,)
-            s = jnp.where((gq[:, None] >= gt[None, :])[None, None],
-                          s, neg)
-        carry = _flash_update(carry, s, cur_v)
+        # after r forward shifts, this device holds k/v block
+        # (me - r) mod P; mask by GLOBAL positions
+        kv_blk = (me - jnp.int32(r)) % jnp.int32(P)
+        if use_pallas:
+            offs_f = jnp.stack([(me * s_blk).astype(jnp.float32),
+                                (kv_blk * s_blk).astype(jnp.float32)])
+            part = _flash_partials_pallas(qb, cur_k, cur_v, offs_f,
+                                          causal)
+            carry = part if carry is None else _merge_partials(carry,
+                                                               part)
+        else:
+            s = _scores(qb, cur_k) * scale           # (H, B, Sq, Skv)
+            if causal:
+                gq = me * s_blk + jnp.arange(s_blk)      # (Sq,)
+                gt = kv_blk * s_blk + jnp.arange(s_blk)  # (Skv,)
+                s = jnp.where((gq[:, None] >= gt[None, :])[None, None],
+                              s, neg)
+            carry = _flash_update(carry, s, cur_v)
         if r + 1 < P:
             # shift the k/v block one step around the ring
             perm = [(i, (i + 1) % P) for i in range(P)]
@@ -477,7 +582,7 @@ def _ring_local_fn(qb, kb, vb, *, axis, P, d, causal):
     return _flash_finish(*carry, out_dtype).reshape(out_shape)
 
 
-def _zigzag_local_fn(qb, kb, vb, *, axis, P, d, causal):
+def _zigzag_local_fn(qb, kb, vb, *, axis, P, d, causal, use_pallas=False):
     """Zigzag-placement causal ring (balanced schedule, ~P/2 effective
     rounds of work).
 

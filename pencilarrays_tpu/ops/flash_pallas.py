@@ -20,13 +20,24 @@ module's public layout); the wrapper folds to ``(H*B, S, D)`` for the
 kernel grid ``(H*B, Sq-blocks, Skv-blocks)``.  Sequence lengths need NOT
 divide the block sizes: both are padded and the kernel masks the key
 tail by global position (same mask path as causal).  Causal masking is
-start-aligned global-position, matching ``dense_attention``
-(``q_offset``/``kv_offset`` must be static Python ints here; traced
-offsets fall back to the XLA path).
+start-aligned global-position, matching ``dense_attention``; the
+offsets ride in SMEM, so they may be **traced** values — that is what
+lets ring attention feed each round's rotating block position straight
+into the kernel.
+
+Two output modes:
+
+* default — the normalized attention output (``acc / l``);
+* ``partials=True`` — the raw flash statistics ``(m, l, acc)`` in the
+  accumulator-carry convention (``m``/``l``: ``(H, B, Sq)``, ``acc``:
+  ``(Sq, H, B, D)``, all f32; input must be the folded 4-D layout).
+  Partial results from disjoint key sets merge exactly (the standard
+  flash/“flash-decoding” combine), which is how the ring schedule
+  accumulates one kernel call per round.
 
 Differentiation: the kernel is forward-only; ``models.attention``
-wraps it in a ``jax.custom_vjp`` whose backward recomputes through the
-XLA scan path (standard flash practice: the backward is itself a
+wraps both modes in ``jax.custom_vjp``\\ s whose backward recomputes
+through the XLA path (standard flash practice: the backward is itself a
 streaming recompute, so nothing extra is stored).
 """
 
@@ -46,18 +57,19 @@ _DEF_BLOCK_K = 256
 _NEG = float(jnp.finfo(jnp.float32).min) / 2  # matches attention._neg_value
 
 
-def supported(sq: int, skv: int, d: int, dtype, *, q_offset, kv_offset,
+def supported(sq: int, skv: int, d: int, dtype, *, q_offset=0, kv_offset=0,
               platform: Optional[str] = None) -> bool:
     """Whether the Pallas kernel handles this case.
 
-    Requirements: static integer offsets (the grid-skip predicate and the
-    mask are built from them at trace time), f32/bf16 element type, a
-    head dim that tiles the lane axis without pathological padding, and —
-    on real accelerators — enough rows for the tiling to pay for itself
-    (tiny shapes go through the XLA scan path, which XLA fuses fine).
+    Requirements: f32/bf16 element type, a head dim that tiles the lane
+    axis without pathological padding, and — on real accelerators —
+    enough rows for the tiling to pay for itself (tiny shapes go through
+    the XLA scan path, which XLA fuses fine).  Offsets may be traced
+    (they live in SMEM); they are accepted here unconditionally and only
+    the *public* ``flash_attention`` routing restricts them to static
+    ints (its ``custom_vjp`` hashes them as nondiff arguments).
     """
-    if not (isinstance(q_offset, int) and isinstance(kv_offset, int)):
-        return False
+    del q_offset, kv_offset
     dt = jnp.dtype(dtype)
     if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
         return False
@@ -72,9 +84,15 @@ def supported(sq: int, skv: int, d: int, dtype, *, q_offset, kv_offset,
     return True
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale: float, causal: bool, q_off: int, kv_off: int,
-                  skv: int, bq: int, bk: int, nk: int, out_dtype):
+def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, *refs,
+                  scale: float, causal: bool, skv: int, bq: int, bk: int,
+                  nk: int, out_dtype, partials: bool):
+    if partials:
+        acc_o, m_o, l_o, m_ref, l_ref, acc_ref = refs
+    else:
+        (o_ref, m_ref, l_ref, acc_ref) = refs
+    q_off = offs_ref[0]
+    kv_off = offs_ref[1]
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -120,19 +138,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         # diagonal.  (Predication skips the FLOPs; the block fetch is
         # pipelined regardless.  Padded key tails are handled by the
         # ``cols < skv`` mask, not skipped: the last key block always
-        # contains at least one real key.)
+        # contains at least one real key.  The predicate may be traced —
+        # offsets live in SMEM.)
         pl.when(q_off + (i + 1) * bq - 1 >= kv_off + j * bk)(_compute)
     else:
         _compute()
 
     @pl.when(j == nk - 1)
     def _finish():
-        l = l_ref[:, :1]
-        # a q row whose visible-key set is empty has l == 0; the dense
-        # reference returns an unspecified finite value there — keep it
-        # finite rather than 0/0
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l).astype(out_dtype)
+        if partials:
+            acc_o[0] = acc_ref[:]
+            m_o[0] = m_ref[:, 0]
+            l_o[0] = l_ref[:, 0]
+        else:
+            l = l_ref[:, :1]
+            # a q row whose visible-key set is empty has l == 0; the
+            # dense reference returns an unspecified finite value there —
+            # keep it finite rather than 0/0
+            l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (acc_ref[:] / l).astype(out_dtype)
 
 
 # imported lazily so module import never requires a Pallas-capable jax
@@ -157,29 +181,34 @@ def _pad_to(x: jax.Array, axis: int, target: int) -> jax.Array:
 
 
 def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                           causal: bool = False, q_offset: int = 0,
-                           kv_offset: int = 0,
+                           causal: bool = False, q_offset=0, kv_offset=0,
                            block_q: int = _DEF_BLOCK_Q,
                            block_k: int = _DEF_BLOCK_K,
-                           interpret: Optional[bool] = None) -> jax.Array:
+                           interpret: Optional[bool] = None,
+                           partials: bool = False):
     """Flash attention on ``(S, H, *batch, D)`` arrays as one Pallas
     kernel per (head x batch) slice.  Forward only — see module
-    docstring for the VJP wiring.  Callers should gate on
-    :func:`supported`.  ``interpret=None`` auto-selects interpreter mode
-    on CPU (the virtual-mesh test backend) and native Mosaic elsewhere.
+    docstring for the VJP wiring and the ``partials`` output mode
+    (which requires the folded 4-D ``(S, H, B, D)`` layout).  Offsets
+    may be traced scalars.  Callers should gate on :func:`supported`.
+    ``interpret=None`` auto-selects interpreter mode on CPU (the
+    virtual-mesh test backend) and native Mosaic elsewhere.
     """
     _ensure_pallas()
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
+    if partials and q.ndim != 4:
+        raise ValueError("partials mode expects the folded (S, H, B, D) "
+                         "layout")
 
-    if not (isinstance(q_offset, int) and isinstance(kv_offset, int)):
-        raise ValueError("pallas path needs static integer offsets")
     out_shape, out_dtype = q.shape, q.dtype
     sq, h = q.shape[:2]
     d = q.shape[-1]
     skv = k.shape[0]
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(kv_offset, jnp.int32)])
 
     def fold(x):  # (S, H, *batch, D) -> (H*B, S, D)
         s = x.shape[0]
@@ -199,19 +228,32 @@ def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     kernel = functools.partial(
         _flash_kernel, scale=1.0 / math.sqrt(d), causal=causal,
-        q_off=q_offset, kv_off=kv_offset, skv=skv, bq=bq, bk=bk, nk=nk,
-        out_dtype=out_dtype)
+        skv=skv, bq=bq, bk=bk, nk=nk, out_dtype=out_dtype,
+        partials=partials)
 
-    out = pl.pallas_call(
+    spec_q = pl.BlockSpec((1, bq, d), lambda hbi, i, j: (hbi, i, 0))
+    spec_kv = pl.BlockSpec((1, bk, d), lambda hbi, i, j: (hbi, j, 0))
+    spec_row = pl.BlockSpec((1, bq), lambda hbi, i, j: (hbi, i))
+    if partials:
+        out_shapes = [
+            jax.ShapeDtypeStruct((hb, nq * bq, d), jnp.float32),  # acc
+            jax.ShapeDtypeStruct((hb, nq * bq), jnp.float32),     # m
+            jax.ShapeDtypeStruct((hb, nq * bq), jnp.float32),     # l
+        ]
+        out_specs = [spec_q, spec_row, spec_row]
+    else:
+        out_shapes = jax.ShapeDtypeStruct((hb, nq * bq, d), out_dtype)
+        out_specs = spec_q
+
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((hb, nq * bq, d), out_dtype),
+        out_shape=out_shapes,
         grid=(hb, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda hbi, i, j: (hbi, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda hbi, i, j: (hbi, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda hbi, i, j: (hbi, j, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets
+            spec_q, spec_kv, spec_kv,
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda hbi, i, j: (hbi, i, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),   # running max m
             pltpu.VMEM((bq, 128), jnp.float32),   # running denominator l
@@ -220,8 +262,17 @@ def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf)
+    )(offs, qf, kf, vf)
 
-    out = out[:, :sq]                                   # drop q padding
+    if partials:
+        acc, m, l = res
+        b = q.shape[2]
+        acc = acc[:, :sq].reshape(h, b, sq, d)
+        acc = jnp.moveaxis(acc, 2, 0)                   # (Sq, H, B, D)
+        m = m[:, :sq].reshape(h, b, sq)                 # (H, B, Sq)
+        l = l[:, :sq].reshape(h, b, sq)
+        return m, l, acc
+
+    out = res[:, :sq]                                   # drop q padding
     out = out.reshape(h, -1, sq, d)
     return jnp.moveaxis(out, 2, 0).reshape(out_shape)

@@ -209,6 +209,86 @@ def test_ulysses_pallas_mixed_dtypes(devices):
                                np.asarray(ref), atol=3e-2, rtol=3e-2)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_pallas_impl_on_mesh(devices, causal):
+    """Ring attention with the kernel in partials mode: one Pallas call
+    per round with the round's traced offsets, merged exactly — must
+    match dense and the XLA ring, and stay differentiable."""
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.models import dense_attention, ring_attention
+
+    P = 4
+    topo = pa.Topology((P,), devices=devices[:P])
+    S, H, D = 32, 2, 16
+    pen = pa.Pencil(topo, (S, H), (0,))
+    rng = np.random.default_rng(17)
+
+    def mk():
+        return pa.PencilArray.from_global(
+            pen, rng.standard_normal((S, H, D)).astype(np.float32),
+            extra_ndims=1)
+
+    q, k, v = mk(), mk(), mk()
+    with jax.default_matmul_precision("float32"):
+        ref = dense_attention(np.asarray(pa.gather(q)),
+                              np.asarray(pa.gather(k)),
+                              np.asarray(pa.gather(v)), causal=causal)
+        out_p = ring_attention(q, k, v, causal=causal, impl="pallas")
+        out_x = ring_attention(q, k, v, causal=causal, impl="xla")
+    np.testing.assert_allclose(np.asarray(pa.gather(out_p)),
+                               np.asarray(ref), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pa.gather(out_p)),
+                               np.asarray(pa.gather(out_x)),
+                               atol=1e-5, rtol=1e-5)
+
+    def loss(data, impl):
+        u = pa.PencilArray(pen, data, (D,))
+        o = ring_attention(u, k, v, causal=causal, impl=impl)
+        return jnp.sum(o.data ** 2)
+
+    with jax.default_matmul_precision("float32"):
+        gp = jax.grad(lambda d: loss(d, "pallas"))(q.data)
+        gx = jax.grad(lambda d: loss(d, "xla"))(q.data)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_zigzag_pallas_raises(devices):
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.models import ring_attention, to_zigzag
+
+    P = 2
+    topo = pa.Topology((P,), devices=devices[:P])
+    pen = pa.Pencil(topo, (16, 2), (0,))
+    u = pa.PencilArray.zeros(pen, (4,))
+    z = to_zigzag(u)
+    with pytest.raises(ValueError):
+        ring_attention(z, z, z, causal=True, zigzag=True, impl="pallas")
+
+
+def test_partials_merge_matches_full():
+    """Kernel partials over two disjoint key halves, merged, must equal
+    the full-key kernel output."""
+    from pencilarrays_tpu.models.attention import (
+        _flash_finish, _merge_partials)
+    from pencilarrays_tpu.ops.flash_pallas import pallas_flash_attention
+
+    rng = np.random.default_rng(23)
+    S, H, B, D = 64, 2, 1, 16
+    q = jnp.asarray(rng.standard_normal((S, H, B, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, H, B, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, H, B, D)), jnp.float32)
+    with jax.default_matmul_precision("float32"):
+        full = pallas_flash_attention(q, k, v, interpret=True)
+        p1 = pallas_flash_attention(q, k[:32], v[:32], partials=True,
+                                    interpret=True)
+        p2 = pallas_flash_attention(q, k[32:], v[32:], partials=True,
+                                    interpret=True)
+        merged = _flash_finish(*_merge_partials(p1, p2), jnp.float32)
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(full),
+                               atol=1e-6, rtol=1e-6)
+
+
 def test_jit_and_shapes_preserved():
     rng = np.random.default_rng(1)
     q, k, v = _qkv(rng, 40, 40, 2, 3, 8)
