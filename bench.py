@@ -1,6 +1,11 @@
-"""Headline benchmark — prints exactly ONE JSON line to stdout.
+"""Headline benchmark — one flushed JSON line PER METRIC as it
+completes, then a final summary line (the driver parses the last line;
+the per-metric lines are the crash-evidence trail: a wedged tunnel can
+kill the process at any point and everything already measured survives
+on stdout).
 
-The line carries the north-star metrics (BASELINE.md "Target metric"):
+The summary line carries the north-star metrics (BASELINE.md "Target
+metric"):
 
 * ``transpose_hop_256``  — 256^3 f32 pencil-transpose hop, GB/s/chip,
   with a same-chip raw-XLA baseline (``jnp.transpose`` of the same cube)
@@ -20,13 +25,58 @@ Timing uses the hardened protocol in ``utils/benchtime.py`` (in-jit
 fori_loop, min-of-repeats, K-differencing): remote TPU tunnels do not
 synchronize on ``block_until_ready``, so naive wall-clock timing measures
 dispatch, not kernels.
+
+Wedge-proofing (round-4, after both round-3 gates timed out red):
+
+* each metric prints its own flushed ``{"bench_metric": ...}`` line the
+  moment it finishes;
+* every metric has an estimated cost; when the remaining deadline budget
+  cannot cover the estimate the metric is skipped with a reason instead
+  of wedging the whole run;
+* the watchdog dumps the PARTIAL results dict (everything measured so
+  far) in the final line instead of ``value: null``;
+* cheap headline metrics (fft_256, transpose_hop) run first;
+* ``PA_BENCH_WEDGE=<metric>`` simulates a tunnel wedge inside that
+  metric (an uninterruptible sleep) and ``PA_BENCH_DEADLINE=<s>``
+  shrinks the watchdog, so the partial-evidence path is testable.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import time
 
 REF_GRID_US = 212.889  # benchmarks/grids.jl:115 (NoPermutation broadcast)
+
+# Advertised peak HBM bandwidth per chip by device kind, GB/s (public
+# spec-sheet numbers; used only to report roofline fractions — absent
+# kinds simply omit the fraction).
+_HBM_PEAK_GB_S = {
+    "TPU v2": 700.0,
+    "TPU v3": 900.0,
+    "TPU v4": 1228.0,
+    "TPU v4 lite": 614.0,
+    "TPU v5": 2765.0,
+    "TPU v5p": 2765.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v6 lite": 1640.0,
+    "TPU v6e": 1640.0,
+}
+
+
+def _hbm_peak(jax):
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return None, None
+    # longest prefix wins: 'TPU v5 lite' must match its own entry, not
+    # the shorter 'TPU v5'
+    for name in sorted(_HBM_PEAK_GB_S, key=len, reverse=True):
+        if kind.lower().startswith(name.lower()):
+            return kind, _HBM_PEAK_GB_S[name]
+    return kind, None
 
 
 def _spread():
@@ -117,11 +167,18 @@ def _bench_fft_n(jax, jnp, np, pa, timeit, n, k0, k1):
     t_raw = timeit(raw, x, k0=k0, k1=k1)
     # 2 transforms x 5 N^3 log2(N^3) real flops (rough FFT flop model)
     flops = 2 * 5 * n ** 3 * np.log2(float(n) ** 3)
+    # Memory-bound roofline model: the r2c round trip is 6 one-dim FFT
+    # passes (3 fwd + 3 bwd), each streaming the working set in and out
+    # of HBM once; real (4 N^3 B) and half-spectrum complex
+    # (8*N^2*(N/2+1) ~ 4 N^3 B) working sets are both ~4 N^3 bytes, so
+    # minimal traffic ~ 6 * 2 * 4 N^3 = 48 N^3 bytes.  main() divides
+    # by the chip's advertised HBM peak for fraction_of_hbm_peak.
     return {
         "framework_gflops": round(flops / t_fw / 1e9, 1),
         "raw_xla_gflops": round(flops / t_raw / 1e9, 1),
         "ratio_vs_raw_xla": round(t_raw / t_fw, 3),
         "framework_seconds": t_fw,
+        "hbm_traffic_model_bytes": 48 * n ** 3,
         "timing_spread": spread,
         "timing_spread_raw": _spread(),
     }
@@ -291,61 +348,14 @@ def bench_flash_attention(jax, jnp, np, pa, timeit):
     }
 
 
-def _start_watchdog(seconds: float = 1500.0):
-    """Guarantee ONE JSON line even if the TPU tunnel wedges.
-
-    ``jax.devices()`` through a dead tunnel blocks forever and cannot be
-    interrupted from Python; without this, a wedged chip turns the whole
-    bench into a silent driver timeout.  The watchdog emits a parseable
-    failure line and hard-exits instead.  1500 s comfortably covers a
-    healthy full run (512^3 compiles included)."""
-    import os
-    import threading
-
-    def fire():
-        print(json.dumps({
-            "metric": "fft_r2c_roundtrip_256_gflops_per_chip",
-            "value": None, "unit": "gflops", "vs_baseline": None,
-            "failures": {"watchdog": "bench exceeded its deadline "
-                         "(TPU tunnel unresponsive?)"}}), flush=True)
-        os._exit(1)  # nonzero: the line is parseable but the run failed
-
-    t = threading.Timer(seconds, fire)
-    t.daemon = True
-    t.start()
-    return t
+# Shared with the watchdog thread: everything measured so far.  Plain
+# dict mutation is atomic enough for a dump-and-exit reader.
+_STATE = {"out": {}, "failures": {}, "current": None, "t0": None}
 
 
-def main():
-    watchdog = _start_watchdog()
-
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    import pencilarrays_tpu as pa
-    from pencilarrays_tpu.utils.benchtime import device_seconds_per_iter
-
-    jax.config.update("jax_enable_x64", True)  # grid bench is f64
-
-    out = {}
-    failures = {}
-    for key, fn in [
-        ("fft_r2c_256", bench_fft),
-        ("fft_r2c_512", bench_fft_512),
-        ("transpose_hop_256", bench_transpose_hop),
-        ("transpose_4d_c64_hop", bench_transpose_4d),
-        ("ns_step_256", bench_ns_step),
-        ("flash_attention_4096", bench_flash_attention),
-        ("grid_broadcast_60x110x21_f64", bench_grid_broadcast),
-        ("fft512_peak_hbm", bench_fft512_peak_hbm),
-    ]:
-        try:
-            out[key] = fn(jax, jnp, np, pa, device_seconds_per_iter)
-        except Exception as e:  # one failed metric must not kill the line
-            failures[key] = f"{type(e).__name__}: {e}"
-
-    fft = out.get("fft_r2c_256", {})
+def _summary_line():
+    out, failures = _STATE["out"], _STATE["failures"]
+    fft = out.get("fft_r2c_256") or {}
     line = {
         "metric": "fft_r2c_roundtrip_256_gflops_per_chip",
         "value": fft.get("framework_gflops"),
@@ -355,8 +365,115 @@ def main():
     }
     if failures:
         line["failures"] = failures
+    return line
+
+
+def _start_watchdog(seconds: float):
+    """Guarantee a final JSON line even if the TPU tunnel wedges.
+
+    ``jax.devices()`` through a dead tunnel blocks forever and cannot be
+    interrupted from Python; without this, a wedged chip turns the whole
+    bench into a silent driver timeout.  On fire the watchdog dumps the
+    PARTIAL results summary — every metric that completed keeps its
+    numbers (they were also already printed as per-metric lines) — and
+    hard-exits nonzero."""
+    import threading
+
+    def fire():
+        _STATE["failures"]["watchdog"] = (
+            "bench exceeded its %.0fs deadline during metric %r "
+            "(TPU tunnel unresponsive?); all completed metrics are "
+            "included" % (seconds, _STATE["current"]))
+        print(json.dumps(_summary_line()), flush=True)
+        os._exit(1)  # nonzero: the line is parseable but the run failed
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+# (metric key, fn, estimated seconds on a healthy tunnel).  Cheap
+# headline metrics FIRST so a late wedge still leaves the numbers that
+# matter; estimates are deliberately generous (compile included).
+_METRICS = [
+    ("fft_r2c_256", "bench_fft", 150),
+    ("transpose_hop_256", "bench_transpose_hop", 100),
+    ("grid_broadcast_60x110x21_f64", "bench_grid_broadcast", 90),
+    ("transpose_4d_c64_hop", "bench_transpose_4d", 120),
+    ("flash_attention_4096", "bench_flash_attention", 180),
+    ("ns_step_256", "bench_ns_step", 200),
+    ("fft_r2c_512", "bench_fft_512", 320),
+    ("fft512_peak_hbm", "bench_fft512_peak_hbm", 150),
+]
+
+
+def main():
+    deadline = float(os.environ.get("PA_BENCH_DEADLINE", "1500"))
+    margin = 30.0  # leave room to print the summary before the watchdog
+    _STATE["t0"] = time.monotonic()
+    watchdog = _start_watchdog(deadline)
+    wedge = os.environ.get("PA_BENCH_WEDGE")
+
+    _STATE["current"] = "init:import_jax"
+    import jax
+
+    if os.environ.get("PA_BENCH_CPU") == "1":
+        # test hook: the axon plugin re-forces jax_platforms='axon,cpu'
+        # at register() time, so the JAX_PLATFORMS env var alone cannot
+        # keep a local test run off the (possibly wedged) tunnel
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.utils.benchtime import device_seconds_per_iter
+
+    jax.config.update("jax_enable_x64", True)  # grid bench is f64
+    # a wedged tunnel blocks forever in jax.devices(); name the phase so
+    # the watchdog's partial dump says where the run died
+    _STATE["current"] = "init:jax.devices"
+    kind, peak = _hbm_peak(jax)
+
+    out, failures = _STATE["out"], _STATE["failures"]
+    if kind is not None:
+        out["chip"] = {"device_kind": kind, "hbm_peak_gb_s": peak}
+    for key, fn_name, est in _METRICS:
+        elapsed = time.monotonic() - _STATE["t0"]
+        if elapsed + est > deadline - margin:
+            failures[key] = ("skipped: %.0fs elapsed + %ds estimate "
+                             "exceeds the %.0fs deadline" %
+                             (elapsed, est, deadline))
+            print(json.dumps({"bench_metric": key,
+                              "skipped": failures[key]}), flush=True)
+            continue
+        _STATE["current"] = key
+        if wedge == key:  # simulated tunnel wedge (see module docstring)
+            time.sleep(deadline + 60)
+        try:
+            res = globals()[fn_name](jax, jnp, np, pa,
+                                     device_seconds_per_iter)
+            if peak is not None and isinstance(res, dict):
+                gbs = res.get("framework_gb_s")
+                if gbs is None and "framework_seconds" in res \
+                        and "hbm_traffic_model_bytes" in res:
+                    gbs = (res["hbm_traffic_model_bytes"]
+                           / res["framework_seconds"] / 1e9)
+                if gbs is not None:
+                    res["fraction_of_hbm_peak"] = round(gbs / peak, 3)
+            out[key] = res
+            print(json.dumps({"bench_metric": key,
+                              "elapsed_s": round(
+                                  time.monotonic() - _STATE["t0"], 1),
+                              **res}), flush=True)
+        except Exception as e:  # one failed metric must not kill the line
+            failures[key] = f"{type(e).__name__}: {e}"
+            print(json.dumps({"bench_metric": key,
+                              "error": failures[key]}), flush=True)
+    _STATE["current"] = None
     watchdog.cancel()
-    print(json.dumps(line))
+    print(json.dumps(_summary_line()), flush=True)
 
 
 if __name__ == "__main__":

@@ -14,11 +14,17 @@ grid toolbox next to the spectral operators (``ops/spectral_ops.py``).
 Layout subtlety: PencilArray data is stored in memory order with
 ceil-rule tail padding on decomposed dims (``parallel/arrays.py``
 storage contract).  A shift along a *padded* dim must not let values
-cross the pad gap, so the wrap is stitched from two whole-array rolls
-selected at the seam (keeping the constructors' zero-fill contract) —
-everything stays shape-preserving because GSPMD segfaults/all-gathers on
-unevenly-resharded slices; unpadded dims shift as one roll.  Either way
-the result keeps the input's pencil and sharding.
+cross the pad gap, so the wrap is stitched from two rolls selected at
+the seam (keeping the constructors' zero-fill contract) — everything
+stays shape-preserving because GSPMD segfaults/all-gathers on unevenly
+-resharded slices.  Roll shifts are congruent mod the padded extent, so
+the seam roll's effective depth is ``|k| + pad`` (the roll amounts
+``n - r`` and ``-(r + pad)`` lower identically): the sharded-axis
+exchange is a thin boundary layer — ``|k|`` rows for the bulk plus
+``|k| + pad`` for the seam — never a full shard, a bound pinned by
+``tests/test_stencil.py::test_padded_dim_halo_bytes``.  Unpadded dims
+shift as one roll.  Either way the result keeps the input's pencil and
+sharding.
 
 The reference has no stencil layer (its grid utilities stop at
 coordinate broadcasts, ``src/LocalGrids``); this module is the analog of
@@ -65,8 +71,10 @@ def shift(u: PencilArray, axis: int, offset: int, *,
     ``boundary``: ``"periodic"`` wraps indices mod the true extent;
     ``"zero"`` reads out-of-range positions as 0.  Works along any dim —
     local, decomposed, padded, permuted; on a decomposed dim the
-    compiled program exchanges exactly the ``|k|``-deep boundary layer
-    with ring neighbors (GSPMD collective-permute).
+    compiled program exchanges only boundary layers with ring neighbors
+    (GSPMD collective-permute): ``|k|`` deep on evenly-divided dims,
+    at most ``2|k| + pad`` deep on ceil-padded dims (the seam needs a
+    second small roll past the pad gap).
     """
     if boundary not in _BOUNDARIES:
         raise ValueError(f"boundary must be one of {_BOUNDARIES}")
@@ -84,14 +92,25 @@ def shift(u: PencilArray, axis: int, offset: int, *,
             out = jnp.roll(data, -k, axis=ax)
         else:
             # result[i] = data[(i+k) mod n] inside the true extent n of
-            # the padded dim: (i+r) mod n is i+r below the seam at
-            # n-r and i+r-n above it — two rolls select-stitched at the
-            # seam, tail padding re-zeroed
+            # the padded dim.  Below the seam at n-r that is data[i+r]
+            # (roll by -r); the seam rows i in [n-r, n) need the FIRST r
+            # global rows, which sit r+p positions ahead once the p pad
+            # rows are skipped (p = npad - n).  The -(r+p) form makes the
+            # bounded depth visible; it lowers identically to the
+            # congruent n-r roll (shifts are mod npad), so the exchange
+            # is a (2r+p)-deep boundary layer either way.  Tail padding
+            # re-zeroed; no pad row is ever read into the true extent
+            # (lo reads i+r < n, hi reads (i+r+p) mod npad in [0, r)).
             r = k % n
-            idx = _axis_index(data.shape, ax)
-            lo = jnp.roll(data, -r, axis=ax)
-            hi = jnp.roll(data, n - r, axis=ax)
-            out = jnp.where(idx < n - r, lo, hi)
+            if r == 0:
+                out = data
+                idx = _axis_index(data.shape, ax)
+            else:
+                p = npad - n
+                idx = _axis_index(data.shape, ax)
+                lo = jnp.roll(data, -r, axis=ax)
+                hi = jnp.roll(data, -(r + p), axis=ax)
+                out = jnp.where(idx < n - r, lo, hi)
             out = jnp.where(idx < n, out, zero)
     else:
         # result[i] = data[i+k] where 0 <= i+k < n, else 0; the rolled

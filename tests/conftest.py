@@ -23,6 +23,24 @@ jax.config.update("jax_enable_x64", True)
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """Skip ``slow``-marked tests in the default run, mirroring the
+    reference clamping its test nprocs (``test/runtests.jl:29-32``) —
+    but never silently: an explicit ``-m`` expression (including
+    ``-m ""`` for the full suite) or an explicit ``::node`` selection
+    takes full control."""
+    argv = list(config.invocation_params.args)
+    if "-m" in argv or any(a.startswith(("-m=", "--markexpr")) for a in argv):
+        return
+    if any("::" in a for a in argv):
+        return
+    skip = pytest.mark.skip(
+        reason='slow-marked: run with -m "" (or name the node id)')
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -1,0 +1,97 @@
+"""Tests-only stub of the minimal diffrax surface our interop uses.
+
+``interop/diffrax_ext.py`` wires ``PIDController(norm=global_wrms_norm)``
+into ``diffrax.diffeqsolve``; the real package is not installed in this
+image (no network), so this stub implements just enough of the API —
+``ODETerm``, ``Heun``, ``SaveAt``, ``PIDController``, ``diffeqsolve`` —
+for the wrapper to execute end-to-end: a host-side adaptive Heun loop
+whose accept/reject decision and dt control go through the controller's
+``norm`` hook, exactly the seam the reference extension overloads
+(``ext/PencilArraysDiffEqExt.jl:5-9``).  Installed into ``sys.modules``
+by ``tests/test_diffrax_interop.py``; never shipped.
+
+This is an API-shape stand-in, not a reimplementation of diffrax: one
+solver, one controller law, dense output ignored.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__version__ = "0.0-pencilarrays-test-stub"
+
+
+@dataclasses.dataclass
+class ODETerm:
+    vector_field: Callable  # (t, y, args) -> dy/dt pytree
+
+
+class Heun:
+    """Marker for the 2nd-order explicit trapezoidal pair."""
+
+
+@dataclasses.dataclass
+class SaveAt:
+    t1: bool = False
+
+
+@dataclasses.dataclass
+class PIDController:
+    rtol: float
+    atol: float
+    norm: Callable[[Any], jax.Array]
+
+
+@dataclasses.dataclass
+class Solution:
+    ts: Any
+    ys: Any
+    stats: dict
+
+
+def diffeqsolve(terms, solver, *, t0, t1, dt0, y0,
+                stepsize_controller: PIDController,
+                saveat: Optional[SaveAt] = None, max_steps: int = 1000,
+                args=None):
+    if not isinstance(solver, Heun):
+        raise NotImplementedError("stub only implements Heun")
+    f = terms.vector_field
+    rtol = stepsize_controller.rtol
+    atol = stepsize_controller.atol
+    norm = stepsize_controller.norm
+
+    def scaled_error(err, y_a, y_b):
+        return jax.tree_util.tree_map(
+            lambda e, a, b: e / (atol + rtol * jnp.maximum(jnp.abs(a),
+                                                           jnp.abs(b))),
+            err, y_a, y_b)
+
+    t, dt, y = float(t0), float(dt0), y0
+    accepted = rejected = 0
+    while t < t1 - 1e-12 and accepted + rejected < max_steps:
+        h = min(dt, t1 - t)
+        k1 = f(t, y, args)
+        y_eul = jax.tree_util.tree_map(lambda a, b: a + h * b, y, k1)
+        k2 = f(t + h, y_eul, args)
+        y_new = jax.tree_util.tree_map(
+            lambda a, b, c: a + (0.5 * h) * (b + c), y, k1, k2)
+        err = jax.tree_util.tree_map(
+            lambda b, c: (0.5 * h) * (c - b), k1, k2)
+        enorm = float(norm(scaled_error(err, y, y_new)))
+        if enorm <= 1.0:
+            y, t = y_new, t + h
+            accepted += 1
+        else:
+            rejected += 1
+        dt = h * min(5.0, max(0.2, 0.9 * max(enorm, 1e-10) ** -0.5))
+    if t < t1 - 1e-12:
+        raise RuntimeError(
+            f"stub diffeqsolve exhausted max_steps={max_steps} at t={t} "
+            f"(tolerances too tight for the step budget?)")
+    return Solution(ts=jnp.asarray([t]), ys=y,
+                    stats={"num_accepted_steps": accepted,
+                           "num_rejected_steps": rejected})

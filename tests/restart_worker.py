@@ -1,0 +1,94 @@
+"""Worker for the cross-process-count restart test.
+
+The reference's discontiguous MPI-IO layout exists precisely so a file
+can be "read back using a different number or distribution of MPI
+processes" (``src/PencilIO/mpi_io.jl:159-167``).  The TPU analog must
+hold across *process counts*, not just decompositions: this worker is
+launched by ``test_multiprocess.py::test_restart_across_process_counts``
+in three phases —
+
+* ``write`` under 4 processes (2 devices each): binary + HDF5 (shard
+  files + virtual-dataset master), pencil decomposed (1, 2) with a
+  non-trivial permutation;
+* ``read2`` under 2 processes (4 devices each): re-read both files onto
+  a DIFFERENT decomposition (0, 2) on a different mesh shape;
+* ``read1`` single-process (8 local devices, no ``jax.distributed``):
+  re-read onto a 1-D slab decomposition.
+
+Every phase checks the gathered global array bit-for-bit against the
+deterministic ground truth regenerated from the shared seed.
+
+Usage::
+
+    python restart_worker.py <coordinator|-> <nprocs> <pid> <tmpdir> <phase>
+"""
+
+import os
+import sys
+
+
+def main():
+    coordinator, nprocs, pid, tmpdir, phase = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+        sys.argv[5])
+    n_local = 8 // nprocs
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_local}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    if nprocs > 1:
+        jax.distributed.initialize(coordinator, num_processes=nprocs,
+                                   process_id=pid)
+    import numpy as np
+
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.io import (BinaryDriver, HDF5Driver, has_hdf5,
+                                     open_file)
+
+    assert len(jax.devices()) == 8
+    shape = (11, 9, 13)  # ragged: every mesh below pads some dim
+    truth = np.random.default_rng(11).standard_normal(shape)
+    bpath = os.path.join(tmpdir, "restart.bin")
+    hpath = os.path.join(tmpdir, "restart.h5")
+
+    if phase == "write":
+        topo = pa.Topology((2, 4))
+        pen = pa.Pencil(topo, shape, (1, 2),
+                        permutation=pa.Permutation(2, 0, 1))
+        u = pa.PencilArray.from_global(pen, truth)
+        with open_file(BinaryDriver(), bpath, write=True, create=True) as f:
+            f.write("u", u)
+        if has_hdf5():
+            with open_file(HDF5Driver(), hpath, write=True,
+                           create=True) as f:
+                f.write("u", u)
+        if nprocs > 1:
+            pa.distributed.sync_global_devices("write_done")
+    else:
+        if phase == "read2":
+            topo = pa.Topology((4, 2))
+            pen = pa.Pencil(topo, shape, (0, 2))
+        elif phase == "read1":
+            topo = pa.Topology((8,))
+            pen = pa.Pencil(topo, shape, (1,))
+        else:
+            raise SystemExit(f"unknown phase {phase!r}")
+        with open_file(BinaryDriver(), bpath, read=True) as f:
+            back = f.read("u", pen)
+        assert np.array_equal(pa.gather(back), truth), \
+            f"binary restart mismatch in {phase}"
+        if has_hdf5() and os.path.exists(hpath):
+            with open_file(HDF5Driver(), hpath, read=True) as f:
+                hback = f.read("u", pen)
+            assert np.array_equal(pa.gather(hback), truth), \
+                f"hdf5 restart mismatch in {phase}"
+        if nprocs > 1:
+            pa.distributed.sync_global_devices("read_done")
+    print(f"RESTART_OK phase={phase} pid={pid}")
+
+
+if __name__ == "__main__":
+    main()

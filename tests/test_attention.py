@@ -193,6 +193,7 @@ def test_flash_never_materializes_score_matrix():
     assert biggest <= 4 * S * chunk, biggest  # far below S*S
 
 
+@pytest.mark.slow  # ~40 s: long-sequence flash sweep
 def test_ulysses_long_sequence_flash(topo):
     """Long-S Ulysses (flash local step) matches the ring path closely;
     the dense S x S score matrix would be 64x larger than anything the
@@ -235,6 +236,7 @@ def test_zigzag_roundtrip(topo):
     np.testing.assert_array_equal(gather(from_zigzag(to_zigzag(x))), u)
 
 
+@pytest.mark.slow  # ~40 s: zigzag x causal x dense cross-check
 def test_zigzag_causal_matches_dense(topo):
     """Zigzag-placed causal ring == dense causal (after undoing the
     placement)."""

@@ -121,6 +121,71 @@ def test_diffeqsolve_gating():
         diffeqsolve(None, None, 0.0, 1.0, 0.1, None)
 
 
+@pytest.fixture
+def stub_diffrax(monkeypatch):
+    """Install tests/diffrax_stub.py as ``diffrax`` so the REAL wrapper
+    (interop.diffeqsolve) executes end-to-end.  Real-package parity
+    still awaits the dependency (not installed in this image); the stub
+    pins the wiring — controller construction, norm-hook plumbing,
+    kwarg passthrough — against rot."""
+    if diffrax_available():
+        pytest.skip("real diffrax present; stub unnecessary")
+    import diffrax_stub
+    monkeypatch.setitem(__import__("sys").modules, "diffrax", diffrax_stub)
+    return diffrax_stub
+
+
+def test_diffeqsolve_stub_executes_wrapper(topo, stub_diffrax):
+    """interop.diffeqsolve end-to-end through the stub: the default
+    PIDController it builds must carry global_wrms_norm (observed via a
+    counting wrapper at the norm seam), drive accept/reject, and solve
+    the decay ODE on a PencilArray state."""
+    diffrax = stub_diffrax
+    pen = Pencil(topo, SHAPE, (1, 2))
+    u, y0 = make_state(pen, seed=5)
+
+    calls = {"n": 0}
+    orig_ctor = diffrax.PIDController
+
+    def counting_ctor(*, rtol, atol, norm):
+        assert norm is global_wrms_norm  # the wrapper's default hook
+        def counted(y):
+            calls["n"] += 1
+            return norm(y)
+        return orig_ctor(rtol=rtol, atol=atol, norm=counted)
+
+    diffrax.PIDController = counting_ctor
+    try:
+        term = diffrax.ODETerm(lambda t, y, args: y * (-1.0))
+        # dt0 deliberately too coarse: forces at least one rejection, so
+        # the controller's accept/reject seam demonstrably executes
+        sol = diffeqsolve(term, diffrax.Heun(), 0.0, 1.0, 0.9, y0,
+                          rtol=1e-5, atol=1e-8,
+                          saveat=diffrax.SaveAt(t1=True))
+    finally:
+        diffrax.PIDController = orig_ctor
+    (y1,) = jax.tree_util.tree_leaves(
+        sol.ys, is_leaf=lambda x: isinstance(x, PencilArray))
+    np.testing.assert_allclose(gather(y1), u * np.exp(-1.0), rtol=1e-4)
+    assert sol.stats["num_rejected_steps"] >= 1
+    assert calls["n"] >= sol.stats["num_accepted_steps"]
+
+
+def test_diffeqsolve_stub_controller_override(topo, stub_diffrax):
+    """A caller-supplied stepsize_controller kwarg must override the
+    default global-norm controller (the wrapper's documented escape
+    hatch)."""
+    diffrax = stub_diffrax
+    pen = Pencil(topo, SHAPE, (1, 2))
+    _, y0 = make_state(pen, seed=6)
+    mine = diffrax.PIDController(rtol=1e-3, atol=1e-6,
+                                 norm=global_wrms_norm)
+    term = diffrax.ODETerm(lambda t, y, args: y * (-1.0))
+    sol = diffeqsolve(term, diffrax.Heun(), 0.0, 0.5, 0.1, y0,
+                      stepsize_controller=mine)
+    assert sol.stats["num_accepted_steps"] >= 1
+
+
 @pytest.mark.skipif(not diffrax_available(), reason="diffrax not installed")
 def test_diffeqsolve_real(topo):
     """The real ecosystem path, when the package is present: decay ODE on

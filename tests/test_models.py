@@ -73,6 +73,7 @@ def test_decomposition_independence(topo, devices):
     np.testing.assert_allclose(u8, u1, rtol=1e-9, atol=1e-11)
 
 
+@pytest.mark.slow  # ~25 s: multi-step scan rollout
 def test_simulate_scan(topo):
     """Whole-trajectory lax.scan: must agree with the step-by-step loop
     and record monotone-decaying energies."""

@@ -19,24 +19,25 @@ def _free_port():
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("nprocs", [2, 4])
-def test_multi_process_integration(tmp_path, nprocs):
-    here = os.path.dirname(os.path.abspath(__file__))
-    worker = os.path.join(here, "multiprocess_worker.py")
-    coordinator = f"127.0.0.1:{_free_port()}"
+def _launch_workers(worker, nprocs, extra_args, sentinel, label):
+    """Spawn ``nprocs`` copies of ``worker``, wait, and assert every one
+    exits 0 and prints ``sentinel``.  Returns the outputs.  On timeout
+    the already-captured pipes are DRAINED after the kill so the failure
+    message carries everything the workers printed before hanging."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     # drop the TPU-claiming sitecustomize: worker processes must not race
     # for the single chip
-    env["PYTHONPATH"] = os.path.dirname(here)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(worker)))
+    coordinator = (f"127.0.0.1:{_free_port()}" if nprocs > 1 else "-")
     procs = [
         subprocess.Popen(
             [sys.executable, worker, coordinator, str(nprocs), str(pid),
-             str(tmp_path)],
+             *extra_args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
+            text=True)
         for pid in range(nprocs)
     ]
     outs = []
@@ -47,11 +48,50 @@ def test_multi_process_integration(tmp_path, nprocs):
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        pytest.fail("multiprocess workers timed out:\n" + "\n".join(outs))
+        # drain what the (now dead) workers managed to print — the
+        # evidence trail for diagnosing the hang
+        drained = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=10)
+            except Exception:
+                out = ""
+            drained.append(out or "")
+        pytest.fail(f"{label} workers timed out; captured output:\n"
+                    + "\n---\n".join(drained))
     for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
-        assert "WORKER_OK" in out, out[-2000:]
+        assert p.returncode == 0, f"{label} worker failed:\n{out[-3000:]}"
+        assert sentinel in out, out[-2000:]
+    return outs
+
+
+@pytest.mark.parametrize(
+    "nprocs",
+    [2, pytest.param(4, marks=pytest.mark.slow)])  # 4-proc run is ~3 min
+def test_multi_process_integration(tmp_path, nprocs):
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "multiprocess_worker.py")
+    outs = _launch_workers(worker, nprocs, [str(tmp_path)], "WORKER_OK",
+                           f"multiprocess[{nprocs}]")
     # both processes computed the same global sum
     sums = {line.split("sum=")[1] for out in outs
             for line in out.splitlines() if "WORKER_OK" in line}
     assert len(sums) == 1
+
+
+def _run_phase(worker, tmp_path, nprocs, phase):
+    _launch_workers(worker, nprocs, [str(tmp_path), phase],
+                    f"RESTART_OK phase={phase}", f"restart {phase}")
+
+
+def test_restart_across_process_counts(tmp_path):
+    """Write with 4 processes, restart with 2 and with 1 — different
+    decomposition AND different process count each time, for both the
+    binary driver and the HDF5 virtual-dataset layout (the reference's
+    decomposition-independent restart promise, mpi_io.jl:159-167,
+    extended across process counts)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "restart_worker.py")
+    _run_phase(worker, tmp_path, 4, "write")
+    _run_phase(worker, tmp_path, 2, "read2")
+    _run_phase(worker, tmp_path, 1, "read1")

@@ -90,6 +90,26 @@ def test_halo_hlo_budget(devices):
     assert stats2.get("collective-permute", {}).get("count", 0) <= 4
 
 
+def test_padded_dim_halo_bytes(devices):
+    """A periodic shift along a ceil-padded decomposed dim exchanges a
+    THIN boundary layer, never full shards: the bulk roll moves |k|
+    rows and the seam roll |k|+pad rows (roll shifts are congruent mod
+    the padded extent), so the total collective-permute traffic is
+    (2|k| + pad) rows — pinned in bytes here."""
+    topo = pa.Topology((4,), devices=devices[:4])
+    n, m = 10, 16            # dim 0: 10 over 4 -> ceil block 3, pad 2
+    pen = pa.Pencil(topo, (n, m), (0,))
+    u = pa.PencilArray.zeros(pen)
+    k, pad = 1, pen.padded_global_shape[0] - n
+    hlo = jax.jit(lambda d: shift(pa.PencilArray(pen, d), 0, k).data) \
+        .lower(u.data).compile().as_text()
+    stats = collective_stats(hlo)
+    assert "all-gather" not in stats and "all-to-all" not in stats
+    row_bytes = m * 4  # f32 rows
+    got = stats.get("collective-permute", {}).get("bytes", 0)
+    assert 0 < got <= (2 * k + pad) * row_bytes, (stats, pad)
+
+
 def test_local_dim_shift_no_collectives(devices):
     topo = pa.Topology((4,), devices=devices[:4])
     pen = pa.Pencil(topo, (16, 12, 8), (0,))
