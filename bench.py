@@ -309,11 +309,12 @@ def bench_fft512_peak_hbm(jax, jnp, np, pa, timeit):
 
 def bench_flash_attention(jax, jnp, np, pa, timeit):
     """Pallas flash-attention kernel vs the XLA scan path, S=4096 H=8
-    D=128 f32 — the one hot op where a hand kernel beats XLA fusion
-    (``ratio_vs_xla_scan`` > 1 means the Pallas kernel wins); dense
-    attention at this size would hold an S x S score matrix per head.
+    D=128 f32 — forward AND forward+backward (the hand-tiled dq/dk/dv
+    kernels vs XLA's scan VJP).  ``ratio_* > 1`` means the Pallas
+    kernel wins; dense attention at this size would hold an S x S score
+    matrix per head.
     """
-    from pencilarrays_tpu.models.attention import _flash_xla
+    from pencilarrays_tpu.models.attention import _flash_xla, flash_attention
     from pencilarrays_tpu.ops.flash_pallas import (
         pallas_flash_attention, supported)
 
@@ -327,7 +328,7 @@ def bench_flash_attention(jax, jnp, np, pa, timeit):
     mk = jax.jit(lambda key: jax.random.normal(key, (S, H, D), jnp.float32))
     kq, kk, kv = jax.random.split(jax.random.key(0), 3)
     q, k, v = mk(kq), mk(kk), mk(kv)
-    flops = 4 * S * S * H * D
+    flops = 4 * S * S * H * D          # forward; backward adds ~2.5x
 
     def pall(d):
         return pallas_flash_attention(d, k, v)
@@ -336,16 +337,33 @@ def bench_flash_attention(jax, jnp, np, pa, timeit):
         return _flash_xla(d, k, v, causal=False, chunk=None,
                           q_offset=0, kv_offset=0)
 
+    def grad_of(impl):
+        def f(d):
+            return jax.grad(lambda q_: jnp.sum(flash_attention(
+                q_, k, v, impl=impl) ** 2))(d)
+        return f
+
     t_p = timeit(pall, q, k0=1, k1=7)
     spread = _spread()
     t_x = timeit(xla, q, k0=1, k1=7)
-    return {
+    out = {
         "pallas_tflops": round(flops / t_p / 1e12, 2),
         "xla_scan_tflops": round(flops / t_x / 1e12, 2),
         "ratio_vs_xla_scan": round(t_x / t_p, 3),
         "timing_spread": spread,
         "timing_spread_raw": _spread(),
     }
+    t_pg = timeit(grad_of("pallas"), q, k0=1, k1=5)
+    sp_g = _spread()
+    t_xg = timeit(grad_of("xla"), q, k0=1, k1=5)
+    out.update({
+        "fwd_bwd_pallas_tflops": round(3.5 * flops / t_pg / 1e12, 2),
+        "fwd_bwd_xla_tflops": round(3.5 * flops / t_xg / 1e12, 2),
+        "ratio_fwd_bwd_vs_xla": round(t_xg / t_pg, 3),
+        "timing_spread_grad": sp_g,
+        "timing_spread_grad_raw": _spread(),
+    })
+    return out
 
 
 # Shared with the watchdog thread: everything measured so far.  Plain

@@ -1,0 +1,123 @@
+"""Real-TPU flash-attention sweep: Pallas kernel vs XLA scan, forward
+and forward+backward, at several (S, H, D) points.
+
+Produces ``PALLAS_FLASH_SWEEP.json`` — the measured-verdict artifact for
+the hand-kernel's reason to exist (same discipline as
+``ops/pallas_kernels.py``'s permute-kernel verdict): if the kernel loses
+to the XLA scan on the real chip, the routing default should be gated
+accordingly, and the claim removed.
+
+Run on the TPU-attached host::
+
+    python benchmarks/flash_sweep.py           # writes the JSON artifact
+
+Each timing uses the hardened tunnel protocol
+(``utils/benchtime.device_seconds_per_iter``: in-jit fori_loop,
+min-of-repeats, K-differencing) and records the per-repeat spread so a
+win/loss is judged against the noise floor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (S, H, D) points: the bench headline, a long-sequence case, and a
+# smaller many-heads case
+POINTS = [(2048, 8, 128), (4096, 8, 128), (8192, 4, 64)]
+
+
+def main():
+    deadline = float(os.environ.get("PA_SWEEP_DEADLINE", "1200"))
+
+    def fire():
+        print(json.dumps({"error": f"sweep exceeded {deadline:.0f}s "
+                          "(TPU tunnel unresponsive?)"}), flush=True)
+        os._exit(1)
+
+    wd = threading.Timer(deadline, fire)
+    wd.daemon = True
+    wd.start()
+
+    import jax
+    import jax.numpy as jnp
+
+    from pencilarrays_tpu.models.attention import _flash_xla, flash_attention
+    from pencilarrays_tpu.ops.flash_pallas import (
+        pallas_flash_attention, supported)
+    from pencilarrays_tpu.utils.benchtime import (
+        device_seconds_per_iter, last_spread)
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"error": "needs the real TPU backend"}))
+        return 1
+    kind = jax.devices()[0].device_kind
+
+    results = {"device_kind": kind, "points": []}
+    for S, H, D in POINTS:
+        if not supported(S, S, D, jnp.float32, platform="tpu"):
+            results["points"].append(
+                {"S": S, "H": H, "D": D, "skipped": "unsupported"})
+            continue
+        mk = jax.jit(lambda key, s=S, h=H, d=D: jax.random.normal(
+            key, (s, h, d), jnp.float32))
+        kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+        q, k, v = mk(kq), mk(kk), mk(kv)
+        flops = 4 * S * S * H * D
+
+        def pall(d_):
+            return pallas_flash_attention(d_, k, v)
+
+        def xla(d_):
+            return _flash_xla(d_, k, v, causal=False, chunk=None,
+                              q_offset=0, kv_offset=0)
+
+        def grad_of(impl):
+            def f(d_):
+                return jax.grad(lambda q_: jnp.sum(flash_attention(
+                    q_, k, v, impl=impl) ** 2))(d_)
+            return f
+
+        t_p = device_seconds_per_iter(pall, q, k0=1, k1=7)
+        sp_p = last_spread()["k1_worst_over_best"]
+        t_x = device_seconds_per_iter(xla, q, k0=1, k1=7)
+        sp_x = last_spread()["k1_worst_over_best"]
+        t_pg = device_seconds_per_iter(grad_of("pallas"), q, k0=1, k1=5)
+        sp_pg = last_spread()["k1_worst_over_best"]
+        t_xg = device_seconds_per_iter(grad_of("xla"), q, k0=1, k1=5)
+        sp_xg = last_spread()["k1_worst_over_best"]
+        point = {
+            "S": S, "H": H, "D": D,
+            "fwd": {"pallas_tflops": round(flops / t_p / 1e12, 2),
+                    "xla_tflops": round(flops / t_x / 1e12, 2),
+                    "ratio_vs_xla": round(t_x / t_p, 3),
+                    "spread_pallas": sp_p, "spread_xla": sp_x},
+            "fwd_bwd": {"pallas_tflops": round(3.5 * flops / t_pg / 1e12, 2),
+                        "xla_tflops": round(3.5 * flops / t_xg / 1e12, 2),
+                        "ratio_vs_xla": round(t_xg / t_pg, 3),
+                        "spread_pallas": sp_pg, "spread_xla": sp_xg},
+        }
+        results["points"].append(point)
+        print(json.dumps(point), flush=True)
+
+    wins = [p for p in results["points"] if "fwd" in p]
+    if wins:
+        results["verdict"] = {
+            "fwd_all_win": all(p["fwd"]["ratio_vs_xla"] > 1.0 for p in wins),
+            "fwd_bwd_all_win": all(p["fwd_bwd"]["ratio_vs_xla"] > 1.0
+                                   for p in wins),
+        }
+    with open(os.path.join(_REPO, "PALLAS_FLASH_SWEEP.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    print("PALLAS_FLASH_SWEEP " + json.dumps(results["verdict"]
+                                             if wins else {}), flush=True)
+    wd.cancel()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

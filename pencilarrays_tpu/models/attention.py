@@ -177,8 +177,9 @@ def flash_attention(q, k, v, *, causal: bool = False, chunk: int = None,
     ``impl`` selects the local kernel: ``"xla"`` is the ``lax.scan``
     streaming path (differentiable, any backend); ``"pallas"`` is the
     hand-tiled VMEM-resident TPU kernel (:mod:`..ops.flash_pallas`),
-    whose backward recomputes through the XLA path via ``custom_vjp``;
-    ``"auto"`` (default) uses Pallas on TPU when
+    differentiable through matching hand-tiled dq/dk/dv backward
+    kernels via ``custom_vjp`` (the standard flash recompute-from
+    -logsumexp backward); ``"auto"`` (default) uses Pallas on TPU when
     :func:`..ops.flash_pallas.supported` accepts the case and
     ``PENCILARRAYS_TPU_PALLAS_ATTENTION`` is not ``0``.
     """
@@ -221,19 +222,24 @@ def _flash_pallas_vjp(q, k, v, causal, q_offset, kv_offset):
 
 
 def _flash_pallas_fwd(q, k, v, causal, q_offset, kv_offset):
-    return (_flash_pallas_vjp(q, k, v, causal, q_offset, kv_offset),
-            (q, k, v))
+    from ..ops.flash_pallas import pallas_flash_attention
+
+    out, (m, l) = pallas_flash_attention(
+        q, k, v, causal=causal, q_offset=q_offset, kv_offset=kv_offset,
+        return_stats=True)
+    return out, (q, k, v, out, m, l)
 
 
 def _flash_pallas_bwd(causal, q_offset, kv_offset, res, g):
-    # flash backward = streaming recompute; route it through the XLA
-    # scan path, whose VJP is exactly that (no O(S^2) residuals)
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _flash_xla(q_, k_, v_, causal=causal,
-                                      chunk=None, q_offset=q_offset,
-                                      kv_offset=kv_offset), q, k, v)
-    return vjp(g)
+    # flash backward = streaming recompute, as hand-tiled dq/dkv Pallas
+    # kernels rebuilding each score block from the saved logsumexp (no
+    # O(S^2) residuals — only the per-row (m, l) statistics ride along)
+    from ..ops.flash_pallas import pallas_flash_attention_bwd
+
+    q, k, v, out, m, l = res
+    return pallas_flash_attention_bwd(
+        q, k, v, out, g, m, l, causal=causal,
+        q_offset=q_offset, kv_offset=kv_offset)
 
 
 _flash_pallas_vjp.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)

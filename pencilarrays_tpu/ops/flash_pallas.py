@@ -35,10 +35,14 @@ Two output modes:
   flash/“flash-decoding” combine), which is how the ring schedule
   accumulates one kernel call per round.
 
-Differentiation: the kernel is forward-only; ``models.attention``
-wraps both modes in ``jax.custom_vjp``\\ s whose backward recomputes
-through the XLA path (standard flash practice: the backward is itself a
-streaming recompute, so nothing extra is stored).
+Differentiation: the default mode has a matching hand-tiled backward —
+:func:`pallas_flash_attention_bwd` rebuilds each score block from the
+saved logsumexp (``return_stats=True`` residuals) and produces dq/dk/dv
+in two passes (standard flash practice: the backward is itself a
+streaming recompute, so only per-row statistics are stored).
+``models.attention`` wires it as the ``custom_vjp`` of the public
+``flash_attention`` routing; the ``partials`` (ring) mode still
+recomputes its backward through the XLA path.
 """
 
 from __future__ import annotations
@@ -50,7 +54,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["pallas_flash_attention", "supported"]
+__all__ = ["pallas_flash_attention", "pallas_flash_attention_bwd",
+           "supported"]
 
 _DEF_BLOCK_Q = 256
 _DEF_BLOCK_K = 256
@@ -86,9 +91,12 @@ def supported(sq: int, skv: int, d: int, dtype, *, q_offset=0, kv_offset=0,
 
 def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, *refs,
                   scale: float, causal: bool, skv: int, bq: int, bk: int,
-                  nk: int, out_dtype, partials: bool):
+                  nk: int, out_dtype, partials: bool,
+                  return_stats: bool = False):
     if partials:
         acc_o, m_o, l_o, m_ref, l_ref, acc_ref = refs
+    elif return_stats:
+        o_ref, m_o, l_o, m_ref, l_ref, acc_ref = refs
     else:
         (o_ref, m_ref, l_ref, acc_ref) = refs
     q_off = offs_ref[0]
@@ -157,6 +165,9 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, *refs,
             # keep it finite rather than 0/0
             l = jnp.where(l == 0.0, 1.0, l)
             o_ref[0] = (acc_ref[:] / l).astype(out_dtype)
+            if return_stats:
+                m_o[0] = m_ref[:, 0]
+                l_o[0] = l_ref[:, 0]
 
 
 # imported lazily so module import never requires a Pallas-capable jax
@@ -185,14 +196,20 @@ def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                            block_q: int = _DEF_BLOCK_Q,
                            block_k: int = _DEF_BLOCK_K,
                            interpret: Optional[bool] = None,
-                           partials: bool = False):
+                           partials: bool = False,
+                           return_stats: bool = False):
     """Flash attention on ``(S, H, *batch, D)`` arrays as one Pallas
-    kernel per (head x batch) slice.  Forward only — see module
-    docstring for the VJP wiring and the ``partials`` output mode
-    (which requires the folded 4-D ``(S, H, B, D)`` layout).  Offsets
-    may be traced scalars.  Callers should gate on :func:`supported`.
+    kernel per (head x batch) slice.  See the module docstring for the
+    VJP wiring and the ``partials`` output mode (which requires the
+    folded 4-D ``(S, H, B, D)`` layout).  Offsets may be traced
+    scalars.  Callers should gate on :func:`supported`.
     ``interpret=None`` auto-selects interpreter mode on CPU (the
     virtual-mesh test backend) and native Mosaic elsewhere.
+
+    ``return_stats=True`` additionally returns the flash softmax
+    statistics ``(m, l)`` in FOLDED row layout ``(H*B, Sq)`` (f32, q
+    padding sliced off) — the residuals :func:`pallas_flash_attention_bwd`
+    consumes; the return value becomes ``(out, (m, l))``.
     """
     _ensure_pallas()
     from jax.experimental.pallas import tpu as pltpu
@@ -202,6 +219,8 @@ def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if partials and q.ndim != 4:
         raise ValueError("partials mode expects the folded (S, H, B, D) "
                          "layout")
+    if partials and return_stats:
+        raise ValueError("partials already returns the statistics")
 
     out_shape, out_dtype = q.shape, q.dtype
     sq, h = q.shape[:2]
@@ -229,7 +248,7 @@ def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     kernel = functools.partial(
         _flash_kernel, scale=1.0 / math.sqrt(d), causal=causal,
         skv=skv, bq=bq, bk=bk, nk=nk, out_dtype=out_dtype,
-        partials=partials)
+        partials=partials, return_stats=return_stats)
 
     spec_q = pl.BlockSpec((1, bq, d), lambda hbi, i, j: (hbi, i, 0))
     spec_kv = pl.BlockSpec((1, bk, d), lambda hbi, i, j: (hbi, j, 0))
@@ -237,6 +256,13 @@ def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if partials:
         out_shapes = [
             jax.ShapeDtypeStruct((hb, nq * bq, d), jnp.float32),  # acc
+            jax.ShapeDtypeStruct((hb, nq * bq), jnp.float32),     # m
+            jax.ShapeDtypeStruct((hb, nq * bq), jnp.float32),     # l
+        ]
+        out_specs = [spec_q, spec_row, spec_row]
+    elif return_stats:
+        out_shapes = [
+            jax.ShapeDtypeStruct((hb, nq * bq, d), out_dtype),
             jax.ShapeDtypeStruct((hb, nq * bq), jnp.float32),     # m
             jax.ShapeDtypeStruct((hb, nq * bq), jnp.float32),     # l
         ]
@@ -273,6 +299,236 @@ def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         l = l[:, :sq].reshape(h, b, sq)
         return m, l, acc
 
+    if return_stats:
+        res, m, l = res
+        m, l = m[:, :sq], l[:, :sq]                     # (H*B, Sq)
     out = res[:, :sq]                                   # drop q padding
     out = out.reshape(h, -1, sq, d)
-    return jnp.moveaxis(out, 2, 0).reshape(out_shape)
+    out = jnp.moveaxis(out, 2, 0).reshape(out_shape)
+    return (out, (m, l)) if return_stats else out
+
+
+# ---------------------------------------------------------------------------
+# Backward: hand-tiled dq / dk / dv kernels (the flash backward recompute).
+#
+# Standard two-pass structure (same tiling argument as the forward — the
+# (bq x bk) score block is rebuilt in VMEM from q/k and the saved
+# logsumexp, never materialized in HBM):
+#
+#   P_ij = exp(s_ij - L_i)              s = scale * q k^T, L = m + log l
+#   dV_j = sum_i P_ij^T dO_i
+#   dP_ij = dO_i . v_j
+#   dS_ij = P_ij (dP_ij - D_i)          D_i = rowsum(dO_i * O_i)
+#   dQ_i = scale * sum_j dS_ij k_j      (pass 1: grid j inner)
+#   dK_j = scale * sum_i dS_ij^T q_i    (pass 2: grid i inner)
+#
+# L rides per-row as (1, bq, 1) blocks; padded q rows carry L = +inf so
+# P == 0 there (their dO is zero-padded too), padded keys are masked by
+# global position — so no pad value ever contaminates a real gradient.
+# Capability bar: the in-tree JAX kernel's dq/dkv split
+# (jax/experimental/pallas/ops/tpu/flash_attention.py); this
+# implementation keeps this module's layout contract and traced-offset
+# SMEM convention instead of its (B, H, S, D) layout.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_common(q, k, v, do, L_ref, D_ref, *, scale, causal, skv,
+                bq, bk, i, j, q_off, kv_off):
+    """Rebuild P and dS for one (bq x bk) block (f32)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # (bq, bk)
+    L = L_ref[0]                                          # (bq, 1)
+    p = jnp.exp(s - L)
+    tail_pad = skv % bk != 0
+    if causal or tail_pad:
+        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        valid = cols < skv
+        if causal:
+            rows = q_off + i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            valid = jnp.logical_and(valid, rows >= kv_off + cols)
+        p = jnp.where(valid, p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)               # (bq, bk)
+    ds = p * (dp - D_ref[0])                              # (bq, bk)
+    return p, ds
+
+
+def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, L_ref,
+                         D_ref, dq_o, dq_acc, *, scale, causal, skv,
+                         bq, bk, nk, out_dtype):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    q_off = offs_ref[0]
+    kv_off = offs_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        _, ds = _bwd_common(q, k, v, do, L_ref, D_ref, scale=scale,
+                            causal=causal, skv=skv, bq=bq, bk=bk,
+                            i=i, j=j, q_off=q_off, kv_off=kv_off)
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(q_off + (i + 1) * bq - 1 >= kv_off + j * bk)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_o[0] = dq_acc[:].astype(out_dtype)
+
+
+def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, L_ref,
+                          D_ref, dk_o, dv_o, dk_acc, dv_acc, *, scale,
+                          causal, skv, bq, bk, nq, out_dtype):
+    j = pl.program_id(1)   # key block: outer
+    i = pl.program_id(2)   # q block: inner (accumulated)
+    q_off = offs_ref[0]
+    kv_off = offs_ref[1]
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _bwd_common(q, k, v, do, L_ref, D_ref, scale=scale,
+                            causal=causal, skv=skv, bq=bq, bk=bk,
+                            i=i, j=j, q_off=q_off, kv_off=kv_off)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, D)
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bk, D)
+
+    if causal:
+        pl.when(q_off + (i + 1) * bq - 1 >= kv_off + j * bk)(_compute)
+    else:
+        _compute()
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_o[0] = dk_acc[:].astype(out_dtype)
+        dv_o[0] = dv_acc[:].astype(out_dtype)
+
+
+def pallas_flash_attention_bwd(q, k, v, out, do, m, l, *,
+                               causal: bool = False, q_offset=0,
+                               kv_offset=0, block_q: int = _DEF_BLOCK_Q,
+                               block_k: int = _DEF_BLOCK_K,
+                               interpret: Optional[bool] = None):
+    """Flash-attention backward as two Pallas kernels: ``(dq, dk, dv)``
+    from the forward residuals (``out`` plus the folded ``(m, l)``
+    statistics from ``return_stats=True``).  Layouts/dtypes mirror the
+    forward's ``(S, H, *batch, D)`` contract; gradients come back in
+    the inputs' dtypes with f32 accumulation inside the kernels.
+    """
+    _ensure_pallas()
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    sq, h = q.shape[:2]
+    d = q.shape[-1]
+    skv = k.shape[0]
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(kv_offset, jnp.int32)])
+
+    def fold(x):  # (S, H, *batch, D) -> (H*B, S, D)
+        s = x.shape[0]
+        x = x.reshape(s, h, -1, d)
+        return jnp.moveaxis(x, 0, 2).reshape(-1, s, d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    outf, dof = fold(out), fold(do)
+    hb = qf.shape[0]
+
+    # per-row residuals: logsumexp L (+inf where no key is visible, so
+    # the rebuilt P is exactly 0 there) and D = rowsum(dO * O) — cheap
+    # elementwise work left to XLA
+    Lrow = jnp.where(l > 0.0, m + jnp.log(l), jnp.inf)    # (H*B, Sq)
+    Drow = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
+                   axis=-1)                               # (H*B, Sq)
+
+    bq = min(block_q, -(-sq // 8) * 8)
+    bk = min(block_k, -(-skv // 128) * 128)
+    nq = -(-sq // bq)
+    nk = -(-skv // bk)
+    qf = _pad_to(qf, 1, nq * bq)
+    dof = _pad_to(dof, 1, nq * bq)
+    kf = _pad_to(kf, 1, nk * bk)
+    vf = _pad_to(vf, 1, nk * bk)
+    pad_rows = nq * bq - sq
+    if pad_rows:
+        Lrow = jnp.pad(Lrow, ((0, 0), (0, pad_rows)),
+                       constant_values=jnp.inf)
+        Drow = jnp.pad(Drow, ((0, 0), (0, pad_rows)))
+    Lcol = Lrow[..., None]                                # (H*B, Sqp, 1)
+    Dcol = Drow[..., None]
+
+    scale = 1.0 / math.sqrt(d)
+    spec_q = pl.BlockSpec((1, bq, d), lambda hbi, i, j: (hbi, i, 0))
+    spec_row = pl.BlockSpec((1, bq, 1), lambda hbi, i, j: (hbi, i, 0))
+    spec_kv = pl.BlockSpec((1, bk, d), lambda hbi, i, j: (hbi, j, 0))
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    dqf = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale,
+                          causal=causal, skv=skv, bq=bq, bk=bk, nk=nk,
+                          out_dtype=q.dtype),
+        out_shape=jax.ShapeDtypeStruct((hb, nq * bq, d), q.dtype),
+        grid=(hb, nq, nk),
+        in_specs=[smem, spec_q, spec_kv, spec_kv, spec_q, spec_row,
+                  spec_row],
+        out_specs=spec_q,
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, qf, kf, vf, dof, Lcol, Dcol)
+
+    # dkv pass: key blocks outer, q blocks inner (accumulated), so the
+    # q/do/L/D specs index by the INNER grid dim
+    spec_q_i = pl.BlockSpec((1, bq, d), lambda hbi, j, i: (hbi, i, 0))
+    spec_row_i = pl.BlockSpec((1, bq, 1), lambda hbi, j, i: (hbi, i, 0))
+    spec_kv_j = pl.BlockSpec((1, bk, d), lambda hbi, j, i: (hbi, j, 0))
+    dkf, dvf = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale,
+                          causal=causal, skv=skv, bq=bq, bk=bk, nq=nq,
+                          out_dtype=k.dtype),
+        out_shape=[jax.ShapeDtypeStruct((hb, nk * bk, d), k.dtype),
+                   jax.ShapeDtypeStruct((hb, nk * bk, d), v.dtype)],
+        grid=(hb, nk, nq),
+        in_specs=[smem, spec_q_i, spec_kv_j, spec_kv_j, spec_q_i,
+                  spec_row_i, spec_row_i],
+        out_specs=[spec_kv_j, spec_kv_j],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, qf, kf, vf, dof, Lcol, Dcol)
+
+    def unfold(x, s, like):
+        x = x[:, :s].reshape(h, -1, s, d)
+        return jnp.moveaxis(x, 2, 0).reshape(like.shape)
+
+    return (unfold(dqf, sq, q), unfold(dkf, skv, k), unfold(dvf, skv, v))

@@ -108,7 +108,8 @@ def test_validation(topo):
         ring_attention(qh, qh, qh)
 
 
-@pytest.mark.parametrize("scheme", ["ulysses", "ring"])
+@pytest.mark.parametrize("scheme", [
+    "ulysses", pytest.param("ring", marks=pytest.mark.slow)])  # ring ~12 s
 def test_causal_matches_dense(topo, scheme):
     """causal=True masks by GLOBAL positions (ring must map its rotating
     block back to global kv indices)."""
@@ -209,7 +210,8 @@ def test_ulysses_long_sequence_flash(topo):
     np.testing.assert_allclose(out_u, out_r, rtol=2e-4, atol=2e-5)
 
 
-@pytest.mark.parametrize("scheme", ["ulysses", "ring"])
+@pytest.mark.parametrize("scheme", [
+    "ulysses", pytest.param("ring", marks=pytest.mark.slow)])  # ring ~25 s
 def test_batched_attention_matches_dense(topo, scheme):
     """extra_dims=(*batch, D): leading extra dims are independent batch
     elements in both distributed schemes."""

@@ -140,6 +140,7 @@ def test_dct_3d_matches_scipy(topo):
     np.testing.assert_allclose(gather(back), u, rtol=1e-10, atol=1e-12)
 
 
+@pytest.mark.slow  # ~16 s; the DCT variant stays as the default r2r canary
 def test_dst_3d_matches_scipy(topo):
     """DST-II via the DCT identity (no native jax dst) — verified against
     scipy.fft.dstn; completes the R2R family."""

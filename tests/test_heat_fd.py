@@ -5,6 +5,7 @@ decomposition independence, and a neighbor-only collective profile."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import pencilarrays_tpu as pa
 from pencilarrays_tpu.models import DiffusionSpectral, HeatFD
@@ -34,6 +35,7 @@ def test_matches_numpy_reference(devices):
                                atol=1e-12, rtol=1e-12)
 
 
+@pytest.mark.slow  # ~15 s: FD vs spectral integration cross-check
 def test_cross_validates_spectral(devices):
     """FD vs the exact spectral propagator on a smooth low-mode field:
     the FD error is O(h^2 + dt^2) and must shrink ~4x when the grid

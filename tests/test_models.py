@@ -57,6 +57,7 @@ def test_step_physics(topo):
     assert float(reductions.maximum(abs(div))) < 1e-8
 
 
+@pytest.mark.slow  # ~20 s: full NS step on two meshes
 def test_decomposition_independence(topo, devices):
     """The strongest distributed-correctness check: the same physics on a
     1-device vs 8-device mesh must agree."""

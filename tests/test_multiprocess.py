@@ -67,7 +67,9 @@ def _launch_workers(worker, nprocs, extra_args, sentinel, label):
 
 @pytest.mark.parametrize(
     "nprocs",
-    [2, pytest.param(4, marks=pytest.mark.slow)])  # 4-proc run is ~3 min
+    [pytest.param(2, marks=pytest.mark.slow),
+     pytest.param(4, marks=pytest.mark.slow)])  # ~2 / ~3 min each;
+# default cross-process coverage rides test_restart_across_process_counts
 def test_multi_process_integration(tmp_path, nprocs):
     here = os.path.dirname(os.path.abspath(__file__))
     worker = os.path.join(here, "multiprocess_worker.py")
