@@ -1,0 +1,134 @@
+"""Opportunistic real-TPU evidence capture (VERDICT r4 #1b).
+
+Rounds 3 and 4 both ended with a red bench gate because the single
+end-of-round capture ran through whatever tunnel state existed at that
+moment.  This script inverts the strategy: run it in the background the
+whole round; every cycle it probes the tunnel cheaply (disposable
+subprocess, short timeout) and, at the FIRST healthy moment, runs the
+full bench and the flash-attention sweep, writing timestamped artifacts:
+
+* ``BENCH_SELF_r05.json``    — every per-metric line + the summary line
+  from ``bench.py`` (same JSON the driver would capture), plus capture
+  metadata (UTC time, attempt number);
+* ``PALLAS_FLASH_SWEEP.json`` — written by ``benchmarks/flash_sweep.py``
+  itself.
+
+Once both artifacts exist the script exits; committing them is the
+operator's (builder's) job.  A wedge mid-capture leaves the partial
+stream in the artifact — evidence is append-only, never erased.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PROBE = (
+    "import time,os; t0=time.time(); import jax; import jax.numpy as jnp;"
+    "d=jax.devices(); x=jnp.ones((256,256),jnp.float32);"
+    "(x@x).block_until_ready();"
+    "print('PROBE_OK %s %d %.1f' % (jax.default_backend(), len(d),"
+    " time.time()-t0), flush=True)"
+)
+
+
+def _utcnow():
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def probe(timeout_s: float) -> bool:
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, cwd=_REPO)
+        ok = r.returncode == 0 and "PROBE_OK" in r.stdout
+        tag = r.stdout.strip() if ok else (r.stdout + r.stderr)[-300:]
+    except subprocess.TimeoutExpired:
+        ok, tag = False, f"probe killed at {timeout_s:.0f}s"
+    print(f"[{_utcnow()}] probe ok={ok} {tag}", flush=True)
+    return ok
+
+
+def run_bench(attempt: int) -> bool:
+    """Run bench.py, stream+save all JSON lines; True iff summary has a
+    numeric value."""
+    out_path = os.path.join(_REPO, "BENCH_SELF_r05.json")
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, "bench.py"], cwd=_REPO, capture_output=True,
+            text=True, timeout=float(os.environ.get("PA_CAP_BENCH_TMO",
+                                                    "1800")))
+        lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+        rc = r.returncode
+    except subprocess.TimeoutExpired as e:
+        lines = [ln for ln in (e.stdout or "").splitlines()
+                 if ln.startswith("{")]
+        rc = "timeout"
+    summary = None
+    if lines:
+        try:
+            summary = json.loads(lines[-1])
+        except ValueError:
+            pass
+    ok = bool(summary and summary.get("value") is not None)
+    doc = {"captured_utc": _utcnow(), "attempt": attempt, "rc": rc,
+           "ok": ok, "seconds": round(time.time() - t0, 1),
+           "lines": [json.loads(ln) for ln in lines
+                     if _loads_ok(ln)]}
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"[{_utcnow()}] bench rc={rc} ok={ok} "
+          f"({len(lines)} lines)", flush=True)
+    return ok
+
+
+def _loads_ok(ln):
+    try:
+        json.loads(ln)
+        return True
+    except ValueError:
+        return False
+
+
+def run_sweep() -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "benchmarks/flash_sweep.py"], cwd=_REPO,
+            capture_output=True, text=True,
+            timeout=float(os.environ.get("PA_CAP_SWEEP_TMO", "1500")))
+        ok = r.returncode == 0 and os.path.exists(
+            os.path.join(_REPO, "PALLAS_FLASH_SWEEP.json"))
+        tail = r.stdout.strip().splitlines()[-3:]
+    except subprocess.TimeoutExpired:
+        ok, tail = False, ["sweep killed at timeout"]
+    print(f"[{_utcnow()}] sweep ok={ok} " + " | ".join(tail), flush=True)
+    return ok
+
+
+def main():
+    cycle_s = float(os.environ.get("PA_CAP_CYCLE", "300"))
+    probe_tmo = float(os.environ.get("PA_CAP_PROBE_TMO", "150"))
+    bench_done = os.path.exists(os.path.join(_REPO, "BENCH_SELF_r05.json"))
+    sweep_done = os.path.exists(
+        os.path.join(_REPO, "PALLAS_FLASH_SWEEP.json"))
+    attempt = 0
+    while not (bench_done and sweep_done):
+        attempt += 1
+        if probe(probe_tmo):
+            if not bench_done:
+                bench_done = run_bench(attempt)
+            if not sweep_done:
+                sweep_done = run_sweep()
+        if not (bench_done and sweep_done):
+            time.sleep(cycle_s)
+    print(f"[{_utcnow()}] capture complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()
