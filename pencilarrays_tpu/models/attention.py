@@ -197,8 +197,10 @@ def _use_pallas_flash(q, k, v, q_offset, kv_offset, *, force: bool) -> bool:
 
     # the public flash_attention path hashes offsets as nondiff custom_vjp
     # args, so they must be static ints here (the kernel itself takes
-    # traced offsets — the ring partials path uses that)
-    ok = (isinstance(q_offset, int) and isinstance(kv_offset, int)
+    # traced offsets — the ring partials path uses that); numpy integer
+    # scalars are equally static and hash fine
+    ok = (isinstance(q_offset, (int, np.integer))
+          and isinstance(kv_offset, (int, np.integer))
           and q.dtype == k.dtype == v.dtype
           and flash_pallas.supported(q.shape[0], k.shape[0],
                                      q.shape[-1], q.dtype))
@@ -260,48 +262,253 @@ def _merge_partials(a, b):
     return m, l, acc1 * cc1 + acc2 * cc2
 
 
-def _xla_partials(qb, kb, vb, offs_f, causal):
-    """One-block flash statistics on folded (S, H, B, D) arrays — the
-    XLA counterpart of the kernel's ``partials=True`` mode (used as the
-    backward recompute for its ``custom_vjp``)."""
-    d = qb.shape[-1]
-    offs = offs_f.astype(jnp.int32)
-    s = _scores(qb, kb) * (1.0 / math.sqrt(d))
-    if causal:
-        gq = offs[0] + jnp.arange(qb.shape[0])
-        gt = offs[1] + jnp.arange(kb.shape[0])
-        s = jnp.where((gq[:, None] >= gt[None, :])[None, None], s,
-                      _neg_value(s.dtype))
-    return _flash_update(None, s, vb)
+# ---------------------------------------------------------------------------
+# ring / zigzag hand-kernel paths: whole-schedule custom_vjp
+# ---------------------------------------------------------------------------
+# The forward runs one Pallas ``partials`` kernel per visited block with
+# the round's traced global offsets, merged exactly across rounds.  The
+# backward is the standard ring-attention backward, itself a ring: the
+# global softmax over all visited key sets has logsumexp
+# ``L = m + log l`` (final merged statistics), so each visited block's
+# gradient is the ordinary flash backward recompute against that GLOBAL
+# L — k/v rotate around the ring again, a rotating dk/dv accumulator
+# rides along, and after a full cycle every block's gradient is back on
+# its home device.  dq accumulates locally.  All matmul work in both
+# directions runs in the hand-tiled kernels
+# (``ops.flash_pallas``); XLA contributes only the elementwise
+# merge/normalize glue, which it fuses.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _flash_partials_pallas(qb, kb, vb, offs_f, causal):
-    """Pallas partials with traced offsets (f32 so the VJP has a float
-    cotangent slot; the kernel reads them as int32 from SMEM)."""
+def _ring_rounds_pallas(qb, kb, vb, axis, P, d, causal):
+    """Forward partials loop (folded 4-D operands); returns the final
+    merged ``(m, l, acc)``."""
     from ..ops.flash_pallas import pallas_flash_attention
 
-    offs = offs_f.astype(jnp.int32)
-    return pallas_flash_attention(qb, kb, vb, causal=causal,
-                                  q_offset=offs[0], kv_offset=offs[1],
-                                  partials=True)
+    s_blk = qb.shape[0]
+    me = jax.lax.axis_index(axis)
+    carry = None
+    cur_kv = jnp.concatenate([kb, vb], axis=-1)
+    for r in range(P):
+        cur_k, cur_v = cur_kv[..., :d], cur_kv[..., d:]
+        kv_blk = (me - jnp.int32(r)) % jnp.int32(P)
+        part = pallas_flash_attention(
+            qb, cur_k, cur_v, causal=causal, q_offset=me * s_blk,
+            kv_offset=kv_blk * s_blk, partials=True)
+        carry = part if carry is None else _merge_partials(carry, part)
+        if r + 1 < P:
+            perm = [(i, (i + 1) % P) for i in range(P)]
+            cur_kv = jax.lax.ppermute(cur_kv, axis, perm)
+    return carry
 
 
-def _flash_partials_fwd(qb, kb, vb, offs_f, causal):
-    return (_flash_partials_pallas(qb, kb, vb, offs_f, causal),
-            (qb, kb, vb, offs_f))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash_pallas(qb, kb, vb, axis, P, d, causal):
+    m, l, acc = _ring_rounds_pallas(qb, kb, vb, axis, P, d, causal)
+    return _flash_finish(m, l, acc, qb.dtype)
 
 
-def _flash_partials_bwd(causal, res, g):
-    qb, kb, vb, offs_f = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _xla_partials(q_, k_, v_, offs_f, causal),
-        qb, kb, vb)
-    dq, dk, dv = vjp(g)
-    return dq, dk, dv, jnp.zeros_like(offs_f)
+def _ring_flash_pallas_fwd(qb, kb, vb, axis, P, d, causal):
+    m, l, acc = _ring_rounds_pallas(qb, kb, vb, axis, P, d, causal)
+    out32 = acc / jnp.moveaxis(jnp.where(l > 0.0, l, 1.0), -1, 0)[..., None]
+    return out32.astype(qb.dtype), (qb, kb, vb, out32, m, l)
 
 
-_flash_partials_pallas.defvjp(_flash_partials_fwd, _flash_partials_bwd)
+def _ring_flash_pallas_bwd(axis, P, d, causal, res, g):
+    from ..ops.flash_pallas import pallas_flash_attention_bwd_partials
+
+    qb, kb, vb, out32, m, l = res
+    s_blk = qb.shape[0]
+    me = jax.lax.axis_index(axis)
+    g32 = g.astype(jnp.float32)
+    # global per-row residuals: L over ALL visited key sets; D from the
+    # final normalized output (+inf L rows rebuild P == 0 exactly)
+    L = jnp.where(l > 0.0, m + jnp.log(l), jnp.inf)       # (H, B, Sq)
+    D = jnp.moveaxis(jnp.sum(g32 * out32, axis=-1), 0, -1)
+    dq = jnp.zeros(qb.shape, jnp.float32)
+    cur_kv = jnp.concatenate([kb, vb], axis=-1)
+    dkv = jnp.zeros(kb.shape[:-1] + (2 * d,), jnp.float32)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+    for r in range(P):
+        cur_k, cur_v = cur_kv[..., :d], cur_kv[..., d:]
+        kv_blk = (me - jnp.int32(r)) % jnp.int32(P)
+        dq_r, dk_r, dv_r = pallas_flash_attention_bwd_partials(
+            qb, cur_k, cur_v, g32, L, D, causal=causal,
+            q_offset=me * s_blk, kv_offset=kv_blk * s_blk)
+        dq = dq + dq_r
+        dkv = dkv + jnp.concatenate([dk_r, dv_r], axis=-1)
+        # rotate EVERY round (P total shifts = identity): the dk/dv
+        # accumulator must complete the cycle so each block's gradient,
+        # contributed once per device, lands back on its home device
+        cur_kv = jax.lax.ppermute(cur_kv, axis, perm)
+        dkv = jax.lax.ppermute(dkv, axis, perm)
+    return (dq.astype(qb.dtype), dkv[..., :d].astype(kb.dtype),
+            dkv[..., d:].astype(vb.dtype))
+
+
+_ring_flash_pallas.defvjp(_ring_flash_pallas_fwd, _ring_flash_pallas_bwd)
+
+
+# Zigzag placement, kernelized.  Device ``i`` holds q blocks ``lo = i``
+# and ``hi = 2P-1-i`` of ``2P`` (each ``b`` rows); the causal structure
+# of every needed block pair is EXACTLY the kernel's global-position
+# causal mask with the pair's offsets — diagonal pairs get equal
+# offsets, strictly-past pairs get ``q_off > kv_off + b`` (mask
+# all-visible) — so the same ``partials`` kernel covers the whole
+# schedule, two calls per later round (pair A always ``hi x klo``; pair
+# B where-selected on the scalar ``past`` predicate, offsets included,
+# keeping the program single-shape SPMD).
+
+
+def _zigzag_offsets(me, r, P, b):
+    """Global row offsets of the four blocks involved in round ``r``:
+    own (lo, hi) and the round's sender ``j = (me - r) mod P``'s
+    (lo, hi).  All traced int32 scalars — they ride into SMEM."""
+    j = (me - jnp.int32(r)) % jnp.int32(P)
+    return (me * b, (2 * P - 1 - me) * b, j * b, (2 * P - 1 - j) * b)
+
+
+def _zigzag_rounds_pallas(qb, kb, vb, axis, P, d):
+    """Forward partials loop for the zigzag schedule; returns the
+    merged ``(m, l, acc)`` carries for the lo and hi halves."""
+    from ..ops.flash_pallas import pallas_flash_attention
+
+    b = qb.shape[0] // 2
+    me = jax.lax.axis_index(axis)
+    q_lo, q_hi = qb[:b], qb[b:]
+
+    def part(qblk, kblk, vblk, qo, ko):
+        return pallas_flash_attention(qblk, kblk, vblk, causal=True,
+                                      q_offset=qo, kv_offset=ko,
+                                      partials=True)
+
+    lo_off, hi_off, _, _ = _zigzag_offsets(me, 0, P, b)
+    # round 0 — own blocks, the three needed pairs (diag, full, diag)
+    lo = part(q_lo, kb[:b], vb[:b], lo_off, lo_off)
+    hi = _merge_partials(part(q_hi, kb[:b], vb[:b], hi_off, lo_off),
+                         part(q_hi, kb[b:], vb[b:], hi_off, hi_off))
+    cur_kv = jnp.concatenate([kb, vb], axis=-1)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+    for r in range(1, P):
+        cur_kv = jax.lax.ppermute(cur_kv, axis, perm)
+        rk, rv = cur_kv[..., :d], cur_kv[..., d:]
+        _, _, jlo_off, jhi_off = _zigzag_offsets(me, r, P, b)
+        past = me >= r  # sender j = me - r (past) vs me - r + P (future)
+        # pair A — hi x klo: needed for past AND future senders
+        hi = _merge_partials(hi, part(q_hi, rk[:b], rv[:b],
+                                      hi_off, jlo_off))
+        # pair B — past: lo x klo (targets lo); future: hi x khi
+        qB = jnp.where(past, q_lo, q_hi)
+        kB = jnp.where(past, rk[:b], rk[b:])
+        vB = jnp.where(past, rv[:b], rv[b:])
+        sel = jax.tree.map(lambda a, c: jnp.where(past, a, c), lo, hi)
+        sel = _merge_partials(sel, part(qB, kB, vB,
+                                        jnp.where(past, lo_off, hi_off),
+                                        jnp.where(past, jlo_off,
+                                                  jhi_off)))
+        lo = jax.tree.map(lambda new, old: jnp.where(past, new, old),
+                          sel, lo)
+        hi = jax.tree.map(lambda new, old: jnp.where(past, old, new),
+                          sel, hi)
+    return lo, hi
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _zigzag_flash_pallas(qb, kb, vb, axis, P, d):
+    lo, hi = _zigzag_rounds_pallas(qb, kb, vb, axis, P, d)
+    return jnp.concatenate([_flash_finish(*lo, qb.dtype),
+                            _flash_finish(*hi, qb.dtype)], axis=0)
+
+
+def _zigzag_flash_pallas_fwd(qb, kb, vb, axis, P, d):
+    lo, hi = _zigzag_rounds_pallas(qb, kb, vb, axis, P, d)
+
+    def norm(c):
+        m, l, acc = c
+        return acc / jnp.moveaxis(jnp.where(l > 0.0, l, 1.0),
+                                  -1, 0)[..., None]
+
+    out32 = jnp.concatenate([norm(lo), norm(hi)], axis=0)
+    return (out32.astype(qb.dtype),
+            (qb, kb, vb, out32, lo[0], lo[1], hi[0], hi[1]))
+
+
+def _zigzag_flash_pallas_bwd(axis, P, d, res, g):
+    from ..ops.flash_pallas import pallas_flash_attention_bwd_partials
+
+    qb, kb, vb, out32, m_lo, l_lo, m_hi, l_hi = res
+    b = qb.shape[0] // 2
+    me = jax.lax.axis_index(axis)
+    g32 = g.astype(jnp.float32)
+    q_lo, q_hi = qb[:b], qb[b:]
+    g_lo, g_hi = g32[:b], g32[b:]
+
+    def resid(mm, ll, gg, oo):
+        L = jnp.where(ll > 0.0, mm + jnp.log(ll), jnp.inf)
+        D = jnp.moveaxis(jnp.sum(gg * oo, axis=-1), 0, -1)
+        return L, D
+
+    L_lo, D_lo = resid(m_lo, l_lo, g_lo, out32[:b])
+    L_hi, D_hi = resid(m_hi, l_hi, g_hi, out32[b:])
+
+    def bwd_part(qblk, kblk, vblk, gg, L, D, qo, ko):
+        return pallas_flash_attention_bwd_partials(
+            qblk, kblk, vblk, gg, L, D, causal=True,
+            q_offset=qo, kv_offset=ko)
+
+    lo_off, hi_off, _, _ = _zigzag_offsets(me, 0, P, b)
+    zero_half = jnp.zeros((b,) + kb.shape[1:-1] + (2 * d,), jnp.float32)
+
+    # round 0 — own blocks
+    dq1, dk1, dv1 = bwd_part(q_lo, kb[:b], vb[:b], g_lo, L_lo, D_lo,
+                             lo_off, lo_off)
+    dq2, dk2, dv2 = bwd_part(q_hi, kb[:b], vb[:b], g_hi, L_hi, D_hi,
+                             hi_off, lo_off)
+    dq3, dk3, dv3 = bwd_part(q_hi, kb[b:], vb[b:], g_hi, L_hi, D_hi,
+                             hi_off, hi_off)
+    dq_lo, dq_hi = dq1, dq2 + dq3
+    dkv = jnp.concatenate(
+        [jnp.concatenate([dk1 + dk2, dv1 + dv2], axis=-1),
+         jnp.concatenate([dk3, dv3], axis=-1)], axis=0)
+
+    cur_kv = jnp.concatenate([kb, vb], axis=-1)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+    for r in range(1, P):
+        cur_kv = jax.lax.ppermute(cur_kv, axis, perm)
+        dkv = jax.lax.ppermute(dkv, axis, perm)
+        rk, rv = cur_kv[..., :d], cur_kv[..., d:]
+        _, _, jlo_off, jhi_off = _zigzag_offsets(me, r, P, b)
+        past = me >= r
+        # pair A — hi x klo
+        dqA, dkA, dvA = bwd_part(q_hi, rk[:b], rv[:b], g_hi, L_hi, D_hi,
+                                 hi_off, jlo_off)
+        dq_hi = dq_hi + dqA
+        contribA = jnp.concatenate([dkA, dvA], axis=-1)
+        # pair B — operands, residuals, AND offsets where-selected
+        qB = jnp.where(past, q_lo, q_hi)
+        kB = jnp.where(past, rk[:b], rk[b:])
+        vB = jnp.where(past, rv[:b], rv[b:])
+        gB = jnp.where(past, g_lo, g_hi)
+        LB = jnp.where(past, L_lo, L_hi)
+        DB = jnp.where(past, D_lo, D_hi)
+        dqB, dkB, dvB = bwd_part(qB, kB, vB, gB, LB, DB,
+                                 jnp.where(past, lo_off, hi_off),
+                                 jnp.where(past, jlo_off, jhi_off))
+        dq_lo = dq_lo + jnp.where(past, dqB, 0.0)
+        dq_hi = dq_hi + jnp.where(past, 0.0, dqB)
+        contribB = jnp.concatenate([dkB, dvB], axis=-1)
+        dkv = dkv + jnp.concatenate(
+            [contribA + jnp.where(past, contribB, 0.0),
+             jnp.where(past, zero_half, contribB)], axis=0)
+    # one final shift completes the cycle (P total): every block's
+    # accumulated gradient returns to its home device
+    dkv = jax.lax.ppermute(dkv, axis, perm)
+    dq = jnp.concatenate([dq_lo, dq_hi], axis=0).astype(qb.dtype)
+    return (dq, dkv[..., :d].astype(kb.dtype),
+            dkv[..., d:].astype(vb.dtype))
+
+
+_zigzag_flash_pallas.defvjp(_zigzag_flash_pallas_fwd,
+                            _zigzag_flash_pallas_bwd)
 
 
 def _flash_xla(q, k, v, *, causal, chunk, q_offset, kv_offset):
@@ -522,12 +729,11 @@ def ring_attention(q: PencilArray, k: PencilArray, v: PencilArray,
         raise ValueError("zigzag needs S divisible by 2P")
 
     use_zigzag = causal and zigzag and P > 1
-    if use_zigzag and impl == "pallas":
-        raise ValueError("the zigzag schedule's pair selection is not "
-                         "kernelized; use impl='auto' or 'xla'")
-    use_pallas = (not use_zigzag) and impl != "xla" and _ring_use_pallas(
-        q, k, v, pen_seq.size_global()[0] // P, d,
-        force=(impl == "pallas"))
+    # kernel block length: the full local block for the plain ring, one
+    # zigzag half-block (b = S/(2P)) for the zigzag pair schedule
+    blk_rows = pen_seq.size_global()[0] // P // (2 if use_zigzag else 1)
+    use_pallas = impl != "xla" and _ring_use_pallas(
+        q, k, v, blk_rows, d, force=(impl == "pallas"))
     local = _zigzag_local_fn if use_zigzag else _ring_local_fn
     fn = jax.shard_map(
         lambda qb, kb, vb: local(qb, kb, vb, axis=axis, P=P, d=d,
@@ -543,14 +749,20 @@ def _ring_local_fn(qb, kb, vb, *, axis, P, d, causal, use_pallas=False):
 
     ``use_pallas=False``: causal rounds mask by global position —
     fully-future blocks still pay their score/value FLOPs (the zigzag
-    path avoids that).  ``use_pallas=True``: each round is ONE Pallas
-    kernel call in ``partials`` mode with the round's traced global
-    offsets (SMEM), merged exactly across rounds; the kernel's own
-    block-skip predication then prunes fully-future work at runtime,
-    so even the naive causal placement stops paying for masked blocks.
+    path avoids that).  ``use_pallas=True``: the whole schedule runs
+    under :func:`_ring_flash_pallas` — each round ONE Pallas kernel
+    call in ``partials`` mode with the round's traced global offsets
+    (SMEM), merged exactly across rounds, and a matching hand-tiled
+    ring BACKWARD (global-logsumexp flash recompute per block with a
+    rotating dk/dv accumulator); the kernel's block-skip predication
+    prunes fully-future work at runtime, so even the naive causal
+    placement stops paying for masked blocks.
     """
     out_shape, out_dtype = qb.shape, qb.dtype
     qb, kb, vb = _fold_batch(qb), _fold_batch(kb), _fold_batch(vb)
+    if use_pallas:
+        out = _ring_flash_pallas(qb, kb, vb, axis, P, d, causal)
+        return out.reshape(out_shape)
     scale = 1.0 / math.sqrt(d)
     s_blk = qb.shape[0]
     me = jax.lax.axis_index(axis)
@@ -566,21 +778,13 @@ def _ring_local_fn(qb, kb, vb, *, axis, P, d, causal, use_pallas=False):
         # after r forward shifts, this device holds k/v block
         # (me - r) mod P; mask by GLOBAL positions
         kv_blk = (me - jnp.int32(r)) % jnp.int32(P)
-        if use_pallas:
-            offs_f = jnp.stack([(me * s_blk).astype(jnp.float32),
-                                (kv_blk * s_blk).astype(jnp.float32)])
-            part = _flash_partials_pallas(qb, cur_k, cur_v, offs_f,
-                                          causal)
-            carry = part if carry is None else _merge_partials(carry,
-                                                               part)
-        else:
-            s = _scores(qb, cur_k) * scale           # (H, B, Sq, Skv)
-            if causal:
-                gq = me * s_blk + jnp.arange(s_blk)      # (Sq,)
-                gt = kv_blk * s_blk + jnp.arange(s_blk)  # (Skv,)
-                s = jnp.where((gq[:, None] >= gt[None, :])[None, None],
-                              s, neg)
-            carry = _flash_update(carry, s, cur_v)
+        s = _scores(qb, cur_k) * scale               # (H, B, Sq, Skv)
+        if causal:
+            gq = me * s_blk + jnp.arange(s_blk)      # (Sq,)
+            gt = kv_blk * s_blk + jnp.arange(s_blk)  # (Skv,)
+            s = jnp.where((gq[:, None] >= gt[None, :])[None, None],
+                          s, neg)
+        carry = _flash_update(carry, s, cur_v)
         if r + 1 < P:
             # shift the k/v block one step around the ring
             perm = [(i, (i + 1) % P) for i in range(P)]
@@ -608,10 +812,19 @@ def _zigzag_local_fn(qb, kb, vb, *, axis, P, d, causal, use_pallas=False):
     single-shape SPMD while never touching a fully-masked block.  Score
     FLOPs: ``(4P + 2) b^2`` block-units vs the naive path's ``8P``
     (measured via ``cost_analysis`` in the tests).
+
+    ``use_pallas=True`` runs the same schedule with every pair as one
+    hand-tiled ``partials`` kernel call (each pair's causal structure
+    IS the kernel's global-position mask under the pair's traced
+    offsets), with a matching hand-tiled ring backward — see
+    :func:`_zigzag_flash_pallas`.
     """
     assert causal
     out_shape, out_dtype = qb.shape, qb.dtype
     qb, kb, vb = _fold_batch(qb), _fold_batch(kb), _fold_batch(vb)
+    if use_pallas:
+        out = _zigzag_flash_pallas(qb, kb, vb, axis, P, d)
+        return out.reshape(out_shape)
     scale = 1.0 / math.sqrt(d)
     b = qb.shape[0] // 2
     me = jax.lax.axis_index(axis)

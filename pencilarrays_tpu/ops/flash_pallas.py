@@ -35,14 +35,18 @@ Two output modes:
   flash/“flash-decoding” combine), which is how the ring schedule
   accumulates one kernel call per round.
 
-Differentiation: the default mode has a matching hand-tiled backward —
+Differentiation: both modes have matching hand-tiled backwards.
 :func:`pallas_flash_attention_bwd` rebuilds each score block from the
 saved logsumexp (``return_stats=True`` residuals) and produces dq/dk/dv
 in two passes (standard flash practice: the backward is itself a
-streaming recompute, so only per-row statistics are stored).
+streaming recompute, so only per-row statistics are stored);
 ``models.attention`` wires it as the ``custom_vjp`` of the public
-``flash_attention`` routing; the ``partials`` (ring) mode still
-recomputes its backward through the XLA path.
+``flash_attention`` routing.  :func:`pallas_flash_attention_bwd_partials`
+runs the same two kernels against a GLOBAL logsumexp for one visited
+key block — the per-round building block of the ring/zigzag schedules'
+hand-tiled backward (``models.attention._ring_flash_pallas`` /
+``_zigzag_flash_pallas``), where k/v rotate around the ring again and a
+rotating dk/dv accumulator carries each block's gradient home.
 """
 
 from __future__ import annotations
@@ -55,7 +59,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["pallas_flash_attention", "pallas_flash_attention_bwd",
-           "supported"]
+           "pallas_flash_attention_bwd_partials", "supported"]
 
 _DEF_BLOCK_Q = 256
 _DEF_BLOCK_K = 256
@@ -334,13 +338,20 @@ def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 def _bwd_common(q, k, v, do, L_ref, D_ref, *, scale, causal, skv,
                 bq, bk, i, j, q_off, kv_off):
-    """Rebuild P and dS for one (bq x bk) block (f32)."""
+    """Rebuild P and dS for one (bq x bk) block (f32).
+
+    Scores are masked BEFORE exponentiation (mirroring the forward):
+    a masked raw score is not bounded by L, so ``exp(s - L)`` on it
+    could overflow to inf for garbage-L rows (fully-masked rows whose
+    forward left ``l > 0``) and the correctness would then hang on a
+    where() re-applying exactly the forward's mask.  Masking first
+    means no intermediate inf ever exists.
+    """
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale       # (bq, bk)
-    L = L_ref[0]                                          # (bq, 1)
-    p = jnp.exp(s - L)
     tail_pad = skv % bk != 0
+    valid = None
     if causal or tail_pad:
         cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         valid = cols < skv
@@ -348,6 +359,13 @@ def _bwd_common(q, k, v, do, L_ref, D_ref, *, scale, causal, skv,
             rows = q_off + i * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             valid = jnp.logical_and(valid, rows >= kv_off + cols)
+        s = jnp.where(valid, s, _NEG)
+    L = L_ref[0]                                          # (bq, 1)
+    p = jnp.exp(s - L)
+    if valid is not None:
+        # exp(_NEG - L) is exactly 0 for any finite L >= the row's real
+        # max; this where() additionally zeroes masked entries of
+        # garbage-L rows (L << 0), keeping the old contract bit-for-bit
         p = jnp.where(valid, p, 0.0)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
@@ -440,17 +458,9 @@ def pallas_flash_attention_bwd(q, k, v, out, do, m, l, *,
     forward's ``(S, H, *batch, D)`` contract; gradients come back in
     the inputs' dtypes with f32 accumulation inside the kernels.
     """
-    _ensure_pallas()
-    from jax.experimental.pallas import tpu as pltpu
-
-    if interpret is None:
-        interpret = jax.default_backend() == "cpu"
-
     sq, h = q.shape[:2]
     d = q.shape[-1]
     skv = k.shape[0]
-    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
-                      jnp.asarray(kv_offset, jnp.int32)])
 
     def fold(x):  # (S, H, *batch, D) -> (H*B, S, D)
         s = x.shape[0]
@@ -459,7 +469,6 @@ def pallas_flash_attention_bwd(q, k, v, out, do, m, l, *,
 
     qf, kf, vf = fold(q), fold(k), fold(v)
     outf, dof = fold(out), fold(do)
-    hb = qf.shape[0]
 
     # per-row residuals: logsumexp L (+inf where no key is visible, so
     # the rebuilt P is exactly 0 there) and D = rowsum(dO * O) — cheap
@@ -467,6 +476,84 @@ def pallas_flash_attention_bwd(q, k, v, out, do, m, l, *,
     Lrow = jnp.where(l > 0.0, m + jnp.log(l), jnp.inf)    # (H*B, Sq)
     Drow = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
                    axis=-1)                               # (H*B, Sq)
+
+    dqf, dkf, dvf = _bwd_folded(
+        qf, kf, vf, dof, Lrow, Drow, q_offset, kv_offset,
+        causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, dq_dtype=q.dtype, dk_dtype=k.dtype,
+        dv_dtype=v.dtype)
+
+    def unfold(x, s, like):
+        x = x.reshape(h, -1, s, d)
+        return jnp.moveaxis(x, 2, 0).reshape(like.shape)
+
+    return (unfold(dqf, sq, q), unfold(dkf, skv, k), unfold(dvf, skv, v))
+
+
+def pallas_flash_attention_bwd_partials(q, k, v, do, L, D, *,
+                                        causal: bool = False, q_offset=0,
+                                        kv_offset=0,
+                                        block_q: int = _DEF_BLOCK_Q,
+                                        block_k: int = _DEF_BLOCK_K,
+                                        interpret: Optional[bool] = None):
+    """Backward for ONE key block of a partials-mode accumulation.
+
+    The ring/zigzag schedules merge per-round partials into a single
+    global softmax; their backward is the standard flash recompute per
+    visited block with the GLOBAL logsumexp.  This entry point runs the
+    same two hand-tiled kernels as :func:`pallas_flash_attention_bwd`
+    but takes the partials-layout residuals directly:
+
+    * ``q/k/v/do``: folded 4-D ``(S, H, B, D)`` (the partials-mode
+      layout contract);
+    * ``L``: ``(H, B, Sq)`` f32 — the global logsumexp rows
+      (``m + log l`` after ALL rounds merged; +inf where ``l == 0``);
+    * ``D``: ``(H, B, Sq)`` f32 — ``rowsum(dO * O)`` with ``O`` the
+      final normalized output.
+
+    Offsets may be traced (SMEM), which is what lets each ring round
+    feed its rotating block position in.  Returns ``(dq, dk, dv)`` in
+    f32 (the caller accumulates across rounds before casting).
+    """
+    sq, h = q.shape[:2]
+    d = q.shape[-1]
+    skv = k.shape[0]
+
+    def fold(x):  # (S, H, B, D) -> (H*B, S, D)
+        s = x.shape[0]
+        return jnp.moveaxis(x, 0, 2).reshape(-1, s, d)
+
+    qf, kf, vf, dof = fold(q), fold(k), fold(v), fold(do)
+    Lrow = L.reshape(-1, sq)                              # (H*B, Sq)
+    Drow = D.reshape(-1, sq)
+    dqf, dkf, dvf = _bwd_folded(
+        qf, kf, vf, dof, Lrow, Drow, q_offset, kv_offset,
+        causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, dq_dtype=jnp.float32,
+        dk_dtype=jnp.float32, dv_dtype=jnp.float32)
+
+    def unfold(x, s):
+        return jnp.moveaxis(x.reshape(h, -1, s, d), 2, 0)
+
+    return unfold(dqf, sq), unfold(dkf, skv), unfold(dvf, skv)
+
+
+def _bwd_folded(qf, kf, vf, dof, Lrow, Drow, q_offset, kv_offset, *,
+                causal, block_q, block_k, interpret, dq_dtype, dk_dtype,
+                dv_dtype):
+    """Shared backward core on folded ``(H*B, S, D)`` operands with
+    per-row residuals ``Lrow``/``Drow`` ``(H*B, Sq)``.  Returns folded
+    ``(dq, dk, dv)`` sliced back to the real sequence lengths."""
+    _ensure_pallas()
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    hb, sq, d = qf.shape
+    skv = kf.shape[1]
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(kv_offset, jnp.int32)])
 
     bq = min(block_q, -(-sq // 8) * 8)
     bk = min(block_k, -(-skv // 128) * 128)
@@ -493,8 +580,8 @@ def pallas_flash_attention_bwd(q, k, v, out, do, m, l, *,
     dqf = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale,
                           causal=causal, skv=skv, bq=bq, bk=bk, nk=nk,
-                          out_dtype=q.dtype),
-        out_shape=jax.ShapeDtypeStruct((hb, nq * bq, d), q.dtype),
+                          out_dtype=dq_dtype),
+        out_shape=jax.ShapeDtypeStruct((hb, nq * bq, d), dq_dtype),
         grid=(hb, nq, nk),
         in_specs=[smem, spec_q, spec_kv, spec_kv, spec_q, spec_row,
                   spec_row],
@@ -513,9 +600,9 @@ def pallas_flash_attention_bwd(q, k, v, out, do, m, l, *,
     dkf, dvf = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale,
                           causal=causal, skv=skv, bq=bq, bk=bk, nq=nq,
-                          out_dtype=k.dtype),
-        out_shape=[jax.ShapeDtypeStruct((hb, nk * bk, d), k.dtype),
-                   jax.ShapeDtypeStruct((hb, nk * bk, d), v.dtype)],
+                          out_dtype=dk_dtype),
+        out_shape=[jax.ShapeDtypeStruct((hb, nk * bk, d), dk_dtype),
+                   jax.ShapeDtypeStruct((hb, nk * bk, d), dv_dtype)],
         grid=(hb, nk, nq),
         in_specs=[smem, spec_q_i, spec_kv_j, spec_kv_j, spec_q_i,
                   spec_row_i, spec_row_i],
@@ -527,8 +614,4 @@ def pallas_flash_attention_bwd(q, k, v, out, do, m, l, *,
         interpret=interpret,
     )(offs, qf, kf, vf, dof, Lcol, Dcol)
 
-    def unfold(x, s, like):
-        x = x[:, :s].reshape(h, -1, s, d)
-        return jnp.moveaxis(x, 2, 0).reshape(like.shape)
-
-    return (unfold(dqf, sq, q), unfold(dkf, skv, k), unfold(dvf, skv, v))
+    return dqf[:, :sq], dkf[:, :skv], dvf[:, :skv]

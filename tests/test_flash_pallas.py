@@ -343,7 +343,9 @@ def test_ring_pallas_impl_on_mesh(devices, causal):
                                atol=1e-5, rtol=1e-5)
 
 
-def test_ring_zigzag_pallas_raises(devices):
+def test_ring_zigzag_pallas_force_rejects_tiny_head_dim(devices):
+    """impl='pallas' forcing still surfaces supported()'s verdict (d=4
+    does not tile the lane axis)."""
     import pencilarrays_tpu as pa
     from pencilarrays_tpu.models import ring_attention, to_zigzag
 
@@ -354,6 +356,99 @@ def test_ring_zigzag_pallas_raises(devices):
     z = to_zigzag(u)
     with pytest.raises(ValueError):
         ring_attention(z, z, z, causal=True, zigzag=True, impl="pallas")
+
+
+@pytest.mark.slow  # interpret-mode kernels x zigzag pairs x grad
+@pytest.mark.parametrize("P", [2, 4])
+def test_zigzag_pallas_impl_on_mesh(devices, P):
+    """The kernelized zigzag schedule (VERDICT r4 #3/#4): every pair one
+    partials kernel call under the pair's traced offsets, hand-tiled
+    ring backward — must match dense attention and the XLA zigzag path
+    in BOTH directions."""
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.models import (
+        dense_attention, from_zigzag, ring_attention, to_zigzag)
+
+    topo = pa.Topology((P,), devices=devices[:P])
+    S, H, D = 16 * P, 2, 16
+    pen = pa.Pencil(topo, (S, H), (0,))
+    rng = np.random.default_rng(29)
+
+    def mk():
+        return pa.PencilArray.from_global(
+            pen, rng.standard_normal((S, H, D)).astype(np.float32),
+            extra_ndims=1)
+
+    q, k, v = mk(), mk(), mk()
+    qz, kz, vz = map(to_zigzag, (q, k, v))
+    with jax.default_matmul_precision("float32"):
+        ref = dense_attention(np.asarray(pa.gather(q)),
+                              np.asarray(pa.gather(k)),
+                              np.asarray(pa.gather(v)), causal=True)
+        out_p = from_zigzag(ring_attention(qz, kz, vz, causal=True,
+                                           zigzag=True, impl="pallas"))
+        out_x = from_zigzag(ring_attention(qz, kz, vz, causal=True,
+                                           zigzag=True, impl="xla"))
+    np.testing.assert_allclose(np.asarray(pa.gather(out_p)),
+                               np.asarray(ref), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pa.gather(out_p)),
+                               np.asarray(pa.gather(out_x)),
+                               atol=1e-5, rtol=1e-5)
+
+    def loss(dq, dk, dv, impl):
+        o = ring_attention(pa.PencilArray(pen, dq, (D,)),
+                           pa.PencilArray(pen, dk, (D,)),
+                           pa.PencilArray(pen, dv, (D,)),
+                           causal=True, zigzag=True, impl=impl)
+        return jnp.sum(o.data ** 2)
+
+    with jax.default_matmul_precision("float32"):
+        gp = jax.grad(loss, argnums=(0, 1, 2))(qz.data, kz.data, vz.data,
+                                               "pallas")
+        gx = jax.grad(loss, argnums=(0, 1, 2))(qz.data, kz.data, vz.data,
+                                               "xla")
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.slow  # interpret-mode kernels x ring rounds x full grad
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_pallas_bwd_kernels_full_grad(devices, causal):
+    """The hand-tiled ring backward (global-logsumexp recompute with the
+    rotating dk/dv accumulator) must match the XLA ring's gradient for
+    ALL of q, k, v — not just q."""
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.models import ring_attention
+
+    P = 4
+    topo = pa.Topology((P,), devices=devices[:P])
+    S, H, D = 32, 2, 16
+    pen = pa.Pencil(topo, (S, H), (0,))
+    rng = np.random.default_rng(43)
+
+    def mk():
+        return pa.PencilArray.from_global(
+            pen, rng.standard_normal((S, H, D)).astype(np.float32),
+            extra_ndims=1)
+
+    q, k, v = mk(), mk(), mk()
+
+    def loss(dq, dk, dv, impl):
+        o = ring_attention(pa.PencilArray(pen, dq, (D,)),
+                           pa.PencilArray(pen, dk, (D,)),
+                           pa.PencilArray(pen, dv, (D,)),
+                           causal=causal, impl=impl)
+        return jnp.sum(o.data ** 2)
+
+    with jax.default_matmul_precision("float32"):
+        gp = jax.grad(loss, argnums=(0, 1, 2))(q.data, k.data, v.data,
+                                               "pallas")
+        gx = jax.grad(loss, argnums=(0, 1, 2))(q.data, k.data, v.data,
+                                               "xla")
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
 
 
 def test_partials_merge_matches_full():

@@ -49,9 +49,12 @@ def _launch_workers(worker, nprocs, extra_args, sentinel, label):
         for p in procs:
             p.kill()
         # drain what the (now dead) workers managed to print — the
-        # evidence trail for diagnosing the hang
-        drained = []
-        for p in procs:
+        # evidence trail for diagnosing the hang.  Workers that already
+        # completed keep their captured output (communicate() must not
+        # be re-called on them: a second call fails and would replace
+        # the evidence with an empty string)
+        drained = list(outs)
+        for p in procs[len(outs):]:
             try:
                 out, _ = p.communicate(timeout=10)
             except Exception:
