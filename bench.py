@@ -405,16 +405,22 @@ def bench_flash_attention(jax, jnp, np, pa, timeit):
         "timing_spread": spread,
         "timing_spread_raw": _spread(),
     }
-    t_pg = timeit(grad_of("pallas"), q, k0=1, k1=5)
-    sp_g = _spread()
-    t_xg = timeit(grad_of("xla"), q, k0=1, k1=5)
-    out.update({
-        "fwd_bwd_pallas_tflops": round(3.5 * flops / t_pg / 1e12, 2),
-        "fwd_bwd_xla_tflops": round(3.5 * flops / t_xg / 1e12, 2),
-        "ratio_fwd_bwd_vs_xla": round(t_xg / t_pg, 3),
-        "timing_spread_grad": sp_g,
-        "timing_spread_grad_raw": _spread(),
-    })
+    try:
+        # guarded separately: the hand backward kernels' (1, bq, 1)
+        # row-residual BlockSpecs are the least-proven Mosaic surface;
+        # if they fail to lower, the forward numbers must survive
+        t_pg = timeit(grad_of("pallas"), q, k0=1, k1=5)
+        sp_g = _spread()
+        t_xg = timeit(grad_of("xla"), q, k0=1, k1=5)
+        out.update({
+            "fwd_bwd_pallas_tflops": round(3.5 * flops / t_pg / 1e12, 2),
+            "fwd_bwd_xla_tflops": round(3.5 * flops / t_xg / 1e12, 2),
+            "ratio_fwd_bwd_vs_xla": round(t_xg / t_pg, 3),
+            "timing_spread_grad": sp_g,
+            "timing_spread_grad_raw": _spread(),
+        })
+    except Exception as e:
+        out["fwd_bwd_error"] = f"{type(e).__name__}: {e}"[:500]
     return out
 
 

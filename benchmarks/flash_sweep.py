@@ -82,25 +82,34 @@ def main():
                     q_, k, v, impl=impl) ** 2))(d_)
             return f
 
-        t_p = device_seconds_per_iter(pall, q, k0=1, k1=7)
-        sp_p = last_spread()["k1_worst_over_best"]
-        t_x = device_seconds_per_iter(xla, q, k0=1, k1=7)
-        sp_x = last_spread()["k1_worst_over_best"]
-        t_pg = device_seconds_per_iter(grad_of("pallas"), q, k0=1, k1=5)
-        sp_pg = last_spread()["k1_worst_over_best"]
-        t_xg = device_seconds_per_iter(grad_of("xla"), q, k0=1, k1=5)
-        sp_xg = last_spread()["k1_worst_over_best"]
-        point = {
-            "S": S, "H": H, "D": D,
-            "fwd": {"pallas_tflops": round(flops / t_p / 1e12, 2),
-                    "xla_tflops": round(flops / t_x / 1e12, 2),
-                    "ratio_vs_xla": round(t_x / t_p, 3),
-                    "spread_pallas": sp_p, "spread_xla": sp_x},
-            "fwd_bwd": {"pallas_tflops": round(3.5 * flops / t_pg / 1e12, 2),
-                        "xla_tflops": round(3.5 * flops / t_xg / 1e12, 2),
-                        "ratio_vs_xla": round(t_xg / t_pg, 3),
-                        "spread_pallas": sp_pg, "spread_xla": sp_xg},
-        }
+        point = {"S": S, "H": H, "D": D}
+        try:
+            t_p = device_seconds_per_iter(pall, q, k0=1, k1=7)
+            sp_p = last_spread()["k1_worst_over_best"]
+            t_x = device_seconds_per_iter(xla, q, k0=1, k1=7)
+            sp_x = last_spread()["k1_worst_over_best"]
+            point["fwd"] = {
+                "pallas_tflops": round(flops / t_p / 1e12, 2),
+                "xla_tflops": round(flops / t_x / 1e12, 2),
+                "ratio_vs_xla": round(t_x / t_p, 3),
+                "spread_pallas": sp_p, "spread_xla": sp_x}
+        except Exception as e:  # a failed point must not void the sweep
+            point["fwd_error"] = f"{type(e).__name__}: {e}"[:500]
+        try:
+            t_pg = device_seconds_per_iter(grad_of("pallas"), q,
+                                           k0=1, k1=5)
+            sp_pg = last_spread()["k1_worst_over_best"]
+            t_xg = device_seconds_per_iter(grad_of("xla"), q, k0=1, k1=5)
+            sp_xg = last_spread()["k1_worst_over_best"]
+            point["fwd_bwd"] = {
+                "pallas_tflops": round(3.5 * flops / t_pg / 1e12, 2),
+                "xla_tflops": round(3.5 * flops / t_xg / 1e12, 2),
+                "ratio_vs_xla": round(t_xg / t_pg, 3),
+                "spread_pallas": sp_pg, "spread_xla": sp_xg}
+        except Exception as e:
+            # the hand backward's (1, bq, 1) row-residual BlockSpecs
+            # are the least-proven Mosaic surface — keep fwd evidence
+            point["fwd_bwd_error"] = f"{type(e).__name__}: {e}"[:500]
         results["points"].append(point)
         print(json.dumps(point), flush=True)
 
@@ -150,13 +159,17 @@ def main():
         results["ring_fwd_bwd"] = {"error": f"{type(e).__name__}: {e}"}
         print(json.dumps(results["ring_fwd_bwd"]), flush=True)
 
-    wins = [p for p in results["points"] if "fwd" in p]
-    if wins:
+    fwd_pts = [p for p in results["points"] if "fwd" in p]
+    bwd_pts = [p for p in results["points"] if "fwd_bwd" in p]
+    if fwd_pts or bwd_pts:
         results["verdict"] = {
-            "fwd_all_win": all(p["fwd"]["ratio_vs_xla"] > 1.0 for p in wins),
-            "fwd_bwd_all_win": all(p["fwd_bwd"]["ratio_vs_xla"] > 1.0
-                                   for p in wins),
+            "fwd_all_win": bool(fwd_pts) and all(
+                p["fwd"]["ratio_vs_xla"] > 1.0 for p in fwd_pts),
+            "fwd_bwd_all_win": bool(bwd_pts) and all(
+                p["fwd_bwd"]["ratio_vs_xla"] > 1.0 for p in bwd_pts),
+            "fwd_points": len(fwd_pts), "fwd_bwd_points": len(bwd_pts),
         }
+    wins = fwd_pts
     with open(os.path.join(_REPO, "PALLAS_FLASH_SWEEP.json"), "w") as f:
         json.dump(results, f, indent=1)
     print("PALLAS_FLASH_SWEEP " + json.dumps(results["verdict"]

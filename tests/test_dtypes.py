@@ -152,3 +152,16 @@ def test_f32_ns_model_never_promotes_under_x64(topo):
         lambda d: model.step(
             PencilArray(uh.pencil, d, (3,)), 1e-3).data, uh.data)
     assert not bad, f"NS step promotes to {bad}"
+
+
+def test_from_global_downcast_warns(topo):
+    """The deliberate dtype-downcast warning (``from_global`` storing a
+    narrower dtype than the input) must actually fire — it is on the
+    suite-wide ignore list (pyproject ``filterwarnings``), so this
+    dedicated assertion is what keeps it from silently disappearing."""
+    pen = Pencil(topo, (8, 8), (0, 1))
+    # the suite runs with x64 enabled, so downcasting must be provoked
+    # by temporarily disabling it: the f64 input is then stored f32
+    with jax.enable_x64(False), pytest.warns(UserWarning,
+                                             match="stored as"):
+        PencilArray.from_global(pen, np.zeros((8, 8), np.float64))
