@@ -192,6 +192,39 @@ def flash_attention(q, k, v, *, causal: bool = False, chunk: int = None,
                       q_offset=q_offset, kv_offset=kv_offset)
 
 
+@functools.lru_cache(maxsize=1)
+def _flash_sweep_verdict():
+    """Measured verdict from the real-chip sweep artifact
+    (``PALLAS_FLASH_SWEEP.json`` at the repo root, written by
+    ``benchmarks/flash_sweep.py``) — the same discipline as the permute
+    kernel (``ops/pallas_kernels.py``): a hand kernel's default routing
+    must be justified by a number, not a claim.  Returns the
+    ``verdict`` dict, or ``None`` when no measurement exists yet (the
+    kernel's tiling argument then carries the default)."""
+    import json
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "PALLAS_FLASH_SWEEP.json")
+    try:
+        with open(path) as f:
+            return json.load(f).get("verdict")
+    except (OSError, ValueError):
+        return None
+
+
+def _auto_pallas_allowed() -> bool:
+    """The ``impl='auto'`` default: off when the env knob says so, off
+    when a real-chip sweep MEASURED the kernel losing to the XLA scan
+    (``impl='pallas'`` still forces it for experiments)."""
+    env = os.environ.get("PENCILARRAYS_TPU_PALLAS_ATTENTION", "1")
+    if env == "0":
+        return False
+    verdict = _flash_sweep_verdict()
+    if verdict is not None and verdict.get("fwd_all_win") is False:
+        return False
+    return True
+
+
 def _use_pallas_flash(q, k, v, q_offset, kv_offset, *, force: bool) -> bool:
     from ..ops import flash_pallas
 
@@ -210,7 +243,7 @@ def _use_pallas_flash(q, k, v, q_offset, kv_offset, *, force: bool) -> bool:
                 "impl='pallas' but flash_pallas.supported() rejects this "
                 "case (traced offsets, unsupported dtype, or tiny shape)")
         return True
-    if os.environ.get("PENCILARRAYS_TPU_PALLAS_ATTENTION", "1") == "0":
+    if not _auto_pallas_allowed():
         return False
     return ok and jax.default_backend() == "tpu"
 
@@ -689,7 +722,7 @@ def _ring_use_pallas(q, k, v, s_local, d, *, force: bool) -> bool:
                 "impl='pallas' but flash_pallas.supported() rejects the "
                 "ring local block (unsupported dtype or tiny shape)")
         return True
-    if os.environ.get("PENCILARRAYS_TPU_PALLAS_ATTENTION", "1") == "0":
+    if not _auto_pallas_allowed():
         return False
     return ok and jax.default_backend() == "tpu"
 

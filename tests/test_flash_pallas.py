@@ -481,3 +481,23 @@ def test_jit_and_shapes_preserved():
                                                    impl="pallas"))
     out = f(q, k, v)
     assert out.shape == q.shape and out.dtype == q.dtype
+
+
+def test_auto_routing_consults_measured_verdict(monkeypatch):
+    """impl='auto' must be gated by the real-chip sweep verdict when one
+    exists (the permute-kernel measured-verdict discipline): a measured
+    loss turns the default off; no measurement keeps the tiling-argument
+    default; the env knob always wins."""
+    from pencilarrays_tpu.models import attention as attn
+
+    monkeypatch.delenv("PENCILARRAYS_TPU_PALLAS_ATTENTION", raising=False)
+    monkeypatch.setattr(attn, "_flash_sweep_verdict",
+                        lambda: {"fwd_all_win": False})
+    assert not attn._auto_pallas_allowed()
+    monkeypatch.setattr(attn, "_flash_sweep_verdict",
+                        lambda: {"fwd_all_win": True})
+    assert attn._auto_pallas_allowed()
+    monkeypatch.setattr(attn, "_flash_sweep_verdict", lambda: None)
+    assert attn._auto_pallas_allowed()
+    monkeypatch.setenv("PENCILARRAYS_TPU_PALLAS_ATTENTION", "0")
+    assert not attn._auto_pallas_allowed()
