@@ -214,3 +214,36 @@ def test_collective_stats_parser(topo):
     s = collective_stats(synth)
     assert s["all-gather"]["count"] == 1  # -start counted, -done not
     assert "all-to-all" not in s  # name references don't count
+
+
+@pytest.mark.slow  # interpret-mode pallas kernels compile slowly on CPU
+def test_ring_pallas_fwd_bwd_collective_budget(devices):
+    """The hand-kernel ring's wire budget: forward = P-1 k/v rotations;
+    backward = its recompute ring (the rotating k/v AND the rotating
+    dk/dv accumulator, P shifts each to complete the cycle home).  Any
+    extra collective is a resharding bug."""
+    from pencilarrays_tpu.models import ring_attention
+
+    P = 4
+    topo_seq = Topology((P,), devices=devices[:P])
+    S, H, D = 32, 2, 16
+    pen = Pencil(topo_seq, (S, H), (0,))
+    q = PencilArray.zeros(pen, (D,), jnp.float32)
+
+    def grad_fn(d):
+        def loss(d_):
+            o = ring_attention(PencilArray(pen, d_, (D,)),
+                               PencilArray(pen, d_ + 1.0, (D,)),
+                               PencilArray(pen, d_ * 2.0, (D,)),
+                               causal=True, impl="pallas")
+            return jnp.sum(o.data ** 2)
+        return jax.grad(loss)(d)
+
+    c = count_collectives(hlo_of(grad_fn, q.data))
+    # Naively: P-1 fwd rotations + P bwd kv re-rotations + P dkv
+    # rotations.  The compiled artifact is tighter: the bwd's kv
+    # re-rotation chain is IDENTICAL to the fwd's, so XLA CSEs it away
+    # entirely (and DCEs the last unused kv shift) — what ships is
+    # (P-1) shared kv rotations + P dkv rotations = 2P-1.
+    assert c["collective-permute"] == 2 * P - 1, c
+    assert c["all-to-all"] == 0 and c["all-gather"] == 0, c
