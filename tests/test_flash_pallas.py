@@ -501,3 +501,43 @@ def test_auto_routing_consults_measured_verdict(monkeypatch):
     assert attn._auto_pallas_allowed()
     monkeypatch.setenv("PENCILARRAYS_TPU_PALLAS_ATTENTION", "0")
     assert not attn._auto_pallas_allowed()
+
+
+@pytest.mark.slow  # interpret-mode kernels x ring rounds, bf16
+def test_ring_pallas_bf16_on_mesh(devices):
+    """bf16 q/k/v through the kernelized ring: f32 statistics inside the
+    kernels, bf16 on the wire and in the gradients."""
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.models import dense_attention, ring_attention
+
+    P = 2
+    topo = pa.Topology((P,), devices=devices[:P])
+    S, H, D = 16, 2, 16
+    pen = pa.Pencil(topo, (S, H), (0,))
+    rng = np.random.default_rng(51)
+
+    def mk():
+        u = pa.PencilArray.from_global(
+            pen, rng.standard_normal((S, H, D)).astype(np.float32),
+            extra_ndims=1)
+        return pa.PencilArray(pen, u.data.astype(jnp.bfloat16), (D,))
+
+    q, k, v = mk(), mk(), mk()
+    ref = dense_attention(np.asarray(pa.gather(q), np.float32),
+                          np.asarray(pa.gather(k), np.float32),
+                          np.asarray(pa.gather(v), np.float32),
+                          causal=True)
+    out = ring_attention(q, k, v, causal=True, impl="pallas")
+    assert out.data.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(pa.gather(out), np.float32), np.asarray(ref),
+        atol=4e-2, rtol=4e-2)
+
+    def loss(d):
+        o = ring_attention(pa.PencilArray(pen, d, (D,)), k, v,
+                           causal=True, impl="pallas")
+        return jnp.sum(o.data.astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss)(q.data)
+    assert g.dtype == jnp.bfloat16
+    assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
