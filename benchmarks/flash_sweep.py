@@ -26,9 +26,10 @@ import threading
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# (S, H, D) points: the bench headline, a long-sequence case, and a
-# smaller many-heads case
-POINTS = [(2048, 8, 128), (4096, 8, 128), (8192, 4, 64)]
+# (S, H, D, dtype) points: the bench headline, a long-sequence case, a
+# smaller many-heads case, and the MXU-native bf16 headline
+POINTS = [(2048, 8, 128, "float32"), (4096, 8, 128, "float32"),
+          (8192, 4, 64, "float32"), (4096, 8, 128, "bfloat16")]
 
 
 def main():
@@ -58,13 +59,15 @@ def main():
     kind = jax.devices()[0].device_kind
 
     results = {"device_kind": kind, "points": []}
-    for S, H, D in POINTS:
-        if not supported(S, S, D, jnp.float32, platform="tpu"):
+    for S, H, D, dtname in POINTS:
+        dt = jnp.dtype(dtname)
+        if not supported(S, S, D, dt, platform="tpu"):
             results["points"].append(
-                {"S": S, "H": H, "D": D, "skipped": "unsupported"})
+                {"S": S, "H": H, "D": D, "dtype": dtname,
+                 "skipped": "unsupported"})
             continue
-        mk = jax.jit(lambda key, s=S, h=H, d=D: jax.random.normal(
-            key, (s, h, d), jnp.float32))
+        mk = jax.jit(lambda key, s=S, h=H, d=D, t=dt: jax.random.normal(
+            key, (s, h, d), jnp.float32).astype(t))
         kq, kk, kv = jax.random.split(jax.random.key(0), 3)
         q, k, v = mk(kq), mk(kk), mk(kv)
         flops = 4 * S * S * H * D
@@ -82,7 +85,7 @@ def main():
                     q_, k, v, impl=impl) ** 2))(d_)
             return f
 
-        point = {"S": S, "H": H, "D": D}
+        point = {"S": S, "H": H, "D": D, "dtype": dtname}
         try:
             t_p = device_seconds_per_iter(pall, q, k0=1, k1=7)
             sp_p = last_spread()["k1_worst_over_best"]
