@@ -265,6 +265,15 @@ def _flash_pallas_fwd(q, k, v, causal, q_offset, kv_offset):
     return out, (q, k, v, out, m, l)
 
 
+def _hand_bwd_enabled() -> bool:
+    """``PENCILARRAYS_TPU_FLASH_BWD=xla`` keeps the Pallas FORWARD but
+    routes every flash backward through the XLA recompute — the
+    one-flag escape hatch if the hand backward kernels misbehave on a
+    given chip/toolchain (their row-residual BlockSpecs are the
+    youngest Mosaic surface in the tree)."""
+    return os.environ.get("PENCILARRAYS_TPU_FLASH_BWD", "pallas") != "xla"
+
+
 def _flash_pallas_bwd(causal, q_offset, kv_offset, res, g):
     # flash backward = streaming recompute, as hand-tiled dq/dkv Pallas
     # kernels rebuilding each score block from the saved logsumexp (no
@@ -272,6 +281,12 @@ def _flash_pallas_bwd(causal, q_offset, kv_offset, res, g):
     from ..ops.flash_pallas import pallas_flash_attention_bwd
 
     q, k, v, out, m, l = res
+    if not _hand_bwd_enabled():
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _flash_xla(
+                q_, k_, v_, causal=causal, chunk=None,
+                q_offset=q_offset, kv_offset=kv_offset), q, k, v)
+        return vjp(g)
     return pallas_flash_attention_bwd(
         q, k, v, out, g, m, l, causal=causal,
         q_offset=q_offset, kv_offset=kv_offset)
@@ -350,6 +365,15 @@ def _ring_flash_pallas_bwd(axis, P, d, causal, res, g):
     from ..ops.flash_pallas import pallas_flash_attention_bwd_partials
 
     qb, kb, vb, out32, m, l = res
+    if not _hand_bwd_enabled():
+        # escape hatch: differentiate the XLA ring (collective adjoints
+        # included) instead of the hand kernels; folded 4-D operands
+        # make _fold_batch a no-op inside
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _ring_local_fn(
+                q_, k_, v_, axis=axis, P=P, d=d, causal=causal,
+                use_pallas=False), qb, kb, vb)
+        return vjp(g)
     s_blk = qb.shape[0]
     me = jax.lax.axis_index(axis)
     g32 = g.astype(jnp.float32)
@@ -469,6 +493,12 @@ def _zigzag_flash_pallas_bwd(axis, P, d, res, g):
     from ..ops.flash_pallas import pallas_flash_attention_bwd_partials
 
     qb, kb, vb, out32, m_lo, l_lo, m_hi, l_hi = res
+    if not _hand_bwd_enabled():
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _zigzag_local_fn(
+                q_, k_, v_, axis=axis, P=P, d=d, causal=True,
+                use_pallas=False), qb, kb, vb)
+        return vjp(g)
     b = qb.shape[0] // 2
     me = jax.lax.axis_index(axis)
     g32 = g.astype(jnp.float32)

@@ -541,3 +541,58 @@ def test_ring_pallas_bf16_on_mesh(devices):
     g = jax.grad(loss)(q.data)
     assert g.dtype == jnp.bfloat16
     assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
+def test_flash_bwd_escape_hatch(monkeypatch):
+    """PENCILARRAYS_TPU_FLASH_BWD=xla must keep the Pallas forward but
+    produce the XLA-recompute gradient (identical to the full XLA
+    path) — the one-flag fallback if the hand backward misbehaves on
+    some chip."""
+    rng = np.random.default_rng(61)
+    q, k, v = _qkv(rng, 48, 48, 2, 1, 16)
+    ct = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+
+    def loss(impl):
+        def f(q_, k_, v_):
+            return jnp.sum(flash_attention(q_, k_, v_, causal=True,
+                                           impl=impl) * ct)
+        return f
+
+    monkeypatch.setenv("PENCILARRAYS_TPU_FLASH_BWD", "xla")
+    with jax.default_matmul_precision("float32"):
+        gp = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(loss("xla"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.slow  # interpret-mode ring rounds x grad, twice
+def test_ring_bwd_escape_hatch_on_mesh(devices, monkeypatch):
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.models import ring_attention
+
+    P = 2
+    topo = pa.Topology((P,), devices=devices[:P])
+    S, H, D = 16, 2, 16
+    pen = pa.Pencil(topo, (S, H), (0,))
+    rng = np.random.default_rng(67)
+
+    def mk():
+        return pa.PencilArray.from_global(
+            pen, rng.standard_normal((S, H, D)).astype(np.float32),
+            extra_ndims=1)
+
+    q, k, v = mk(), mk(), mk()
+
+    def loss(d, impl):
+        o = ring_attention(pa.PencilArray(pen, d, (D,)), k, v,
+                           causal=True, impl=impl)
+        return jnp.sum(o.data ** 2)
+
+    monkeypatch.setenv("PENCILARRAYS_TPU_FLASH_BWD", "xla")
+    with jax.default_matmul_precision("float32"):
+        gp = jax.grad(lambda d: loss(d, "pallas"))(q.data)
+        gx = jax.grad(lambda d: loss(d, "xla"))(q.data)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                               atol=1e-5, rtol=1e-5)
