@@ -1,0 +1,90 @@
+"""Long-context TRAINING on the pencil mesh — runnable demo.
+
+Run on the virtual CPU mesh::
+
+    python examples/long_context_training.py
+
+One attention block trained end-to-end with sequence parallelism: the
+activations live sequence-decomposed in ZIGZAG placement (the
+steady-state layout for causal ring attention — convert once at the
+boundary, never per step), the forward runs the balanced zigzag ring
+schedule (~half the naive causal FLOPs), and `jax.grad` routes the loss
+cotangent back through the ring's collectives to REPLICATED projection
+weights — the tensor-parallel-free data path of ring-attention training
+(cf. reference `test/arrays.jl` for the array-API surface; the
+distributed-training analog has no reference counterpart).
+
+On a real pod the same code runs with `impl="auto"` selecting the
+hand-tiled Pallas kernels for forward AND backward
+(`docs/SequenceParallel.md`).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("PENCIL_EXAMPLE_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu.models import ring_attention, to_zigzag
+
+P = min(8, len(jax.devices()))
+S, H, D = 16 * P, 4, 16  # sequence divisible by 2P (zigzag blocks)
+
+topo = pa.Topology((P,), devices=jax.devices()[:P])
+pen = pa.Pencil(topo, (S, H), (0,))
+
+rng = np.random.default_rng(0)
+x = to_zigzag(pa.PencilArray.from_global(
+    pen, rng.standard_normal((S, H, D)).astype(np.float32),
+    extra_ndims=1))
+target = to_zigzag(pa.PencilArray.from_global(
+    pen, rng.standard_normal((S, H, D)).astype(np.float32),
+    extra_ndims=1))
+
+# replicated projection weights (per-head feature mixing; batch-free for
+# clarity — extra_dims carry D)
+params = {
+    name: jnp.asarray(rng.standard_normal((D, D)) / np.sqrt(D),
+                      jnp.float32)
+    for name in ("wq", "wk", "wv", "wo")
+}
+
+
+def block(params, xd):
+    """One causal attention block on raw sharded data (zigzag layout).
+    Projections are local einsums on the feature dim — no collectives;
+    the only communication is the ring's k/v rotation."""
+    proj = lambda w: pa.PencilArray(pen, xd @ w, (D,))
+    out = ring_attention(proj(params["wq"]), proj(params["wk"]),
+                         proj(params["wv"]), causal=True, zigzag=True)
+    return out.data @ params["wo"]
+
+
+def loss_fn(params, xd, td):
+    return jnp.mean((block(params, xd) - td) ** 2)
+
+
+@jax.jit
+def train_step(params, xd, td):
+    loss, grads = jax.value_and_grad(loss_fn)(params, xd, td)
+    return loss, jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+
+
+losses = []
+for step in range(5):
+    loss, params = train_step(params, x.data, target.data)
+    losses.append(float(loss))
+    print(f"step {step}: loss {losses[-1]:.6f}")
+
+assert losses[-1] < losses[0], "training must reduce the loss"
+print(f"zigzag ring-attention training over {P} devices: "
+      f"loss {losses[0]:.4f} -> {losses[-1]:.4f}")
