@@ -359,7 +359,8 @@ def test_ring_zigzag_pallas_force_rejects_tiny_head_dim(devices):
 
 
 @pytest.mark.slow  # interpret-mode kernels x zigzag pairs x grad
-@pytest.mark.parametrize("P", [2, 4])
+@pytest.mark.parametrize("P", [2, 3, 4])  # incl. odd P: the past/future
+# split is asymmetric there (verified ad hoc round 5, pinned here)
 def test_zigzag_pallas_impl_on_mesh(devices, P):
     """The kernelized zigzag schedule (VERDICT r4 #3/#4): every pair one
     partials kernel call under the pair's traced offsets, hand-tiled
