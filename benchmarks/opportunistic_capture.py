@@ -111,6 +111,24 @@ def run_sweep() -> bool:
     return ok
 
 
+def run_details() -> bool:
+    """Refresh BENCH_DETAILS.json (benchmarks/suite.py) — the builder's
+    extended numbers, stale since round 2.  Best-effort third artifact:
+    only attempted after bench + sweep are in."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "benchmarks/suite.py"], cwd=_REPO,
+            capture_output=True, text=True,
+            timeout=float(os.environ.get("PA_CAP_DETAILS_TMO", "1500")))
+        ok = r.returncode == 0
+        tail = r.stdout.strip().splitlines()[-2:]
+    except subprocess.TimeoutExpired:
+        ok, tail = False, ["suite killed at timeout"]
+    print(f"[{_utcnow()}] details ok={ok} " + " | ".join(tail),
+          flush=True)
+    return ok
+
+
 def main():
     cycle_s = float(os.environ.get("PA_CAP_CYCLE", "300"))
     probe_tmo = float(os.environ.get("PA_CAP_PROBE_TMO", "150"))
@@ -118,14 +136,17 @@ def main():
     sweep_done = os.path.exists(
         os.path.join(_REPO, "PALLAS_FLASH_SWEEP.json"))
     attempt = 0
-    while not (bench_done and sweep_done):
+    details_done = False
+    while not (bench_done and sweep_done and details_done):
         attempt += 1
         if probe(probe_tmo):
             if not bench_done:
                 bench_done = run_bench(attempt)
             if not sweep_done:
                 sweep_done = run_sweep()
-        if not (bench_done and sweep_done):
+            if bench_done and sweep_done and not details_done:
+                details_done = run_details()
+        if not (bench_done and sweep_done and details_done):
             time.sleep(cycle_s)
     print(f"[{_utcnow()}] capture complete", flush=True)
 
