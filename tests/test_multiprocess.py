@@ -43,7 +43,11 @@ def _launch_workers(worker, nprocs, extra_args, sentinel, label):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            # generous: N processes share ONE core on this image, and
+            # unrelated background load (e.g. the round-5 TPU-capture
+            # probe loop) can halve the effective core for minutes —
+            # 240 s proved flaky under that contention
+            out, _ = p.communicate(timeout=480)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
