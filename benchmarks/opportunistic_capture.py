@@ -56,7 +56,13 @@ def probe(timeout_s: float) -> bool:
 
 def run_bench(attempt: int) -> bool:
     """Run bench.py, stream+save all JSON lines; True iff summary has a
-    numeric value."""
+    numeric value.
+
+    Evidence is APPEND-ONLY (the module contract): the artifact's
+    top-level fields always describe the latest attempt, and every
+    earlier attempt's full doc is preserved under ``prior_attempts`` —
+    a later wedged attempt can never erase an earlier attempt's richer
+    partial-line evidence."""
     out_path = os.path.join(_REPO, "BENCH_SELF_r05.json")
     t0 = time.time()
     try:
@@ -81,10 +87,22 @@ def run_bench(attempt: int) -> bool:
            "ok": ok, "seconds": round(time.time() - t0, 1),
            "lines": [json.loads(ln) for ln in lines
                      if _loads_ok(ln)]}
+    prior = []
+    try:
+        with open(out_path) as f:
+            old = json.load(f)
+        # hoist the previous doc's own history, then the doc itself
+        prior = list(old.pop("prior_attempts", []))
+        prior.append(old)
+    except (OSError, ValueError):
+        pass
+    if prior:
+        doc["prior_attempts"] = prior
     with open(out_path, "w") as f:
         json.dump(doc, f, indent=1)
     print(f"[{_utcnow()}] bench rc={rc} ok={ok} "
-          f"({len(lines)} lines)", flush=True)
+          f"({len(lines)} lines, {len(prior)} prior attempts kept)",
+          flush=True)
     return ok
 
 

@@ -100,6 +100,10 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--devices", type=int, default=1)
     parser.add_argument("--out", default="BENCH_DETAILS.json")
+    parser.add_argument("--pipeline-sweep", action="store_true",
+                        help="also run the pipelined-hop sweep "
+                             "(benchmarks/pipeline_sweep.py; needs >= 2 "
+                             "devices, adds several compiles)")
     args = parser.parse_args()
 
     import jax
@@ -216,6 +220,16 @@ def main():
             "xla_gb_per_s": nb / t_xla / 1e9,
             "speedup": t_xla / t_pal,
         }
+
+    # -- 6. pipelined-hop sweep (opt-in: serialized vs fused K) -----------
+    # Registered here but OFF by default (and slow-marked on the pytest
+    # side) so tier-1 and the default suite stay fast; full artifact via
+    # ``python benchmarks/pipeline_sweep.py``.
+    if args.pipeline_sweep and len(devs) > 1:
+        from benchmarks.pipeline_sweep import measure_roundtrips
+
+        points, verdict = measure_roundtrips(topo, (n, n, n), k1=12)
+        results["pipeline_sweep"] = {"points": points, "verdict": verdict}
 
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)

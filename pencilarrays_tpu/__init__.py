@@ -36,6 +36,7 @@ from .parallel import (  # noqa: F401
     AllToAll,
     Alltoallv,
     Auto,
+    Pipelined,
     PointToPoint,
     resolve_method,
     Ring,

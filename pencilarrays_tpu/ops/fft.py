@@ -22,6 +22,15 @@ This module is that layer rebuilt TPU-first:
 * between stages, the transpose engine's ``all_to_all`` exchanges ride
   ICI (``parallel/transpositions.py``); local transforms run under
   ``shard_map`` so GSPMD can never insert a hidden all-gather;
+* **pipelined hops** (``pipeline=K | "auto"``): each eligible
+  transpose+transform pair fuses into ONE program whose exchange is
+  split into K statically-shaped chunks along a dim neither the
+  exchange nor the stage's transforms touch — chunk ``k``'s collective
+  has no data dependency on chunk ``k-1``'s FFT, so the latency-hiding
+  scheduler overlaps wire time with compute (:func:`_fused_hop_fn`;
+  the reference's ``waitall=false``/``Waitany`` pipeline and the
+  overlapped redistribution of arXiv:1804.09536 / AccFFT, re-expressed
+  for XLA).  K=1 is exactly the serialized schedule;
 * with ``permute=True`` (default, like PencilFFTs' ``permute_dims``)
   each stage's pencil permutation places the stage's transform dim
   *last in memory*, where the FFT is contiguous;
@@ -47,10 +56,25 @@ from typing import List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..parallel.arrays import PencilArray
+from ..parallel.arrays import PencilArray, _fwd_axes, _inv_axes
 from ..parallel.pencil import LogicalOrder, MemoryOrder, Pencil
 from ..parallel.topology import Topology
-from ..parallel.transpositions import AllToAll, AbstractTransposeMethod, transpose
+from ..parallel.transpositions import (
+    AllToAll,
+    AbstractTransposeMethod,
+    Auto,
+    Pipelined,
+    Ring,
+    _chunk_bounds,
+    _exchange_factory,
+    _exchange_operand_extents,
+    _maybe_pallas_transpose,
+    _pipeline_chunk_axis,
+    assert_compatible,
+    resolve_method,
+    transpose,
+)
+from ..utils.jaxcompat import shard_map
 from ..utils.permutations import Permutation
 
 __all__ = ["PencilFFTPlan"]
@@ -84,19 +108,16 @@ def _idst(blk, axis):
     return out * _alt_signs(out, axis)
 
 
-@lru_cache(maxsize=512)
-def _stage_fn(pen: Pencil, extra_ndims: int, ops: tuple, inverse: bool,
-              pre_complex: bool, norm: str):
-    """Cached batched local-transform callable for one schedule step.
+def _stage_op(ops: tuple, inverse: bool, pre_complex: bool, norm: str):
+    """Pure per-block batched local-transform callable (no sharding
+    machinery) — the compute body of a schedule step, shared by
+    :func:`_stage_fn` (whole-block, own ``shard_map``) and
+    :func:`_fused_hop_fn` (applied per chunk inside the fused hop's
+    ``shard_map``, where it composes with the chunked exchange).
 
     ``ops`` is a tuple of ``(kind, mem_axis, n_logical)`` — every
     transform applied at this stage, all along axes that are local
-    (unsharded) in ``pen``.  Runs under ``shard_map`` so each device
-    transforms its own block with zero communication: without this,
-    GSPMD cannot partition the FFT op and inserts an all-gather of the
-    full array per stage (observed: 6 all-gathers in a 3-D forward
-    plan) — the multi-chip killer.  Caching lets eager (un-jitted)
-    plans reuse function objects and hit JAX's dispatch cache.
+    (unsharded) in the stage pencil.
     """
     from jax.scipy import fft as jsfft
 
@@ -153,6 +174,21 @@ def _stage_fn(pen: Pencil, extra_ndims: int, ops: tuple, inverse: bool,
                        else _idst(blk, ax))
             return blk
 
+    return op
+
+
+@lru_cache(maxsize=512)
+def _stage_fn(pen: Pencil, extra_ndims: int, ops: tuple, inverse: bool,
+              pre_complex: bool, norm: str):
+    """Cached batched local-transform callable for one schedule step
+    (:func:`_stage_op` body).  Runs under ``shard_map`` so each device
+    transforms its own block with zero communication: without this,
+    GSPMD cannot partition the FFT op and inserts an all-gather of the
+    full array per stage (observed: 6 all-gathers in a 3-D forward
+    plan) — the multi-chip killer.  Caching lets eager (un-jitted)
+    plans reuse function objects and hit JAX's dispatch cache.
+    """
+    op = _stage_op(ops, inverse, pre_complex, norm)
     if math.prod(pen.mesh.devices.shape) == 1:
         return op
     spec = pen.partition_spec(extra_ndims)
@@ -163,8 +199,159 @@ def _stage_fn(pen: Pencil, extra_ndims: int, ops: tuple, inverse: bool,
     # per-device data-parallel (in_specs == out_specs, no collectives),
     # so the check buys nothing here; differentiability is pinned by
     # tests/test_autodiff.py.
-    return jax.shard_map(op, mesh=pen.mesh, in_specs=spec, out_specs=spec,
+    return shard_map(op, mesh=pen.mesh, in_specs=spec, out_specs=spec,
                          check_vma=False)
+
+
+def _pipeline_sweep_verdict(platform: str = None):
+    """Measured verdict of the pipelined-hop sweep
+    (``PIPELINE_SWEEP.json`` at the repo root, written by
+    ``benchmarks/pipeline_sweep.py``; path override via
+    ``PENCILARRAYS_TPU_PIPELINE_SWEEP_PATH``, mtime-invalidated) — the
+    same routing discipline as the flash kernels: ``pipeline="auto"``
+    follows a measured ``best_k`` when one exists.  ``None`` when no
+    sweep has been captured yet, and ``None`` when the artifact was
+    captured on a DIFFERENT platform than ``platform`` (the plan's OWN
+    mesh platform, not the process default backend — a plan on the CPU
+    virtual mesh of a TPU host must follow CPU numbers and vice versa;
+    a CPU sweep measures chunking overhead, not overlap, and must not
+    route TPU plans).  The sweep records ``platform`` for exactly this
+    check."""
+    from ..utils.artifacts import load_verdict_artifact
+
+    doc = load_verdict_artifact("PIPELINE_SWEEP.json",
+                                "PENCILARRAYS_TPU_PIPELINE_SWEEP_PATH")
+    if not isinstance(doc, dict):
+        return None
+    captured = doc.get("platform")
+    if platform is None:
+        platform = jax.default_backend()
+    if captured is not None and captured != platform:
+        return None
+    return doc.get("verdict")
+
+
+# literature default for pipeline="auto" with no measured verdict: deep
+# enough to hide most wire time behind per-chunk transforms, shallow
+# enough that per-collective launch overhead stays amortized
+# (arXiv:1804.09536 tables 2-4 land at 2-8 pipeline stages)
+_PIPELINE_AUTO_DEFAULT_K = 4
+
+
+@lru_cache(maxsize=512)
+def _fused_hop_fn(src: Pencil, tgt: Pencil, post: Pencil,
+                  extra_ndims: int, ops: tuple, inverse: bool,
+                  pre_complex: bool, norm: str,
+                  base: AbstractTransposeMethod,
+                  chunk_dim: int, bounds: tuple, donate: bool = False,
+                  _pallas: bool = False):
+    """Compiled FUSED transpose+transform hop — the tentpole pipeline.
+
+    The serialized schedule runs hop ``src -> tgt`` as one monolithic
+    exchange, then the stage's batched 1-D transforms: a hard barrier
+    the latency-hiding scheduler cannot break (the collective is a
+    single op).  Here the hop is ONE ``shard_map`` program that chunks
+    the block along logical dim ``chunk_dim`` (untouched by both the
+    exchange pair and the stage's transform dims — precomputed at plan
+    time with static ``bounds``) and, per chunk, runs
+    exchange -> unpack -> transform.  Chunk ``k``'s exchange has NO data
+    dependency on chunk ``k-1``'s transform (pinned on the jaxpr by
+    ``tests/test_overlap.py``), so XLA's scheduler is free to hide each
+    chunk's wire time behind the previous chunk's VPU/MXU work — the
+    TPU re-expression of the reference's ``Isend``/``Waitany`` unpack
+    pipeline (``Transpositions.jl:142-158``) and of the overlapped
+    redistribution in arXiv:1804.09536 / AccFFT (arXiv:1506.07933).
+
+    ``inverse=True`` is the mirrored program for :meth:`backward`:
+    per chunk, inverse-transform -> pack -> reverse exchange — the
+    exchange of chunk ``k`` is independent of chunk ``k+1``'s inverse
+    transform, so the same overlap holds in the other direction.
+
+    Numerics: transforms act along whole, untouched axes, so chunking
+    commutes with them exactly; results match the serialized schedule
+    (bit-identical data movement, identical per-element transform).
+    """
+    R = assert_compatible(src, tgt)
+    axis = src.topology.axis_names[R]
+    P = src.topology.dims[R]
+    a = src.decomposition[R]  # decomposed in src, local in tgt
+    b = tgt.decomposition[R]  # local in src, decomposed in tgt
+    n_a = src.size_global()[a]
+    n_b = src.size_global()[b]
+    op = _stage_op(ops, inverse, pre_complex, norm)
+    mesh = src.mesh
+    # per-chunk unpack permute goes through the same opt-in Pallas tiled
+    # kernel as the serialized path's unpack (_exchange_transpose);
+    # _pallas rides the cache key only, so a toggled env flag cannot
+    # reuse a stale executable (the _compiled_transpose convention)
+    platform = mesh.devices.flat[0].platform
+
+    if not inverse:
+        b_pad = tgt.padded_global_shape[b]
+        inv_in = _inv_axes(src, extra_ndims)    # src memory -> logical
+        fwd_out = _fwd_axes(tgt, extra_ndims)   # logical -> tgt memory
+        exchange = _exchange_factory(base, src, tgt)(axis, P, a, b)
+        in_spec = src.partition_spec(extra_ndims)
+        out_spec = post.partition_spec(extra_ndims)
+        mem_c = fwd_out.index(chunk_dim)
+
+        def local_fn(block):
+            with jax.named_scope("pack_data"):
+                x = jnp.transpose(block, inv_in)
+                if b_pad != n_b:
+                    pad = [(0, 0)] * x.ndim
+                    pad[b] = (0, b_pad - n_b)
+                    x = jnp.pad(x, pad)
+            parts = []
+            for s0, s1 in bounds:
+                xc = jax.lax.slice_in_dim(x, s0, s1, axis=chunk_dim)
+                with jax.named_scope("exchange"):
+                    y = exchange(xc)
+                with jax.named_scope("unpack_data"):
+                    if y.shape[a] != n_a:
+                        y = jax.lax.slice_in_dim(y, 0, n_a, axis=a)
+                    y = _maybe_pallas_transpose(y, fwd_out, platform)
+                with jax.named_scope("stage_compute"):
+                    parts.append(op(y))
+            return jnp.concatenate(parts, axis=mem_c)
+    else:
+        a_pad = src.padded_global_shape[a]
+        inv_post = _inv_axes(tgt, extra_ndims)  # tgt memory -> logical
+        fwd_src = _fwd_axes(src, extra_ndims)   # logical -> src memory
+        # reverse hop tgt -> src: split dim a, concat dim b
+        exchange = _exchange_factory(base, tgt, src)(axis, P, b, a)
+        in_spec = post.partition_spec(extra_ndims)
+        out_spec = src.partition_spec(extra_ndims)
+        mem_c_in = _fwd_axes(post, extra_ndims).index(chunk_dim)
+        mem_c_out = fwd_src.index(chunk_dim)
+
+        def local_fn(block):
+            parts = []
+            for s0, s1 in bounds:
+                blk = jax.lax.slice_in_dim(block, s0, s1, axis=mem_c_in)
+                with jax.named_scope("stage_compute"):
+                    y = op(blk)
+                with jax.named_scope("pack_data"):
+                    y = jnp.transpose(y, inv_post)
+                    if a_pad != n_a:
+                        pad = [(0, 0)] * y.ndim
+                        pad[a] = (0, a_pad - n_a)
+                        y = jnp.pad(y, pad)
+                with jax.named_scope("exchange"):
+                    y = exchange(y)
+                with jax.named_scope("unpack_data"):
+                    if y.shape[b] != n_b:
+                        y = jax.lax.slice_in_dim(y, 0, n_b, axis=b)
+                    parts.append(
+                        _maybe_pallas_transpose(y, fwd_src, platform))
+            return jnp.concatenate(parts, axis=mem_c_out)
+
+    # check_vma=False for the same reason as _stage_fn: the FFT
+    # primitive's transpose rule rejects vma-tagged cotangents, and the
+    # fused hop must stay differentiable end to end.
+    fn = shard_map(local_fn, mesh=mesh, in_specs=in_spec,
+                   out_specs=out_spec, check_vma=False)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 def _stage_permutation(ndims: int, d: int, permute: bool):
@@ -283,13 +470,27 @@ class PencilFFTPlan:
     "forward" | "none"); ``"none"`` is PencilFFTs' unnormalized-BFFT
     convention with :meth:`scale_factor`.  R2R kinds are
     ortho-normalized in every mode.
+
+    ``pipeline`` selects hop pipelining: ``None``/``1`` keeps the
+    serialized hop-then-transform schedule; an int ``K > 1`` fuses each
+    eligible hop with its following transform stage into one program
+    interleaving a K-chunked exchange with per-chunk transforms (the
+    comm/compute overlap the monolithic exchange forbids — see
+    :func:`_fused_hop_fn`); ``"auto"`` follows the measured sweep
+    verdict (``PIPELINE_SWEEP.json``, ``benchmarks/pipeline_sweep.py``)
+    when one exists, else a literature default of
+    ``_PIPELINE_AUTO_DEFAULT_K``.  The chunk dim must be static: K is
+    clamped per hop by the chunkable dim's local extent, and hops with
+    nothing chunkable stay serialized.  Values and gradients are
+    unchanged for every K (test-pinned); only scheduling differs.
     """
 
     def __init__(self, topology: Topology, global_shape: Sequence[int], *,
                  real: bool = False, dtype=None, permute: bool = True,
                  transform="fft", transforms: Sequence[str] = None,
                  method: AbstractTransposeMethod = AllToAll(),
-                 normalization: str = "backward"):
+                 normalization: str = "backward",
+                 pipeline=None):
         global_shape = tuple(int(n) for n in global_shape)
         N = len(global_shape)
         M = topology.ndims
@@ -454,6 +655,36 @@ class PencilFFTPlan:
         self._steps = tuple(steps)
         self._output_pencil = cur
 
+        # -- pipelined hop fusion -----------------------------------------
+        # ``pipeline=K`` rewrites every eligible ("t", ...) + ("f", ...)
+        # pair into ONE fused ("ft", ...) step whose compiled program
+        # interleaves a K-chunked exchange with per-chunk stage compute
+        # (see _fused_hop_fn) — the overlap the serialized schedule's
+        # hard hop/transform barrier forbids.  K=1 (and None) keeps the
+        # serialized schedule unchanged; "auto" follows the measured
+        # sweep verdict (PIPELINE_SWEEP.json) when one exists, else the
+        # literature default of 4.
+        if pipeline is not None and pipeline != "auto" and (
+                not isinstance(pipeline, int) or pipeline < 1):
+            raise ValueError(
+                f"pipeline must be None, a positive int, or 'auto', got "
+                f"{pipeline!r}")
+        self.pipeline = pipeline
+        if pipeline == "auto":
+            verdict = _pipeline_sweep_verdict(
+                topology.mesh.devices.flat[0].platform)
+            try:
+                k_req = int(verdict["best_k"]) if verdict else None
+            except (TypeError, ValueError, KeyError):
+                k_req = None  # malformed artifact must never break plans
+            if k_req is None or k_req < 1:
+                k_req = _PIPELINE_AUTO_DEFAULT_K
+        else:
+            k_req = int(pipeline) if pipeline is not None else 1
+        self.pipeline_chunks = k_req
+        if k_req > 1:
+            self._steps = self._fuse_pipeline_steps(self._steps, k_req)
+
         # conceptual full chain (stage d pencil at its pre-stage shape),
         # for introspection/tests; the schedule above may visit fewer.
         self._pencils: List[Pencil] = []
@@ -464,6 +695,71 @@ class PencilFFTPlan:
                        permutation=cfgs[d][1]))
             if kinds[d] == "rfft":
                 sh[d] = sh[d] // 2 + 1
+
+    def _fuse_pipeline_steps(self, steps: tuple, K: int) -> tuple:
+        """Rewrite eligible hop+transform pairs into fused ``("ft", src,
+        tgt, hop_dtype, post, ops, pre_complex, base, chunk_dim,
+        bounds)`` steps.  A pair fuses when the hop is a real exchange
+        (not a local permute), its method resolves to an explicit
+        single-axis exchange (AllToAll/Ring — Gspmd hops stay
+        serialized: the partitioner owns their collectives), and a
+        chunkable logical dim exists that neither the exchange pair nor
+        the stage's transform dims touch.  Ineligible pairs keep the
+        serialized two-step schedule — ``pipeline=`` never changes what
+        is computed, only how it is scheduled."""
+        fused: List[tuple] = []
+        i = 0
+        while i < len(steps):
+            s = steps[i]
+            if (s[0] == "t" and i + 1 < len(steps)
+                    and steps[i + 1][0] == "f"
+                    and steps[i + 1][1] == s[2]):
+                step = self._try_fuse_hop(s, steps[i + 1], K)
+                if step is not None:
+                    fused.append(step)
+                    i += 2
+                    continue
+            fused.append(s)
+            i += 1
+        return tuple(fused)
+
+    def _try_fuse_hop(self, t_step: tuple, f_step: tuple, K: int):
+        _, src, tgt, hop_dtype = t_step
+        _, pre, post, ops, pre_complex = f_step
+        R = assert_compatible(src, tgt)
+        if R is None or src.topology.dims[R] == 1:
+            return None  # local permute: nothing on the wire to overlap
+        method = self.method
+        if isinstance(method, Auto) and method.mode == "measure":
+            # plan construction must stay cheap and deterministic: the
+            # fused base only needs a reasonable AllToAll/Ring pick, so
+            # decide it from the analytic model rather than running
+            # device benchmarks inside __init__ (measure-mode Auto
+            # still times the plan's serialized "t" hops lazily, at
+            # first transpose, as before)
+            method = Auto(mode="estimate",
+                          latency_bytes=method.latency_bytes)
+        base = resolve_method(src, tgt, (), hop_dtype, method)
+        if isinstance(base, Pipelined):
+            base = base.base  # the fused hop owns the chunking
+        if not isinstance(base, (AllToAll, Ring)):
+            return None  # Gspmd: collectives chosen by the partitioner
+        a = src.decomposition[R]
+        b = tgt.decomposition[R]
+        N = src.ndims
+        mem_ids = tgt.permutation.apply(tuple(range(N)))
+        transform_dims = tuple(mem_ids[ax] for _, ax, _ in ops)
+        # logical extents of the exchanged operand — the same shape the
+        # cost model prices (shared helper, so they cannot diverge)
+        ext = _exchange_operand_extents(src, tgt, R)
+        c = _pipeline_chunk_axis(ext, a, b, exclude=transform_dims)
+        if c is None:
+            return None
+        bounds = _chunk_bounds(ext[c], K)
+        if len(bounds) <= 1:
+            return None
+        return ("ft", src, tgt, hop_dtype, post, tuple(ops), pre_complex,
+                base, c, bounds)
 
     # -- pencils ----------------------------------------------------------
     @property
@@ -498,15 +794,30 @@ class PencilFFTPlan:
 
         method = method if method is not None else self.method
         total: dict = {}
-        for step in self._steps:
-            if step[0] != "t":
-                continue
-            _, src, dst, hop_dtype = step
+
+        def add(src, dst, hop_dtype, m, k_mult=1):
             for op, c in transpose_cost(src, dst, extra_dims, hop_dtype,
-                                        method).items():
+                                        m).items():
                 e = total.setdefault(op, {"count": 0, "bytes": 0})
-                e["count"] += c["count"]
+                # chunking multiplies launches, never bytes (ceil chunks
+                # partition the block exactly) — same rule as the
+                # Pipelined branch of transpose_cost
+                e["count"] += c["count"] * k_mult
                 e["bytes"] += c["bytes"]
+
+        for step in self._steps:
+            if step[0] == "t":
+                _, src, dst, hop_dtype = step
+                add(src, dst, hop_dtype, method)
+            elif step[0] == "ft":
+                (_, src, dst, hop_dtype, _post, _ops, _pc, base,
+                 _c, bounds) = step
+                m = base if method is self.method else method
+                if isinstance(m, Pipelined):
+                    # the fused hop owns the chunking (k_mult below) —
+                    # unwrap so the count is not multiplied twice
+                    m = m.base
+                add(src, dst, hop_dtype, m, k_mult=len(bounds))
         return total
 
     def allocate_input(self, extra_dims: Tuple[int, ...] = ()) -> PencilArray:
@@ -552,6 +863,20 @@ class PencilFFTPlan:
             if step[0] == "t":
                 x = transpose(x, step[2], method=self.method,
                               donate=self._hop_donate(x, owned))
+            elif step[0] == "ft":
+                # fused pipelined hop: chunked exchange interleaved with
+                # per-chunk stage compute in ONE program (_fused_hop_fn)
+                (_, src, tgt, hop_dtype, post, ops, pre_complex, base,
+                 chunk_dim, bounds) = step
+                from .pallas_kernels import pallas_enabled
+
+                data = _fused_hop_fn(src, tgt, post, nd_extra, ops,
+                                     False, pre_complex,
+                                     self.normalization, base,
+                                     chunk_dim, bounds,
+                                     self._hop_donate(x, owned),
+                                     pallas_enabled())(x.data)
+                x = PencilArray(post, data, x.extra_dims)
             else:
                 _, pre, post, ops, pre_complex = step
                 data = _stage_fn(pre, nd_extra, ops, False, pre_complex,
@@ -579,6 +904,20 @@ class PencilFFTPlan:
             if step[0] == "t":
                 x = transpose(x, step[1], method=self.method,
                               donate=self._hop_donate(x, owned))
+            elif step[0] == "ft":
+                # mirrored fused hop: per-chunk inverse transform, then
+                # the reverse exchange — same overlap, other direction
+                (_, src, tgt, hop_dtype, post, ops, pre_complex, base,
+                 chunk_dim, bounds) = step
+                from .pallas_kernels import pallas_enabled
+
+                data = _fused_hop_fn(src, tgt, post, nd_extra, ops,
+                                     True, pre_complex,
+                                     self.normalization, base,
+                                     chunk_dim, bounds,
+                                     self._hop_donate(x, owned),
+                                     pallas_enabled())(x.data)
+                x = PencilArray(src, data, x.extra_dims)
             else:
                 _, pre, post, ops, pre_complex = step
                 data = _stage_fn(post, nd_extra, ops, True, pre_complex,

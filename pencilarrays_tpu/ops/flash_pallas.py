@@ -186,6 +186,15 @@ def _ensure_pallas():
     return pl
 
 
+def _compiler_params(**kw):
+    """Mosaic compiler params across jax versions (TPUCompilerParams was
+    renamed CompilerParams)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
 def _pad_to(x: jax.Array, axis: int, target: int) -> jax.Array:
     pad = target - x.shape[axis]
     if pad == 0:
@@ -289,7 +298,7 @@ def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             pltpu.VMEM((bq, 128), jnp.float32),   # running denominator l
             pltpu.VMEM((bq, d), jnp.float32),     # numerator accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(offs, qf, kf, vf)
@@ -587,7 +596,7 @@ def _bwd_folded(qf, kf, vf, dof, Lrow, Drow, q_offset, kv_offset, *,
                   spec_row],
         out_specs=spec_q,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(offs, qf, kf, vf, dof, Lcol, Dcol)
@@ -609,7 +618,7 @@ def _bwd_folded(qf, kf, vf, dof, Lrow, Drow, q_offset, kv_offset, *,
         out_specs=[spec_kv_j, spec_kv_j],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(offs, qf, kf, vf, dof, Lcol, Dcol)

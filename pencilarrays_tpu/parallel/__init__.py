@@ -13,6 +13,7 @@ from .transpositions import (
     AllToAll,
     Alltoallv,
     Auto,
+    Pipelined,
     PointToPoint,
     Ring,
     Gspmd,
@@ -31,6 +32,7 @@ __all__ = [
     "ManyPencilArray",
     "Alltoallv",
     "Auto",
+    "Pipelined",
     "PointToPoint",
     "resolve_method",
     "Ring",

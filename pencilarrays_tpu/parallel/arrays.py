@@ -494,6 +494,12 @@ class PencilArray:
             np.any: reductions.any,
             np.count_nonzero: reductions.count_nonzero,
         }
+        if func is np.result_type:
+            # dtype-only query — older jax's dtypes.dtype() probes it
+            # before the __jax_array__ unwrap; answer from the dtypes
+            # without materializing anything
+            return np.result_type(*(a.dtype if isinstance(a, PencilArray)
+                                    else a for a in args))
         f = table.get(func)
         if (f is None or kwargs or len(args) != 1
                 or not isinstance(args[0], PencilArray)):

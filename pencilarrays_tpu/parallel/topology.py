@@ -28,7 +28,9 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
-from jax.sharding import AxisType, Mesh
+from jax.sharding import Mesh
+
+from ..utils.jaxcompat import AxisType
 
 __all__ = ["Topology", "dims_create", "default_axis_names"]
 
@@ -116,10 +118,15 @@ class Topology:
             raise ValueError(f"duplicate axis names: {axis_names}")
         dev_array = np.array(devices, dtype=object).reshape(dims)
         # Auto axis types: classic GSPMD partitioning — sharding decisions
-        # may be refined by the compiler outside shard_map regions.
-        self._mesh = Mesh(
-            dev_array, axis_names, axis_types=(AxisType.Auto,) * len(dims)
-        )
+        # may be refined by the compiler outside shard_map regions.  On
+        # pre-AxisType jax every mesh axis already behaves as Auto.
+        if AxisType is None:
+            self._mesh = Mesh(dev_array, axis_names)
+        else:
+            self._mesh = Mesh(
+                dev_array, axis_names,
+                axis_types=(AxisType.Auto,) * len(dims)
+            )
         self._dims = dims
         self._axis_names = axis_names
 
@@ -142,8 +149,8 @@ class Topology:
         ``Manual`` meshes reject the ``shard_map`` collectives the
         transpose engine issues (the failure would otherwise surface
         later as an opaque shard_map error)."""
-        bad = [str(t) for t in getattr(mesh, "axis_types", ())
-               if t != AxisType.Auto]
+        bad = ([str(t) for t in getattr(mesh, "axis_types", ())
+                if t != AxisType.Auto] if AxisType is not None else [])
         if bad:
             raise ValueError(
                 f"from_mesh requires Auto axis types, got {bad}; build the "

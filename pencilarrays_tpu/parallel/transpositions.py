@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..utils.jaxcompat import shard_map
 from ..utils.timers import timeit
 from .arrays import PencilArray, _fwd_axes, _inv_axes
 from .pencil import LogicalOrder, MemoryOrder, Pencil
@@ -77,6 +78,7 @@ __all__ = [
     "Alltoallv",
     "Auto",
     "Gspmd",
+    "Pipelined",
     "PointToPoint",
     "Ring",
     "Transposition",
@@ -123,6 +125,78 @@ Alltoallv = AllToAll
 
 
 @dataclass(frozen=True)
+class Pipelined(AbstractTransposeMethod):
+    """Chunked exchange: split the hop into ``chunks`` statically-shaped
+    pieces along a dimension the exchange never touches (any dim other
+    than the split/concat pair — including dims decomposed in BOTH
+    pencils, whose local tile rides along unchanged — or the extra
+    dims), and run one ``base``-method exchange per chunk.
+
+    This is the TPU re-expression of the reference's ``waitall=false`` +
+    ``Waitany`` unpack pipeline (``Transpositions.jl:142-158, 510-516``)
+    at the *data* level: a monolithic collective is an atomic unit the
+    latency-hiding scheduler can only overlap with OTHER work, but a
+    chunked exchange gives the scheduler K independent collective/compute
+    pairs — chunk ``k``'s wire time hides behind chunk ``k-1``'s compute
+    whenever a consumer (e.g. the next FFT stage,
+    ``PencilFFTPlan(pipeline=K)``) is fused per-chunk into the same
+    program (arXiv:1804.09536 §4; AccFFT's overlapped redistribution).
+
+    Standalone (no fused consumer) the chunks serialize on the one mesh
+    axis and ``Pipelined(K)`` simply costs K collective launches for the
+    same bytes — the win exists only inside a fused hop.  Data movement
+    is BIT-IDENTICAL to ``base`` for every K (chunking along an
+    untouched dim commutes with the exchange); ``chunks=1`` IS ``base``.
+
+    Static-shape constraint: chunk boundaries are fixed at trace time
+    (ceil-sized chunks, a short tail chunk when the extent does not
+    divide), and the chunk dim's local extent bounds the usable K.  When
+    no chunkable dim exists (e.g. a 2-D array whose both dims are the
+    exchange pair, with no extra dims) the method degenerates to
+    ``base`` unchunked.
+    """
+
+    chunks: int = 4
+    base: AbstractTransposeMethod = AllToAll()
+
+    def __post_init__(self):
+        if not isinstance(self.chunks, int) or self.chunks < 1:
+            raise ValueError(
+                f"Pipelined chunks must be a positive int, got "
+                f"{self.chunks!r}")
+        if not isinstance(self.base, (AllToAll, Ring)):
+            raise ValueError(
+                f"Pipelined base must be AllToAll() or Ring() (explicit "
+                f"single-axis exchanges), got {self.base!r}")
+
+
+def _chunk_bounds(n: int, K: int) -> Tuple[Tuple[int, int], ...]:
+    """Static chunk boundaries for extent ``n`` in <= K ceil-sized
+    pieces: ``((0, s), (s, 2s), ..., (., n))`` with ``s = ceil(n/K)``.
+    Every piece has a shape known at trace time (SPMD requirement)."""
+    K = max(1, min(int(K), int(n)))
+    step = -(-n // K)
+    return tuple((s0, min(s0 + step, n)) for s0 in range(0, n, step))
+
+
+def _pipeline_chunk_axis(shape: Tuple[int, ...], a: int, b: int,
+                         exclude: Tuple[int, ...] = ()) -> Optional[int]:
+    """Choose the chunk axis of a logical-order local block: the
+    largest-extent axis that is neither the split dim ``b`` nor the
+    concat dim ``a`` (nor excluded — fused hops also exclude the stage's
+    transform dims, which must stay whole for their FFT).  Deterministic
+    (ties resolve to the lowest axis index); ``None`` when nothing is
+    chunkable."""
+    best = None
+    for c, n in enumerate(shape):
+        if c == a or c == b or c in exclude or n < 2:
+            continue
+        if best is None or n > shape[best]:
+            best = c
+    return best
+
+
+@dataclass(frozen=True)
 class Auto(AbstractTransposeMethod):
     """Pick the exchange method per (pin, pout) configuration — the
     planner role FFTW's ``ESTIMATE``/``MEASURE`` flags play for the
@@ -142,8 +216,10 @@ class Auto(AbstractTransposeMethod):
     fused collective, same bytes); strong raggedness (``G << P``) tips
     to Ring once tiles outweigh per-round latency.
 
-    ``mode="measure"``: FFTW_MEASURE-style — compile both candidates for
-    the actual configuration and time a forward+back pair on device
+    ``mode="measure"``: FFTW_MEASURE-style — compile every candidate for
+    the actual configuration (:class:`AllToAll`, :class:`Ring`, and on
+    chunkable configurations the :class:`Pipelined` sweep over
+    ``K in {2, 4, 8}``) and time a forward+back pair on device
     (hardened K-differenced protocol, ``utils/benchtime.py``), caching
     the winner per configuration for the life of the process.
 
@@ -247,22 +323,28 @@ def _exchange_transpose(data, pin: Pencil, pout: Pencil, R: int,
         fwd_out != tuple(range(len(fwd_out)))
         and pk.pallas_enabled()
         and pk.supported(out_block, fwd_out, data.dtype, platform))
-    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=in_spec,
+    fn = shard_map(local_fn, mesh=mesh, in_specs=in_spec,
                        out_specs=out_spec,
                        check_vma=not pallas_may_run)
     return fn(data)
 
 
-def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
-                          extra_ndims: int):
-    """Exchange on topology axis ``R``: one ``lax.all_to_all`` — the
-    reference's entire pack -> Alltoallv -> unpack pipeline in one op
-    (split dim b into P tiles, concat received tiles along dim a)."""
+def _a2a_factory(pin: Pencil, pout: Pencil):
+    """Exchange factory: one ``lax.all_to_all`` — the reference's entire
+    pack -> Alltoallv -> unpack pipeline in one op (split dim b into P
+    tiles, concat received tiles along dim a)."""
     def factory(axis, P, a, b):
         return lambda x: jax.lax.all_to_all(
             x, axis, split_axis=b, concat_axis=a, tiled=True)
 
-    return _exchange_transpose(data, pin, pout, R, extra_ndims, factory)
+    return factory
+
+
+def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
+                          extra_ndims: int):
+    """Exchange on topology axis ``R`` via :func:`_a2a_factory`."""
+    return _exchange_transpose(data, pin, pout, R, extra_ndims,
+                               _a2a_factory(pin, pout))
 
 
 def _transpose_local(data, pin: Pencil, pout: Pencil, extra_ndims: int):
@@ -287,7 +369,7 @@ def _transpose_local(data, pin: Pencil, pout: Pencil, extra_ndims: int):
         # per-block tiled permute under shard_map (block layouts are
         # identical across devices, so one kernel serves all); gating and
         # interpret policy live in _maybe_pallas_transpose
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda blk: _maybe_pallas_transpose(blk, axes, platform),
             mesh=mesh, in_specs=pin.partition_spec(extra_ndims),
             out_specs=pout.partition_spec(extra_ndims), check_vma=False)
@@ -296,28 +378,11 @@ def _transpose_local(data, pin: Pencil, pout: Pencil, extra_ndims: int):
     return jax.lax.with_sharding_constraint(out, pout.sharding(extra_ndims))
 
 
-def _transpose_ring(data, pin: Pencil, pout: Pencil, R: int,
-                    extra_ndims: int):
-    """Like :func:`_transpose_all_to_all`, but the exchange is staged
-    shifted ``ppermute`` rounds of single tiles — and it is RAGGED-AWARE.
-
-    Bytes-on-the-wire model (vs reference ``Transpositions.jl:383-389``,
-    which sends exact per-peer intersection ranges): under XLA SPMD every
-    round's tile must have ONE static shape across devices, while the
-    true intersection extents vary per (source, dest) pair — so exact
-    intersection-size transfers are unrepresentable, and for dense
-    configurations padded-uniform tiles are already optimal.  What IS
-    statically known is which ceil-rule blocks are *entirely empty*:
-    with ``n`` true elements in ``P`` blocks of ``ceil(n/P)``, only the
-    first ``S = ceil(n / ceil(n/P))`` devices own data.  The ring
-    therefore runs ``G-1`` rounds among the first
-    ``G = max(S_a, S_b)`` participants instead of ``P-1``: for the
-    pathological raggedness the padded scheme is worst at (``n`` barely
-    above ``P``), this removes most of the pure-padding traffic —
-    e.g. ``n_a = n_b = 9, P = 8`` runs 4 rounds instead of 7.
-    Structurally-empty destination blocks are zero-filled, keeping the
-    padding-is-zeros invariant and bit-identity with :class:`AllToAll`.
-    """
+def _ring_factory(pin: Pencil, pout: Pencil):
+    """Exchange factory for :class:`Ring` — see :func:`_transpose_ring`
+    for the full design notes.  The returned exchange closure is shape-
+    polymorphic along every dim other than (a, b): it serves the whole
+    block and any :class:`Pipelined` chunk of it equally."""
     def factory(axis, P, a, b):
         n_a = pin.size_global()[a]
         n_b = pin.size_global()[b]
@@ -373,12 +438,103 @@ def _transpose_ring(data, pin: Pencil, pout: Pencil, R: int,
 
         return exchange
 
-    return _exchange_transpose(data, pin, pout, R, extra_ndims, factory)
+    return factory
+
+
+def _exchange_factory(method: AbstractTransposeMethod, pin: Pencil,
+                      pout: Pencil):
+    """Dispatch the explicit single-axis exchange factory for a concrete
+    method; :class:`Pipelined` wraps its base factory per-chunk.  Shared
+    with the FFT planner's fused pipelined hops (``ops/fft.py``)."""
+    if isinstance(method, AllToAll):
+        return _a2a_factory(pin, pout)
+    if isinstance(method, Ring):
+        return _ring_factory(pin, pout)
+    if isinstance(method, Pipelined):
+        inner_f = _exchange_factory(method.base, pin, pout)
+
+        def factory(axis, P, a, b):
+            inner = inner_f(axis, P, a, b)
+
+            def exchange(x):
+                c = _pipeline_chunk_axis(x.shape, a, b)
+                if c is None:
+                    return inner(x)
+                bounds = _chunk_bounds(x.shape[c], method.chunks)
+                if len(bounds) == 1:
+                    return inner(x)
+                parts = [inner(jax.lax.slice_in_dim(x, s0, s1, axis=c))
+                         for s0, s1 in bounds]
+                return jnp.concatenate(parts, axis=c)
+
+            return exchange
+
+        return factory
+    raise TypeError(f"no explicit exchange factory for method {method!r}")
+
+
+def _transpose_pipelined(data, pin: Pencil, pout: Pencil, R: int,
+                         extra_ndims: int, method: "Pipelined"):
+    """Chunked exchange (:class:`Pipelined`): the base method applied
+    per statically-shaped chunk of an exchange-untouched dim, results
+    concatenated — pure data movement, bit-identical to the base.  The
+    overlap win materializes when a consumer is fused per-chunk into
+    the same program (``PencilFFTPlan(pipeline=K)``)."""
+    return _exchange_transpose(data, pin, pout, R, extra_ndims,
+                               _exchange_factory(method, pin, pout))
+
+
+def _transpose_ring(data, pin: Pencil, pout: Pencil, R: int,
+                    extra_ndims: int):
+    """Like :func:`_transpose_all_to_all`, but the exchange is staged
+    shifted ``ppermute`` rounds of single tiles — and it is RAGGED-AWARE.
+
+    Bytes-on-the-wire model (vs reference ``Transpositions.jl:383-389``,
+    which sends exact per-peer intersection ranges): under XLA SPMD every
+    round's tile must have ONE static shape across devices, while the
+    true intersection extents vary per (source, dest) pair — so exact
+    intersection-size transfers are unrepresentable, and for dense
+    configurations padded-uniform tiles are already optimal.  What IS
+    statically known is which ceil-rule blocks are *entirely empty*:
+    with ``n`` true elements in ``P`` blocks of ``ceil(n/P)``, only the
+    first ``S = ceil(n / ceil(n/P))`` devices own data.  The ring
+    therefore runs ``G-1`` rounds among the first
+    ``G = max(S_a, S_b)`` participants instead of ``P-1``: for the
+    pathological raggedness the padded scheme is worst at (``n`` barely
+    above ``P``), this removes most of the pure-padding traffic —
+    e.g. ``n_a = n_b = 9, P = 8`` runs 4 rounds instead of 7.
+    Structurally-empty destination blocks are zero-filled, keeping the
+    padding-is-zeros invariant and bit-identity with :class:`AllToAll`.
+    """
+    return _exchange_transpose(data, pin, pout, R, extra_ndims,
+                               _ring_factory(pin, pout))
 
 
 # ---------------------------------------------------------------------------
 # analytic cost model
 # ---------------------------------------------------------------------------
+
+
+def _exchange_operand_extents(pin: Pencil, pout: Pencil, R: int
+                              ) -> Tuple[int, ...]:
+    """Logical extents of the exchanged operand: the local block with
+    the to-be-split dim ``b`` padded to its post-exchange padded extent
+    — ``padded_global[i] / P_i`` for every dim decomposed in the input,
+    ``pout.padded_global[b]`` for ``b``, true extent for other local
+    dims.  The ONE definition shared by :func:`transpose_cost` (pricing)
+    and the FFT planner's fused-hop chunk-axis choice (``ops/fft.py``),
+    so the priced shape and the chunked shape can never diverge."""
+    b = pout.decomposition[R]
+    ext = []
+    for i in range(pin.ndims):
+        if i == b:
+            ext.append(pout.padded_global_shape[b])
+        elif i in pin.decomposition:
+            j = pin.decomposition.index(i)
+            ext.append(pin.padded_global_shape[i] // pin.topology.dims[j])
+        else:
+            ext.append(pin.size_global()[i])
+    return tuple(ext)
 
 
 def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
@@ -413,15 +569,7 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
         return {}
     a = pin.decomposition[R]
     b = pout.decomposition[R]
-    ext = []
-    for i in range(pin.ndims):
-        if i == b:
-            ext.append(pout.padded_global_shape[b])
-        elif i in pin.decomposition:
-            j = pin.decomposition.index(i)
-            ext.append(pin.padded_global_shape[i] // pin.topology.dims[j])
-        else:
-            ext.append(pin.size_global()[i])
+    ext = _exchange_operand_extents(pin, pout, R)
     elems = int(np.prod(ext, dtype=np.int64))
     for e in extra_dims:
         elems *= int(e)
@@ -439,6 +587,17 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
             return {}
         return {"collective-permute":
                 {"count": G - 1, "bytes": (G - 1) * tile * isize}}
+    if isinstance(method, Pipelined):
+        # chunking multiplies the collective COUNT and leaves total wire
+        # bytes unchanged (ceil chunks partition the block exactly) — the
+        # schema prediction stays equal to compiled-HLO measurement
+        base = transpose_cost(pin, pout, extra_dims, dtype, method.base)
+        shape = tuple(ext) + tuple(extra_dims)
+        c = _pipeline_chunk_axis(shape, a, b)
+        k_eff = (len(_chunk_bounds(shape[c], method.chunks))
+                 if c is not None else 1)
+        return {op: {"count": v["count"] * k_eff, "bytes": v["bytes"]}
+                for op, v in base.items()}
     raise ValueError(
         f"no analytic cost model for method {method!r} (Gspmd collectives "
         f"are chosen by the partitioner; measure them with "
@@ -451,6 +610,13 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
 
 
 _MEASURE_REPORTS: dict = {}
+
+
+def _method_label(m: AbstractTransposeMethod) -> str:
+    """Stable human-readable audit label for a candidate method."""
+    if isinstance(m, Pipelined):
+        return f"Pipelined(chunks={m.chunks}, base={_method_label(m.base)})"
+    return type(m).__name__
 
 
 def last_measure_reports() -> list:
@@ -466,11 +632,13 @@ def last_measure_reports() -> list:
 @lru_cache(maxsize=512)
 def _measured_choice(pin: Pencil, pout: Pencil, R: int, extra_dims: tuple,
                      dtype_str: str) -> AbstractTransposeMethod:
-    """Time both explicit candidates on the actual configuration and cache
-    the winner (FFTW_MEASURE analog).  The timed body is a forward+back
-    pair — shape-preserving, so the hardened in-jit K-differenced
-    protocol (``utils/benchtime.py``) applies directly.  Each decision
-    is recorded with its noise floor in :func:`last_measure_reports`."""
+    """Time every explicit candidate on the actual configuration and
+    cache the winner (FFTW_MEASURE analog): AllToAll, Ring, and — when
+    the configuration has a chunkable dim — the Pipelined K in {2,4,8}
+    sweep.  The timed body is a forward+back pair — shape-preserving, so
+    the hardened in-jit K-differenced protocol (``utils/benchtime.py``)
+    applies directly.  Each decision is recorded with its noise floor in
+    :func:`last_measure_reports`."""
     import numpy as np
 
     from ..utils.benchtime import device_seconds_per_iter, last_spread
@@ -480,7 +648,20 @@ def _measured_choice(pin: Pencil, pout: Pencil, R: int, extra_dims: tuple,
     dtype = np.dtype(dtype_str)
     x0 = PencilArray.zeros(pin, extra_dims, dtype).data
     extra_ndims = len(extra_dims)
-    candidates = (AllToAll(), Ring())
+    # Chunked candidates sweep K in {2, 4, 8} (K=1 IS AllToAll) when the
+    # configuration has a chunkable dim — the pipelined-hop sweep the
+    # FFT planner's ``pipeline="auto"`` consumes; standalone hops rarely
+    # reward chunking (K serialized launches, same bytes), and an honest
+    # measurement says so.
+    a = pin.decomposition[R]
+    b = pout.decomposition[R]
+    blk = tuple(pin.padded_size_local(LogicalOrder)) + tuple(extra_dims)
+    c = _pipeline_chunk_axis(blk, a, b)
+    candidates = [AllToAll(), Ring()]
+    if c is not None:
+        candidates += [Pipelined(chunks=k) for k in (2, 4, 8)
+                       if len(_chunk_bounds(blk[c], k)) > 1]
+    candidates = tuple(candidates)
     best, best_t = 0, float("inf")
     times, spreads = [], []
     for i, cand in enumerate(candidates):
@@ -497,16 +678,19 @@ def _measured_choice(pin: Pencil, pout: Pencil, R: int, extra_dims: tuple,
         spreads.append(last_spread()["k1_worst_over_best"])
         if t < best_t:
             best, best_t = i, t
-    loser_t = max(times)
+    # confidence = winner vs the RUNNER-UP (with >2 candidates the
+    # slowest loser would overstate the margin of a narrow win)
+    loser_t = min(t for i, t in enumerate(times) if i != best) \
+        if len(times) > 1 else best_t
     noise = max(s for s in spreads if s is not None) if any(
         s is not None for s in spreads) else None
     _MEASURE_REPORTS[(pin, pout, R, extra_dims, dtype_str)] = {
         "config": f"{pin.size_global()}@{pin.topology.dims} R={R} "
                   f"{dtype_str}",
-        "candidates": [type(c).__name__ for c in candidates],
+        "candidates": [_method_label(c) for c in candidates],
         "seconds": times,
         "k1_spreads": spreads,
-        "winner": type(candidates[best]).__name__,
+        "winner": _method_label(candidates[best]),
         # ratio of the loser/winner time gap to the measurement noise:
         # > 1 means the decision clears the observed jitter
         "margin_over_noise": (round((loser_t / best_t) / noise, 3)
@@ -604,6 +788,9 @@ def _compiled_transpose(pin: Pencil, pout: Pencil, R: Optional[int],
         fn = lambda data: _transpose_all_to_all(data, pin, pout, R, extra_ndims)
     elif isinstance(method, Ring):
         fn = lambda data: _transpose_ring(data, pin, pout, R, extra_ndims)
+    elif isinstance(method, Pipelined):
+        fn = lambda data: _transpose_pipelined(data, pin, pout, R,
+                                               extra_ndims, method)
     elif isinstance(method, Gspmd):
         fn = lambda data: _reshard_gspmd(data, pin, pout, extra_ndims)
     else:

@@ -1,0 +1,38 @@
+"""JAX API compatibility shims.
+
+The framework targets the current JAX surface (``jax.shard_map`` with
+``check_vma``, ``jax.sharding.AxisType``), but must also run on older
+jaxlib builds (this container ships 0.4.37) where:
+
+* ``shard_map`` still lives in ``jax.experimental.shard_map`` and its
+  static-check kwarg is ``check_rep`` (the varying-mesh-axes check's
+  predecessor);
+* ``jax.sharding.AxisType`` does not exist (all mesh axes behave as the
+  later ``Auto`` type).
+
+Import :func:`shard_map` / :data:`AxisType` from here instead of from
+``jax`` so every call site stays version-agnostic.  The shims resolve at
+import time — zero per-call overhead.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "AxisType"]
+
+try:  # modern surface: jax.sharding.AxisType (Auto/Explicit/Manual)
+    from jax.sharding import AxisType  # type: ignore
+except ImportError:  # pre-AxisType jax: every axis is implicitly Auto
+    AxisType = None
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        """Legacy adapter: ``check_vma`` maps onto ``check_rep`` (the
+        older static replication check the vma check superseded)."""
+        return _legacy_shard_map(f, mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)

@@ -81,12 +81,15 @@ def test_auto_validates_mode():
 
 
 def test_measure_mode_picks_and_caches(devices):
+    from pencilarrays_tpu.parallel.transpositions import (
+        Pipelined, _method_label)
+
     topo = Topology((4, 2))
     shape = (12, 10, 8)
     pin = Pencil(topo, shape, (1, 2))
     pout = pin.replace(decomp_dims=(0, 2))
     m = resolve_method(pin, pout, (), np.float32, Auto(mode="measure"))
-    assert m in (AllToAll(), Ring())
+    assert isinstance(m, (AllToAll, Ring, Pipelined))
     # cached: same configuration resolves to the same object without
     # re-measuring
     before = _measured_choice.cache_info().hits
@@ -98,19 +101,49 @@ def test_measure_mode_picks_and_caches(devices):
     x = PencilArray.from_global(pin, u)
     y = transpose(x, pout, method=Auto(mode="measure"))
     np.testing.assert_array_equal(gather(y), u)
-    # every decision leaves a variance-aware audit record: both
-    # candidates timed, their k1 spreads, and the winner's margin
-    # relative to the observed noise (VERDICT r3 weak #7)
+    # every decision leaves a variance-aware audit record: every
+    # candidate timed (the two explicit exchanges PLUS the Pipelined
+    # K in {2,4,8} sweep on chunkable configurations), their k1
+    # spreads, and the winner's margin relative to the observed noise
+    # (VERDICT r3 weak #7)
     from pencilarrays_tpu.parallel.transpositions import (
         last_measure_reports)
 
     reports = last_measure_reports()
     assert reports, "measure decision left no audit record"
     rep = reports[-1]
-    assert rep["winner"] == type(m).__name__
-    assert len(rep["seconds"]) == len(rep["candidates"]) == 2
+    assert rep["winner"] == _method_label(m)
+    assert len(rep["seconds"]) == len(rep["candidates"]) >= 2
     assert all(t > 0 for t in rep["seconds"])
-    assert len(rep["k1_spreads"]) == 2
+    assert len(rep["k1_spreads"]) == len(rep["candidates"])
+    # this configuration has chunkable dims -> the K sweep must appear
+    assert any(c.startswith("Pipelined") for c in rep["candidates"])
+
+
+def test_pipelined_cost_multiplies_count_not_bytes(devices):
+    """transpose_cost for Pipelined(K): K_eff launches of the base
+    exchange, identical total wire bytes (ceil chunks partition the
+    block exactly) — the schema the HLO measurement reproduces."""
+    from pencilarrays_tpu import Pipelined, Ring
+
+    topo = Topology((8,))
+    pin, pout = _pair(topo, (32, 32, 8))
+    base = pa.transpose_cost(pin, pout, (), np.float32, AllToAll())
+    c4 = pa.transpose_cost(pin, pout, (), np.float32, Pipelined(chunks=4))
+    assert c4["all-to-all"]["bytes"] == base["all-to-all"]["bytes"]
+    assert c4["all-to-all"]["count"] == 4 * base["all-to-all"]["count"]
+    # ring base: rounds multiply, bytes stay
+    br = pa.transpose_cost(pin, pout, (), np.float32, Ring())
+    cr = pa.transpose_cost(pin, pout, (), np.float32,
+                           Pipelined(chunks=2, base=Ring()))
+    assert cr["collective-permute"]["bytes"] == \
+        br["collective-permute"]["bytes"]
+    assert cr["collective-permute"]["count"] == \
+        2 * br["collective-permute"]["count"]
+    # chunk-dim extent clamps K_eff
+    c_big = pa.transpose_cost(pin, pout, (), np.float32,
+                              Pipelined(chunks=64))
+    assert c_big["all-to-all"]["count"] == 8  # extent of the spare dim
 
 
 def test_transpose_cost_resolves_auto(devices):

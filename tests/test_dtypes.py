@@ -162,6 +162,10 @@ def test_from_global_downcast_warns(topo):
     pen = Pencil(topo, (8, 8), (0, 1))
     # the suite runs with x64 enabled, so downcasting must be provoked
     # by temporarily disabling it: the f64 input is then stored f32
-    with jax.enable_x64(False), pytest.warns(UserWarning,
-                                             match="stored as"):
+    # (jax.enable_x64 moved out of jax.experimental across versions)
+    enable_x64 = getattr(jax, "enable_x64", None)
+    if enable_x64 is None:
+        from jax.experimental import enable_x64
+    with enable_x64(False), pytest.warns(UserWarning,
+                                         match="stored as"):
         PencilArray.from_global(pen, np.zeros((8, 8), np.float64))

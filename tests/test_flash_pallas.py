@@ -504,6 +504,56 @@ def test_auto_routing_consults_measured_verdict(monkeypatch):
     assert not attn._auto_pallas_allowed()
 
 
+def test_bwd_routing_consults_fwd_bwd_verdict(monkeypatch):
+    """With the env knob UNSET, a measured fwd+bwd LOSS routes the flash
+    backward through the XLA recompute while the (separately measured)
+    Pallas forward stays — the ADVICE r5 medium finding.  An explicit
+    env setting always wins, in either direction."""
+    from pencilarrays_tpu.models import attention as attn
+
+    monkeypatch.delenv("PENCILARRAYS_TPU_FLASH_BWD", raising=False)
+    monkeypatch.setattr(attn, "_flash_sweep_verdict",
+                        lambda: {"fwd_all_win": True,
+                                 "fwd_bwd_all_win": False})
+    assert not attn._hand_bwd_enabled()
+    monkeypatch.setattr(attn, "_flash_sweep_verdict",
+                        lambda: {"fwd_all_win": True,
+                                 "fwd_bwd_all_win": True})
+    assert attn._hand_bwd_enabled()
+    monkeypatch.setattr(attn, "_flash_sweep_verdict", lambda: None)
+    assert attn._hand_bwd_enabled()  # no measurement: tiling default
+    # explicit env overrides the measured verdict both ways
+    monkeypatch.setattr(attn, "_flash_sweep_verdict",
+                        lambda: {"fwd_bwd_all_win": False})
+    monkeypatch.setenv("PENCILARRAYS_TPU_FLASH_BWD", "pallas")
+    assert attn._hand_bwd_enabled()
+    monkeypatch.setenv("PENCILARRAYS_TPU_FLASH_BWD", "xla")
+    assert not attn._hand_bwd_enabled()
+
+
+def test_flash_sweep_artifact_env_override_and_mtime(tmp_path,
+                                                     monkeypatch):
+    """PENCILARRAYS_TPU_FLASH_SWEEP_PATH points the verdict loader
+    anywhere (installed layouts), and a rewritten artifact is re-read on
+    mtime change — no process-lifetime lru pin (ADVICE r5 low #2)."""
+    import json
+    import os
+
+    from pencilarrays_tpu.models import attention as attn
+
+    art = tmp_path / "sweep.json"
+    art.write_text(json.dumps({"verdict": {"fwd_all_win": True}}))
+    monkeypatch.setenv("PENCILARRAYS_TPU_FLASH_SWEEP_PATH", str(art))
+    assert attn._flash_sweep_verdict() == {"fwd_all_win": True}
+    # rewrite + distinct mtime -> the loader must pick up the new doc
+    art.write_text(json.dumps({"verdict": {"fwd_all_win": False}}))
+    os.utime(art, ns=(1, 1))
+    assert attn._flash_sweep_verdict() == {"fwd_all_win": False}
+    # missing file: None (and the stale cache entry is dropped)
+    art.unlink()
+    assert attn._flash_sweep_verdict() is None
+
+
 @pytest.mark.slow  # interpret-mode kernels x ring rounds, bf16
 def test_ring_pallas_bf16_on_mesh(devices):
     """bf16 q/k/v through the kernelized ring: f32 statistics inside the

@@ -67,6 +67,13 @@ def _launch_workers(worker, nprocs, extra_args, sentinel, label):
         pytest.fail(f"{label} workers timed out; captured output:\n"
                     + "\n---\n".join(drained))
     for p, out in zip(procs, outs):
+        if (p.returncode != 0 and
+                "aren't implemented on the CPU backend" in out):
+            # older jaxlib: the CPU backend has no cross-process
+            # collective transport (gloo came later) — an environment
+            # capability gap, not a framework regression
+            pytest.skip("this jaxlib's CPU backend lacks multiprocess "
+                        "collectives")
         assert p.returncode == 0, f"{label} worker failed:\n{out[-3000:]}"
         assert sentinel in out, out[-2000:]
     return outs

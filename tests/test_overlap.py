@@ -19,7 +19,8 @@ import numpy as np
 import pytest
 
 from pencilarrays_tpu import (
-    AllToAll, Pencil, PencilArray, Ring, Topology, Transposition, transpose,
+    AllToAll, Pencil, PencilArray, PencilFFTPlan, Ring, Topology,
+    Transposition, transpose,
 )
 
 
@@ -80,6 +81,102 @@ def test_transpose_and_independent_compute_are_dependency_free(topo, method):
     # and both compile into ONE module (one dispatch, one schedule)
     hlo = jax.jit(f).lower(x.data, w).compile().as_text()
     assert "dot(" in hlo or "dot-general" in hlo
+
+
+def _subjaxprs(jaxpr):
+    """Yield ``jaxpr`` and every (closed) sub-jaxpr reachable from its
+    eqn params, recursively."""
+    yield jaxpr
+    for e in jaxpr.eqns:
+        for v in e.params.values():
+            sub = getattr(v, "jaxpr", None)
+            if sub is not None:
+                yield from _subjaxprs(sub)
+            elif hasattr(v, "eqns"):
+                yield from _subjaxprs(v)
+
+
+def _contains_fft(eqn):
+    """True when the eqn is (or transitively wraps) an FFT primitive —
+    jnp.fft calls trace as pjit-wrapped sub-jaxprs."""
+    if eqn.primitive.name == "fft":
+        return True
+    for v in eqn.params.values():
+        sub = getattr(v, "jaxpr", None)
+        if sub is None and hasattr(v, "eqns"):
+            sub = v
+        if sub is not None and any(_contains_fft(i) for i in sub.eqns):
+            return True
+    return False
+
+
+def test_fused_pipelined_hop_exchanges_independent_of_ffts(topo):
+    """The tentpole's overlap precondition, INSIDE one fused hop: with
+    ``PencilFFTPlan(pipeline=K)``, chunk ``k``'s exchange must have no
+    dependency edge to any chunk's FFT stage (in particular chunk
+    ``k-1``'s) — the serialized schedule's hop->transform barrier is
+    gone and the latency-hiding scheduler may overlap chunk ``k``'s
+    wire time with chunk ``k-1``'s transform.  Each chunk's FFT still
+    depends on exactly its own chunk's exchange (that dependency is the
+    data flow, not the barrier)."""
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                         dtype=jnp.float32, pipeline=2)
+    assert any(s[0] == "ft" for s in plan._steps), "no hop fused"
+    x = plan.allocate_input()
+    jpr = jax.make_jaxpr(
+        lambda d: plan.forward(PencilArray(plan.input_pencil, d)).data
+    )(x.data).jaxpr
+
+    checked = 0
+    for sj in _subjaxprs(jpr):
+        eqns = list(sj.eqns)
+        t_idx = [i for i, e in enumerate(eqns)
+                 if e.primitive.name == "all_to_all"]
+        f_idx = [i for i, e in enumerate(eqns) if _contains_fft(e)]
+        if len(t_idx) < 2 or not f_idx:
+            continue  # not a fused hop body
+        checked += 1
+        deps = _eqn_deps(eqns)
+        # no exchange ever waits on a transform ...
+        for t in t_idx:
+            for f in f_idx:
+                assert f not in deps[t], (
+                    "chunk exchange depends on an FFT stage — the fused "
+                    "hop reintroduced the barrier")
+        # ... and each chunk's transform consumes exactly one exchange
+        for f in f_idx:
+            assert len([t for t in t_idx if t in deps[f]]) == 1
+    assert checked >= 1, "no fused hop body found in the jaxpr"
+
+
+def test_fused_pipelined_backward_ffts_independent_of_exchanges(topo):
+    """Mirror property for :meth:`backward`: the inverse transform of
+    chunk ``k`` must not depend on any chunk's exchange — compute leads,
+    the exchange trails, so chunk ``k``'s inverse FFT overlaps chunk
+    ``k-1``'s wire time."""
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                         dtype=jnp.float32, pipeline=2)
+    uh = plan.allocate_output()
+    jpr = jax.make_jaxpr(
+        lambda d: plan.backward(PencilArray(plan.output_pencil, d)).data
+    )(uh.data).jaxpr
+
+    checked = 0
+    for sj in _subjaxprs(jpr):
+        eqns = list(sj.eqns)
+        t_idx = [i for i, e in enumerate(eqns)
+                 if e.primitive.name == "all_to_all"]
+        f_idx = [i for i, e in enumerate(eqns) if _contains_fft(e)]
+        if len(t_idx) < 2 or not f_idx:
+            continue
+        checked += 1
+        deps = _eqn_deps(eqns)
+        for f in f_idx:
+            for t in t_idx:
+                assert t not in deps[f], (
+                    "inverse transform depends on an exchange — the "
+                    "mirrored fused hop reintroduced the barrier")
+    assert checked >= 1, "no fused hop body found in the jaxpr"
 
 
 def test_transposition_object_overlap_api(topo):
