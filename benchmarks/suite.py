@@ -104,6 +104,13 @@ def main():
                         help="also run the pipelined-hop sweep "
                              "(benchmarks/pipeline_sweep.py; needs >= 2 "
                              "devices, adds several compiles)")
+    parser.add_argument("--resilience", action="store_true",
+                        help="also measure checkpoint save/restore "
+                             "throughput (CheckpointManager) with manifest "
+                             "checksums on vs off")
+    parser.add_argument("--resilience-n", type=int, default=192,
+                        help="cube edge of the resilience benchmark state "
+                             "(f32; 192^3 = 28 MiB per dataset)")
     args = parser.parse_args()
 
     import jax
@@ -230,6 +237,46 @@ def main():
 
         points, verdict = measure_roundtrips(topo, (n, n, n), k1=12)
         results["pipeline_sweep"] = {"points": points, "verdict": verdict}
+
+    # -- 7. resilience: checkpoint throughput, checksums on vs off --------
+    # Opt-in (wall-clock disk I/O, several hundred MB written): what does
+    # the CRC32C manifest cost on the save and the verify-on-restore path?
+    if args.resilience:
+        import shutil
+        import tempfile
+
+        from pencilarrays_tpu.resilience import CheckpointManager
+
+        n_r = args.resilience_n
+        pen_r = Pencil(topo, (n_r, n_r, n_r),
+                       tuple(range(3 - len(topo.dims), 3))
+                       if len(devs) > 1 else (2,))
+        state = {"u": PencilArray.from_global(
+            pen_r, np.random.default_rng(0).standard_normal(
+                (n_r,) * 3).astype(np.float32))}
+        nbytes = n_r ** 3 * 4
+        results["resilience_checkpoint"] = {"dataset_mb": nbytes / 1e6}
+        for checksums in (True, False):
+            root = tempfile.mkdtemp(prefix="pa_resil_bench_")
+            try:
+                mgr = CheckpointManager(root, keep=2, checksums=checksums)
+                mgr.save(0, state)  # warm: allocator, file creation
+                t0 = time.perf_counter()
+                mgr.save(1, state)
+                t_save = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                back = mgr.restore(1).read("u", pen_r)
+                np.asarray(back.data.addressable_shards[0].data)
+                t_restore = time.perf_counter() - t0
+                results["resilience_checkpoint"][
+                    "checksums_on" if checksums else "checksums_off"] = {
+                    "save_seconds": t_save,
+                    "save_mb_per_s": nbytes / t_save / 1e6,
+                    "restore_verify_seconds": t_restore,
+                    "restore_mb_per_s": nbytes / t_restore / 1e6,
+                }
+            finally:
+                shutil.rmtree(root, ignore_errors=True)
 
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)

@@ -61,6 +61,13 @@ from .parallel import (  # noqa: F401
 from .ops.localgrid import LocalRectilinearGrid, localgrid  # noqa: F401
 from . import ops  # noqa: F401
 from . import io  # noqa: F401
+from . import resilience  # noqa: F401
+from .resilience import (  # noqa: F401
+    CheckpointManager,
+    CorruptCheckpointError,
+    CorruptSidecarError,
+    RetryPolicy,
+)
 from .parallel import distributed  # noqa: F401
 from .ops.fft import PencilFFTPlan  # noqa: F401
 from .compat import (  # noqa: F401

@@ -41,6 +41,9 @@ import numpy as np
 from ..parallel.arrays import PencilArray
 from ..parallel.distributed import sync_global_devices
 from ..parallel.pencil import LogicalOrder, MemoryOrder, Pencil
+from ..resilience import faults
+from ..resilience.errors import CorruptSidecarError
+from ..resilience.retry import RetryPolicy
 from .core import ParallelIODriver, metadata
 from . import native
 
@@ -250,20 +253,35 @@ class BinaryFile:
     # -- metadata ---------------------------------------------------------
     def _load_meta(self) -> Dict:
         if os.path.exists(self.meta_filename):
-            with open(self.meta_filename) as f:
-                return json.load(f)
+            try:
+                with open(self.meta_filename) as f:
+                    return json.load(f)
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                raise CorruptSidecarError(
+                    f"corrupt sidecar {self.meta_filename!r} ({e}): the "
+                    f"data file cannot be interpreted without it.  Recover "
+                    f"from the last committed checkpoint "
+                    f"(resilience.CheckpointManager.latest_valid()), or use "
+                    f"read_raw(offset=...) if the layout is known.",
+                    path=self.meta_filename) from e
         return {"driver": "BinaryDriver", "version": FORMAT_VERSION,
                 "endianness": _endianness(), "datasets": []}
 
     def _flush_meta(self):
-        # atomic replace: a crash mid-flush must never corrupt the
-        # sidecar (it is the commit point of every write)
-        tmp = self.meta_filename + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._meta, f, indent=1)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.meta_filename)
+        # transient filesystem errors at the commit point back off and
+        # retry rather than abort a checkpoint whose data already landed
+        RetryPolicy.from_env().call(
+            self._flush_meta_once,
+            label=f"flush sidecar {self.meta_filename}")
+
+    def _flush_meta_once(self):
+        faults.fire("io.flush_meta", path=self.meta_filename)
+        # atomic fsync'd replace (shared resilience primitive): a crash
+        # mid-flush must never corrupt the sidecar (it is the commit
+        # point of every write)
+        from ..resilience.fsutil import atomic_write_json
+
+        atomic_write_json(self.meta_filename, self._meta)
 
     @property
     def datasets(self) -> List[Dict]:
@@ -294,16 +312,26 @@ class BinaryFile:
         self.close()
 
     # -- write ------------------------------------------------------------
-    def write(self, name: str, x, *, chunks: bool = False) -> None:
+    def write(self, name: str, x, *, chunks: bool = False,
+              block_observer=None) -> None:
         """``file[name] = x`` of the reference (``mpi_io.jl:170-189``).
         ``x`` may be a tuple/list of same-pencil arrays — written as ONE
         dataset with a trailing component dim (collection-level I/O);
-        :meth:`read` returns the tuple back."""
+        :meth:`read` returns the tuple back.
+
+        ``block_observer(start, block)`` is called once per streamed
+        logical-order block as it is written (the checkpoint manager's
+        checksum hook — the block is already the write path's host copy,
+        so observing adds no extra copy).  Discontiguous layout only."""
         if not self.writable:
             raise PermissionError("file not opened for writing")
         from ..utils.timers import timeit
         from .core import pack_collection
 
+        if block_observer is not None and chunks:
+            raise ValueError(
+                "block_observer streams logical-order blocks; the chunks "
+                "layout stores memory-order rank blocks")
         x, ncomp = pack_collection(x)
         if self.uniquify_names:
             base, n = name, 1
@@ -312,10 +340,10 @@ class BinaryFile:
                 n += 1
                 name = f"{base}({n})"
         with timeit(x.pencil.timer, "write parallel"):
-            self._write_dataset(name, x, chunks, ncomp)
+            self._write_dataset(name, x, chunks, ncomp, block_observer)
 
     def _write_dataset(self, name: str, x: PencilArray, chunks: bool,
-                       ncomp: int = None):
+                       ncomp: int = None, block_observer=None):
         # Rewriting an existing dataset of identical size ping-pongs
         # between two regions: the new bytes go to the SPARE region (the
         # previous version's old slot, or a fresh one on the first
@@ -351,7 +379,7 @@ class BinaryFile:
         if chunks:
             entry["chunk_map"] = self._write_chunks(x, offset, dtype)
         else:
-            self._write_discontiguous(x, offset, dtype)
+            self._write_discontiguous(x, offset, dtype, block_observer)
         self._meta["datasets"] = [
             d for d in self._meta["datasets"] if d["name"] != name
         ] + [entry]
@@ -370,7 +398,8 @@ class BinaryFile:
             self._flush_meta()
         sync_global_devices("pa_io_write")
 
-    def _write_discontiguous(self, x: PencilArray, offset: int, dtype):
+    def _write_discontiguous(self, x: PencilArray, offset: int, dtype,
+                             block_observer=None):
         shape = x.pencil.size_global(LogicalOrder) + x.extra_dims
         total = offset + int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
         if self._is_proc0:
@@ -388,7 +417,10 @@ class BinaryFile:
         # multi-host SPMD every process writes exactly its own blocks into
         # the shared file — the collective write_all of mpi_io.jl:335-380.
         # Blocks are materialized lazily so only in-flight ones occupy
-        # host memory.
+        # host memory.  Each block passes the ``io.write_block`` fault
+        # point (on the main thread, so injection order is deterministic)
+        # and the optional block_observer checksum hook before any pwrite
+        # is issued for it.
         use_native = native.available()
         if use_native:
             # Two levels of parallelism share one budget: blocks across
@@ -400,8 +432,7 @@ class BinaryFile:
             nblocks = max(1, len(x.pencil.mesh.local_devices))
             inner = max(1, native.default_threads() // min(nblocks, 8))
 
-            def write_block(start_block):
-                start, block = start_block
+            def put(start, block):
                 # native strided scatter (the MPI create_subarray+write_all
                 # analog): GIL-released pwrite runs
                 native.scatter_write(self.filename, offset,
@@ -409,14 +440,44 @@ class BinaryFile:
                                      start, nthreads=inner)
 
             with ThreadPoolExecutor(max_workers=8) as ex:
-                list(ex.map(write_block, iter_local_blocks(x)))
+                if block_observer is None \
+                        and not faults.armed("io.write_block"):
+                    # fast path: contiguous copies happen just-in-time in
+                    # the pool threads, bounding extra host memory to the
+                    # blocks in flight
+                    list(ex.map(lambda sb: put(*sb), iter_local_blocks(x)))
+                else:
+                    # hook path: copy on the main thread so injection
+                    # order and observed bytes are deterministic; drain
+                    # the oldest write once 8 are in flight so a slow
+                    # disk never accumulates the whole local array in
+                    # materialized copies
+                    futs = []
+                    for i, (start, block) in enumerate(
+                            iter_local_blocks(x)):
+                        block = np.ascontiguousarray(block)
+                        faults.block_write_hook(
+                            i, start, block, block_observer, put,
+                            in_flight=futs, path=self.filename)
+                        futs.append(ex.submit(put, start, block))
+                        while len(futs) >= 8:
+                            futs.pop(0).result()
+                    for fu in futs:
+                        fu.result()
         else:
             mm = np.memmap(self.filename, dtype=dtype, mode="r+",
                            offset=offset, shape=shape)
-            for start, block in iter_local_blocks(x):
+
+            def put(start, block):
                 dst = tuple(slice(s, s + e)
                             for s, e in zip(start, block.shape))
                 mm[dst] = block
+
+            for i, (start, block) in enumerate(iter_local_blocks(x)):
+                faults.block_write_hook(i, start, block, block_observer,
+                                        put, flush=mm.flush,
+                                        path=self.filename)
+                put(start, block)
             mm.flush()
             del mm
 
@@ -445,10 +506,17 @@ class BinaryFile:
         sync_global_devices("pa_io_truncate")
         # each process writes its own addressable shards' chunks
         with open(self.filename, "r+b") as f:
-            for coords, block in iter_local_blocks(x, MemoryOrder):
+            for i, (coords, block) in enumerate(
+                    iter_local_blocks(x, MemoryOrder)):
                 rank = topo.rank(coords)
-                f.seek(chunk_map[rank]["offset_bytes"])
-                f.write(np.ascontiguousarray(block).tobytes())
+
+                def put(_coords, blk, rank=rank):
+                    f.seek(chunk_map[rank]["offset_bytes"])
+                    f.write(np.ascontiguousarray(blk).tobytes())
+
+                faults.block_write_hook(i, coords, block, None, put,
+                                        flush=f.flush, path=self.filename)
+                put(coords, block)
         return chunk_map
 
     # -- read -------------------------------------------------------------

@@ -34,10 +34,34 @@ class ParallelIODriver:
 
 
 @contextmanager
-def open_file(driver: ParallelIODriver, filename: str, **mode):
+def open_file(driver: ParallelIODriver, filename: str, retry=None, **mode):
     """``open(f, driver, filename; mode...)`` of the reference
-    (``PencilIO.jl:18-51``) as a context manager."""
-    f = driver.open(filename, **mode)
+    (``PencilIO.jl:18-51``) as a context manager.
+
+    The open is consulted by the ``io.open`` fault-injection point and
+    retried under ``retry`` (default
+    :meth:`~pencilarrays_tpu.resilience.RetryPolicy.from_env`) — a
+    transient filesystem error at open time backs off instead of
+    crashing the job; non-transient errors (missing file, permission)
+    propagate immediately.  EXCEPT multi-process *writable* opens: those
+    run a collective barrier inside the driver, and a one-sided retry
+    would re-enter it while peers have advanced to a later named barrier
+    (deadlock) — so the collective case fails fast instead."""
+    from ..parallel.distributed import is_multiprocess
+    from ..resilience import faults
+    from ..resilience.retry import RetryPolicy
+
+    policy = retry or RetryPolicy.from_env()
+    writable = any(mode.get(k) for k in ("write", "append", "create",
+                                         "truncate"))
+    if writable and is_multiprocess():
+        policy = policy.replace(max_attempts=1)
+
+    def _open():
+        faults.fire("io.open", path=filename)
+        return driver.open(filename, **mode)
+
+    f = policy.call(_open, label=f"open {filename}")
     try:
         yield f
     finally:

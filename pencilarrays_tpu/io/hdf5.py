@@ -44,6 +44,7 @@ import numpy as np
 from ..parallel.arrays import PencilArray
 from ..parallel.distributed import sync_global_devices
 from ..parallel.pencil import LogicalOrder, MemoryOrder, Pencil
+from ..resilience import faults
 from .core import ParallelIODriver, metadata
 
 __all__ = ["HDF5Driver", "HDF5File", "has_hdf5"]
@@ -166,19 +167,23 @@ class HDF5File:
             return np.dtype(np.uint16), "bfloat16"
         return dt, None
 
-    def write(self, name: str, x) -> None:
+    def write(self, name: str, x, *, block_observer=None) -> None:
         """``file[name] = x``: hyperslab writes per block
         (``ext/PencilArraysHDF5Ext.jl:113-118``), metadata as attributes
         (``ext:127-133``).  A tuple/list of same-pencil arrays is written
         as ONE dataset with a trailing component dim (collection-level
-        I/O, ``ext:222-229``)."""
+        I/O, ``ext:222-229``).
+
+        ``block_observer(start, block)`` is called once per streamed
+        logical-order block (the checkpoint manager's checksum hook; the
+        block is the write path's existing host copy)."""
         if not self.writable:
             raise PermissionError("file not opened for writing")
         from .core import pack_collection
 
         x, ncomp = pack_collection(x)
         if self._multi:
-            return self._write_multiproc(name, x, ncomp)
+            return self._write_multiproc(name, x, ncomp, block_observer)
         from ..utils.timers import timeit
         from .binary import iter_local_blocks
 
@@ -216,12 +221,17 @@ class HDF5File:
                 dset = self._f.create_dataset(name, shape=shape,
                                               dtype=store_dt,
                                               chunks=chunk_shape)
-            for start, block in iter_local_blocks(x):
-                if marker:
-                    block = block.view(store_dt)
+            def put(start, block):
                 dst = tuple(slice(s, s + e)
                             for s, e in zip(start, block.shape))
                 dset[dst] = block
+
+            for i, (start, block) in enumerate(iter_local_blocks(x)):
+                if marker:
+                    block = block.view(store_dt)
+                faults.block_write_hook(i, start, block, block_observer,
+                                        put, flush=self._f.flush)
+                put(start, block)
             for k, v in metadata(x, collection=ncomp).items():
                 dset.attrs[k] = json.dumps(v)
             if marker:
@@ -232,7 +242,7 @@ class HDF5File:
                 del dset.attrs["collection"]
 
     def _write_multiproc(self, name: str, x: PencilArray,
-                         ncomp: int = None) -> None:
+                         ncomp: int = None, block_observer=None) -> None:
         """Collective multi-process write: shard files + VDS master.
 
         Each process writes the blocks of ITS devices into its shard
@@ -248,8 +258,8 @@ class HDF5File:
             topo = pen.topology
             store_dt, marker = self._storage_dtype(x.dtype)
             grp = self._f.require_group(name)
-            for coords, _start, block in iter_local_blocks(
-                    x, with_coords=True):
+            for i, (coords, start, block) in enumerate(
+                    iter_local_blocks(x, with_coords=True)):
                 rank = topo.rank(coords)
                 block = np.ascontiguousarray(block)
                 if marker:
@@ -260,6 +270,17 @@ class HDF5File:
                     del grp[ds]  # shape changed: shard files may leak
                     # the old allocation (HDF5 never reclaims); same-
                     # shape rewrites below reuse storage in place
+
+                def put(_start, blk, ds=ds):
+                    # torn-injection path only: a partial-shape rank
+                    # block replaces the dataset outright (the master is
+                    # never rebuilt past the kill, so nothing reads it)
+                    if ds in grp:
+                        del grp[ds]
+                    grp.create_dataset(ds, data=blk)
+
+                faults.block_write_hook(i, start, block, block_observer,
+                                        put, flush=self._f.flush)
                 if ds in grp:
                     grp[ds][...] = block
                 else:
@@ -272,7 +293,19 @@ class HDF5File:
             self._f.flush()
             sync_global_devices("pa_h5_data")
             if self._is_proc0:
-                self._build_master(name, x, store_dt, marker, ncomp)
+                # retried entirely on proc0 BETWEEN the barriers (peers
+                # are parked at pa_h5_commit, which proc0 has not entered
+                # yet), so transient errors back off without barrier
+                # desync; _build_master is idempotent (del + recreate)
+                from ..resilience.retry import RetryPolicy
+
+                def _commit_master():
+                    faults.fire("io.flush_meta", path=self.filename)
+                    self._build_master(name, x, store_dt, marker, ncomp)
+
+                RetryPolicy.from_env().call(
+                    _commit_master,
+                    label=f"build hdf5 master {self.filename}")
             sync_global_devices("pa_h5_commit")
 
     def _build_master(self, name: str, x: PencilArray, store_dt, marker,

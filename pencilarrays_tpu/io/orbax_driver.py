@@ -27,6 +27,8 @@ import numpy as np
 
 from ..parallel.arrays import PencilArray
 from ..parallel.pencil import LogicalOrder, Pencil
+from ..resilience import faults
+from ..resilience.retry import RetryPolicy
 from .core import ParallelIODriver, metadata
 
 __all__ = ["OrbaxDriver", "OrbaxFile", "has_orbax"]
@@ -145,8 +147,21 @@ class OrbaxFile:
             self._pending_meta[name] = meta
         else:
             self._ckpt.wait_until_finished()
-            with open(self._meta_path(name), "w") as f:
-                json.dump(meta, f, indent=1)
+            self._publish_meta(name, meta)
+
+    def _publish_meta(self, name: str, meta: dict) -> None:
+        """Durably publish a dataset's metadata — the commit point of an
+        orbax write, so it passes the ``io.flush_meta`` fault point, is
+        retried on transient errors, and lands via atomic replace."""
+
+        from ..resilience.fsutil import atomic_write_json
+
+        def _flush():
+            faults.fire("io.flush_meta", path=self._meta_path(name))
+            atomic_write_json(self._meta_path(name), meta)
+
+        RetryPolicy.from_env().call(
+            _flush, label=f"flush orbax meta {name}")
 
     def read(self, name: str, pencil: Pencil,
              extra_dims: Optional[Tuple[int, ...]] = None):
@@ -225,8 +240,7 @@ class OrbaxFile:
             self._pending_meta.clear()
             raise
         for name, meta in self._pending_meta.items():
-            with open(self._meta_path(name), "w") as f:
-                json.dump(meta, f, indent=1)
+            self._publish_meta(name, meta)
         self._pending_meta.clear()
 
     def close(self):

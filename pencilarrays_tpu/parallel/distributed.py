@@ -12,16 +12,31 @@ ICI *and* DCN automatically.
 This module wraps the bootstrap and the few host-aware queries the rest
 of the framework needs.  Single-process use (including the CPU test mesh)
 needs none of this — every function degrades to the trivial answer.
+
+Resilience (see ``docs/Resilience.md``): the coordinator connection is
+the first cross-process rendezvous of a job and the coordinator may
+simply not be up yet when a restarted worker arrives — so
+:func:`initialize` retries under a
+:class:`~pencilarrays_tpu.resilience.RetryPolicy` (bounded exponential
+backoff, not a hang and not a crash), guards against double
+initialization with a clear error instead of an opaque jax failure, and
+both it and :func:`sync_global_devices` consult the ``dist.initialize``
+/ ``barrier`` fault-injection points.
 """
 
 from __future__ import annotations
 
+import re
 from typing import Optional
 
 import jax
 
+from ..resilience import faults
+from ..resilience.retry import RetryPolicy
+
 __all__ = [
     "initialize",
+    "ensure_initialized",
     "is_initialized",
     "process_index",
     "process_count",
@@ -33,21 +48,141 @@ __all__ = [
 _initialized = False
 
 
+def _jax_already_initialized() -> bool:
+    """Probe jax's own distributed state (version-tolerant): True when a
+    coordinator client exists even if it was created outside this
+    module."""
+    state = getattr(jax.distributed, "global_state", None)
+    return getattr(state, "client", None) is not None
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None, **kw) -> None:
+               process_id: Optional[int] = None, *,
+               retry: Optional[RetryPolicy] = None, **kw) -> None:
     """Connect this process to the multi-host job
     (``jax.distributed.initialize``; on Cloud TPU all arguments are
     auto-detected from the metadata server).  Call before any jax API,
-    exactly once per process — the moral equivalent of ``MPI.Init``."""
+    exactly once per process — the moral equivalent of ``MPI.Init``.
+
+    Calling it twice raises a clear ``RuntimeError`` up front (instead
+    of an opaque failure from inside jax); use :func:`ensure_initialized`
+    for idempotent bootstrap paths like restart workers.  The
+    coordinator connection is retried on transient failures under
+    ``retry`` (default: env-tuned
+    :meth:`~pencilarrays_tpu.resilience.RetryPolicy.from_env`) — a
+    coordinator that is not up *yet* is backed off against, bounded by
+    the policy deadline.  ``_initialized`` flips only after the
+    connection succeeds."""
     global _initialized
-    jax.distributed.initialize(coordinator_address, num_processes,
-                               process_id, **kw)
+    if _initialized or _jax_already_initialized():
+        raise RuntimeError(
+            "distributed.initialize() called twice: jax.distributed is "
+            "already connected in this process.  Use ensure_initialized() "
+            "if the call site cannot know whether bootstrap already "
+            "happened (e.g. a restart worker).")
+    policy = retry or RetryPolicy.from_env()
+    # align jax's own connect timeout with the policy deadline (its
+    # default is 300 s, which would make a single attempt outlive the
+    # whole retry budget — the deadline is only checked between attempts)
+    kw.setdefault("initialization_timeout", max(1, int(policy.deadline)))
+
+    def _connect():
+        faults.fire("dist.initialize", coordinator=coordinator_address,
+                    process_id=process_id)
+        try:
+            jax.distributed.initialize(coordinator_address, num_processes,
+                                       process_id, **kw)
+        except RuntimeError as e:
+            # A failed connect leaves jax's global_state partially set
+            # (client/service created before connect()), which would make
+            # every retry die on jax's 'should only be called once' guard
+            # AND make is_initialized() lie — reset it first.
+            _reset_jax_partial_state()
+            # jax wraps coordinator-unreachable in RuntimeError; surface
+            # the TRANSIENT-looking ones as ConnectionError so the policy
+            # retries them, while config errors (bad address, mismatched
+            # process counts, already-initialized) still fail fast
+            if re.search(r"unavailable|refused|unreachable|reset|"
+                         r"connect|timed.?out|deadline",
+                         str(e), re.IGNORECASE):
+                raise ConnectionError(str(e)) from e
+            raise
+        except Exception:
+            _reset_jax_partial_state()
+            raise
+
+    policy.call(_connect, label="dist.initialize")
     _initialized = True
 
 
+def _reset_jax_partial_state() -> None:
+    """Best-effort rollback of a half-initialized ``jax.distributed``
+    ``global_state`` (client/service objects created before a failed
+    ``connect()``), releasing the coordinator port so a retry can bind
+    again.  Version-tolerant: unknown shapes are left untouched."""
+    state = getattr(jax.distributed, "global_state", None)
+    if state is None:
+        return
+    for attr in ("client", "service", "preemption_sync_manager"):
+        obj = getattr(state, attr, None)
+        if obj is None:
+            continue
+        try:
+            obj.shutdown()
+        except Exception:
+            pass
+        try:
+            setattr(state, attr, None)
+        except Exception:
+            pass
+    if getattr(state, "coordinator_address", None) is not None:
+        try:
+            state.coordinator_address = None
+        except Exception:
+            pass
+
+
+def _multihost_env() -> bool:
+    """Does the environment itself declare a multi-host job (Cloud TPU
+    pod metadata), so an argument-less bootstrap should auto-detect?"""
+    import os
+
+    return any(k in os.environ for k in (
+        "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID",
+        "MEGASCALE_COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS"))
+
+
+def ensure_initialized(coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None, **kw) -> bool:
+    """Idempotent :func:`initialize`: connect if (and only if) this
+    process is not yet part of the job.  Returns True when it actually
+    initialized.  No-op cases — so restart workers can call this
+    untouched whatever configuration they relaunch under:
+
+    * already connected (by us or by a direct jax call);
+    * an explicitly single-process configuration (``num_processes`` <= 1
+      with no coordinator address);
+    * no arguments at all *and* no pod-environment markers — a plain
+      local run.  On a Cloud TPU pod slice the metadata environment
+      (``TPU_WORKER_ID`` etc.) is detected and the argument-less
+      auto-bootstrap still happens, matching ``initialize()``'s
+      auto-detection contract."""
+    if is_initialized():
+        return False
+    if coordinator_address is None:
+        if num_processes is not None and num_processes <= 1:
+            return False  # explicitly single-process
+        if num_processes is None and process_id is None and not kw \
+                and not _multihost_env():
+            return False  # plain local run, nothing to auto-detect
+    initialize(coordinator_address, num_processes, process_id, **kw)
+    return True
+
+
 def is_initialized() -> bool:
-    return _initialized
+    return _initialized or _jax_already_initialized()
 
 
 def process_index() -> int:
@@ -68,7 +203,10 @@ def local_devices():
 
 
 def sync_global_devices(name: str = "pa_barrier") -> None:
-    """Cross-host barrier (``MPI.Barrier`` analog)."""
+    """Cross-host barrier (``MPI.Barrier`` analog).  Consults the
+    ``barrier`` fault point (before the single-process early-out, so
+    chaos tests can drill barrier failures on one process too)."""
+    faults.fire("barrier", name=name)
     if is_multiprocess():
         from jax.experimental import multihost_utils
 

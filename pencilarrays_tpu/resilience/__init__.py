@@ -1,0 +1,52 @@
+"""Resilience subsystem: crash-safe checkpoints, fault injection,
+retry/backoff.
+
+Three cooperating pieces (see ``docs/Resilience.md``):
+
+* :class:`CheckpointManager` — atomic, checksummed, GC'd checkpoints
+  layered over the I/O drivers (``checkpoint.py``);
+* :mod:`~pencilarrays_tpu.resilience.faults` — deterministic named
+  injection points consulted by the drivers and the distributed
+  runtime (``faults.py``);
+* :class:`RetryPolicy` — exponential backoff + jitter + deadline for
+  every cross-process rendezvous (``retry.py``).
+
+``checkpoint`` is imported lazily: the drivers and
+``parallel/distributed.py`` import this package for its errors/faults/
+retry pieces at module load, before ``pencilarrays_tpu.io`` exists.
+"""
+
+from .errors import (  # noqa: F401
+    CheckpointNotFoundError,
+    CorruptCheckpointError,
+    CorruptSidecarError,
+    InjectedFault,
+    ResilienceError,
+    RetryDeadlineExceeded,
+)
+from . import faults  # noqa: F401
+from .retry import RetryPolicy, is_transient  # noqa: F401
+
+__all__ = [
+    "CheckpointManager",
+    "Checkpoint",
+    "CheckpointNotFoundError",
+    "CorruptCheckpointError",
+    "CorruptSidecarError",
+    "InjectedFault",
+    "ResilienceError",
+    "RetryDeadlineExceeded",
+    "RetryPolicy",
+    "is_transient",
+    "faults",
+]
+
+_LAZY = ("CheckpointManager", "Checkpoint")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from . import checkpoint
+
+        return getattr(checkpoint, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,245 @@
+"""Deterministic fault injection for the distributed runtime.
+
+The I/O drivers, the checkpoint manager and ``parallel/distributed.py``
+consult named **injection points** at their failure-critical moments, so
+tests (and chaos drills) can simulate torn writes, crash-before-commit
+and transient ``OSError`` storms *without monkeypatching internals* —
+and so a worker subprocess can be killed mid-write purely through its
+environment.
+
+Registered points (see ``docs/Resilience.md``):
+
+========================  ====================================================
+``io.open``               driver ``open`` (before the file is touched)
+``io.write_block``        one per-shard block about to hit the data file
+``io.flush_meta``         a sidecar/metadata flush (the commit point of a
+                          driver-level write)
+``ckpt.commit``           the checkpoint manager about to commit (rename +
+                          COMMIT marker)
+``dist.initialize``       the coordinator connection inside
+                          ``distributed.initialize``
+``barrier``               ``sync_global_devices`` (ctx carries the name)
+========================  ====================================================
+
+Rules are **counter-based, never random** — the same spec replays the
+same failure.  Spec grammar (comma/semicolon-separated)::
+
+    point:mode[*times][@nth]
+
+* ``mode`` — ``error`` (raise :class:`InjectedFault`), ``kill``
+  (``SIGKILL`` this process: the un-catchable crash), ``torn``
+  (cooperative: the call site writes a partial block, then dies).
+* ``*times`` — trigger on that many consecutive hits (default: ``error``
+  forever, ``kill``/``torn`` once).
+* ``@nth`` — first trigger on the *nth* hit of the point (1-based,
+  default 1): ``io.write_block:torn@3`` tears the third block.
+
+Sources, in precedence order: rules installed programmatically
+(:func:`install` / the :func:`active` context manager), else the
+``PENCILARRAYS_TPU_FAULTS`` environment variable (re-read whenever it
+changes, so a worker can arm itself after import).  Example::
+
+    PENCILARRAYS_TPU_FAULTS="io.write_block:torn@3,dist.initialize:error*3"
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from .errors import InjectedFault
+
+__all__ = [
+    "POINTS",
+    "Rule",
+    "parse",
+    "install",
+    "clear",
+    "reset_counters",
+    "active",
+    "armed",
+    "fire",
+    "block_write_hook",
+    "kill_now",
+    "ENV_VAR",
+]
+
+ENV_VAR = "PENCILARRAYS_TPU_FAULTS"
+
+POINTS = frozenset({
+    "io.open",
+    "io.write_block",
+    "io.flush_meta",
+    "ckpt.commit",
+    "dist.initialize",
+    "barrier",
+})
+
+MODES = frozenset({"error", "kill", "torn"})
+
+
+@dataclass(frozen=True)
+class Rule:
+    point: str
+    mode: str                  # "error" | "kill" | "torn"
+    times: Optional[int]       # consecutive triggering hits (None = forever)
+    first: int = 1             # 1-based hit index of the first trigger
+
+    def triggers(self, hit: int) -> bool:
+        if hit < self.first:
+            return False
+        return self.times is None or hit < self.first + self.times
+
+
+def parse(spec: str) -> List[Rule]:
+    """Parse a spec string into rules (grammar in the module docstring)."""
+    rules = []
+    for raw in spec.replace(";", ",").split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            point, rhs = raw.split(":", 1)
+        except ValueError:
+            raise ValueError(f"fault rule {raw!r}: expected point:mode")
+        point = point.strip()
+        if point not in POINTS:
+            raise ValueError(
+                f"unknown injection point {point!r}; registered points: "
+                f"{sorted(POINTS)}")
+        first = 1
+        if "@" in rhs:
+            rhs, nth = rhs.rsplit("@", 1)
+            first = int(nth)
+            if first < 1:
+                raise ValueError(f"fault rule {raw!r}: @nth is 1-based")
+        times: Optional[int]
+        if "*" in rhs:
+            mode, n = rhs.split("*", 1)
+            times = int(n)
+        else:
+            mode, times = rhs, None
+        mode = mode.strip()
+        if mode not in MODES:
+            raise ValueError(
+                f"fault rule {raw!r}: mode {mode!r} not in {sorted(MODES)}")
+        if times is None and mode in ("kill", "torn"):
+            times = 1  # a crash repeats at most per-process anyway
+        rules.append(Rule(point, mode, times, first))
+    return rules
+
+
+# programmatic rules (highest precedence) + per-point hit counters
+_rules: Optional[List[Rule]] = None
+_env_cache: Optional[str] = None
+_env_rules: List[Rule] = []
+_hits: Dict[str, int] = {}
+
+
+def install(spec) -> None:
+    """Install rules programmatically (a spec string or ``Rule`` list);
+    takes precedence over the environment until :func:`clear`."""
+    global _rules
+    _rules = parse(spec) if isinstance(spec, str) else list(spec)
+    reset_counters()
+
+
+def clear() -> None:
+    """Drop programmatic rules (environment rules apply again)."""
+    global _rules
+    _rules = None
+    reset_counters()
+
+
+def reset_counters() -> None:
+    _hits.clear()
+
+
+@contextmanager
+def active(spec):
+    """Scope rules to a ``with`` block (the test-friendly entry point)."""
+    global _rules
+    prev = _rules
+    install(spec)
+    try:
+        yield
+    finally:
+        _rules = prev
+        reset_counters()
+
+
+def _current_rules() -> Sequence[Rule]:
+    if _rules is not None:
+        return _rules
+    global _env_cache, _env_rules
+    env = os.environ.get(ENV_VAR, "")
+    if env != _env_cache:          # re-read on change: workers arm late
+        _env_cache = env
+        _env_rules = parse(env) if env else []
+    return _env_rules
+
+
+def armed(point: str) -> bool:
+    """Cheap probe: does any current rule target ``point``?  Hot paths
+    use this to keep their no-faults fast path untouched (e.g. the
+    binary writer's in-thread block copies)."""
+    return any(r.point == point for r in _current_rules())
+
+
+def kill_now() -> None:
+    """SIGKILL this process — the un-catchable crash (no atexit, no
+    flush): what a preempted TPU worker actually looks like."""
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def block_write_hook(i, start, block, block_observer, put, *,
+                     flush=None, in_flight=(), **ctx) -> None:
+    """The per-block injection + checksum hook every driver write path
+    shares (ONE implementation of the torn semantics).  Fires
+    ``io.write_block``; on a ``torn`` rule it orders any in-flight
+    writes, writes a prefix of the block's leading-dim rows via ``put``,
+    flushes, and SIGKILLs — the mid-checkpoint crash the resilience
+    tests drill.  Otherwise it feeds the optional ``block_observer``
+    (the checkpoint manager's checksum tap)."""
+    act = fire("io.write_block", block=i, **ctx)
+    if act == "torn":
+        for fu in in_flight:  # order the tear after earlier blocks
+            fu.result()
+        put(start, block[: max(1, block.shape[0] // 2)])
+        if flush is not None:
+            flush()
+        kill_now()
+    if block_observer is not None:
+        block_observer(start, block)
+
+
+def fire(point: str, **ctx) -> Optional[str]:
+    """Consult the injection point.  Returns ``None`` (the overwhelmingly
+    common no-fault case), raises :class:`InjectedFault` (``error``),
+    never returns (``kill``), or returns ``"torn"`` — a cooperative mode
+    the call site honors by writing a partial block and then calling
+    :func:`kill_now`.  Sites that cannot tear treat ``"torn"`` as
+    ``kill``."""
+    rules = _current_rules()
+    if not rules:
+        return None
+    matching = [r for r in rules if r.point == point]
+    if not matching:
+        return None
+    hit = _hits.get(point, 0) + 1
+    _hits[point] = hit
+    for r in matching:
+        if not r.triggers(hit):
+            continue
+        if r.mode == "kill":
+            kill_now()
+        if r.mode == "torn":
+            return "torn"
+        where = f" [{ctx}]" if ctx else ""
+        raise InjectedFault(
+            f"injected fault at {point} (hit {hit}){where}",
+            point=point, hit=hit)
+    return None

@@ -1,11 +1,13 @@
-"""Worker for the cross-process-count restart test.
+"""Worker for the cross-process-count restart tests.
 
 The reference's discontiguous MPI-IO layout exists precisely so a file
 can be "read back using a different number or distribution of MPI
 processes" (``src/PencilIO/mpi_io.jl:159-167``).  The TPU analog must
-hold across *process counts*, not just decompositions: this worker is
-launched by ``test_multiprocess.py::test_restart_across_process_counts``
-in three phases —
+hold across *process counts*, not just decompositions — and, for the
+resilience subsystem, across *crashes*: a worker SIGKILLed mid-write
+must leave the previous committed checkpoint restorable bit-for-bit.
+
+Phases (launched by ``test_multiprocess.py``):
 
 * ``write`` under 4 processes (2 devices each): binary + HDF5 (shard
   files + virtual-dataset master), pencil decomposed (1, 2) with a
@@ -13,10 +15,18 @@ in three phases —
 * ``read2`` under 2 processes (4 devices each): re-read both files onto
   a DIFFERENT decomposition (0, 2) on a different mesh shape;
 * ``read1`` single-process (8 local devices, no ``jax.distributed``):
-  re-read onto a 1-D slab decomposition.
+  re-read onto a 1-D slab decomposition;
+* ``ckpt``: commit checkpoint step 1 (ground truth) through
+  ``resilience.CheckpointManager`` (checksummed manifest + COMMIT);
+* ``killwrite``: arm the ``io.write_block:torn@3`` fault and attempt
+  checkpoint step 2 — the process tears the third block and SIGKILLs
+  itself mid-write (the launcher asserts the signal death);
+* ``recover``: assert ``latest_valid()`` skips the torn step-2 temp
+  wreckage, restores step 1, and the recovered global array is
+  bit-identical to the deterministic ground truth.
 
-Every phase checks the gathered global array bit-for-bit against the
-deterministic ground truth regenerated from the shared seed.
+Every phase checks gathered global arrays bit-for-bit against the
+ground truth regenerated from the shared seed.
 
 Usage::
 
@@ -39,20 +49,25 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
-    if nprocs > 1:
-        jax.distributed.initialize(coordinator, num_processes=nprocs,
-                                   process_id=pid)
     import numpy as np
 
     import pencilarrays_tpu as pa
     from pencilarrays_tpu.io import (BinaryDriver, HDF5Driver, has_hdf5,
                                      open_file)
 
+    # idempotent bootstrap: a no-op when nprocs == 1, a retried
+    # coordinator connection otherwise — restart workers call this
+    # unconditionally instead of tracking whether init already happened
+    pa.distributed.ensure_initialized(
+        None if coordinator == "-" else coordinator,
+        num_processes=nprocs, process_id=pid)
+
     assert len(jax.devices()) == 8
     shape = (11, 9, 13)  # ragged: every mesh below pads some dim
     truth = np.random.default_rng(11).standard_normal(shape)
     bpath = os.path.join(tmpdir, "restart.bin")
     hpath = os.path.join(tmpdir, "restart.h5")
+    ckdir = os.path.join(tmpdir, "ckpts")
 
     if phase == "write":
         topo = pa.Topology((2, 4))
@@ -67,6 +82,44 @@ def main():
                 f.write("u", u)
         if nprocs > 1:
             pa.distributed.sync_global_devices("write_done")
+    elif phase in ("ckpt", "killwrite"):
+        from pencilarrays_tpu.resilience import CheckpointManager, faults
+
+        topo = pa.Topology((2, 4))
+        pen = pa.Pencil(topo, shape, (1, 2),
+                        permutation=pa.Permutation(2, 0, 1))
+        u = pa.PencilArray.from_global(pen, truth)
+        mgr = CheckpointManager(ckdir, keep=3)
+        if phase == "ckpt":
+            mgr.save(1, {"u": u})
+            assert mgr.latest_valid() == 1
+            if nprocs > 1:
+                pa.distributed.sync_global_devices("ckpt_done")
+        else:
+            # arm AFTER import (the env is re-read on change) and tear
+            # a mid-stream block: SIGKILL mid-checkpoint-write.  Each
+            # process streams 8/nprocs blocks, so pick a tear point that
+            # exists for every process.
+            tear = 3 if nprocs == 1 else 2
+            os.environ[faults.ENV_VAR] = f"io.write_block:torn@{tear}"
+            garbage = pa.PencilArray.from_global(
+                pen, truth + 1000.0)  # step 2 must NOT survive
+            mgr.save(2, {"u": garbage})
+            raise SystemExit("unreachable: torn injection did not kill")
+    elif phase == "recover":
+        from pencilarrays_tpu.resilience import CheckpointManager
+
+        mgr = CheckpointManager(ckdir, keep=3)
+        # the torn step-2 attempt must be invisible: only its temp
+        # directory (never renamed, never committed) may remain
+        assert mgr.latest_valid() == 1, mgr.steps()
+        topo = pa.Topology((8,))
+        pen = pa.Pencil(topo, shape, (1,))
+        back = mgr.restore().read("u", pen)
+        assert np.array_equal(pa.gather(back), truth), \
+            "recovered checkpoint is not bit-identical to ground truth"
+        if nprocs > 1:
+            pa.distributed.sync_global_devices("recover_done")
     else:
         if phase == "read2":
             topo = pa.Topology((4, 2))

@@ -1,0 +1,633 @@
+"""Resilience subsystem tests: crash-safe checksummed checkpoints,
+deterministic fault injection, retry/backoff, and the truncation fuzz —
+the single-process half of the failure-path story (the SIGKILL
+subprocess drills live in ``test_multiprocess.py``).
+
+The load-bearing property, asserted by the fuzz test: a corrupted
+checkpoint NEVER yields garbage data — every failure surfaces as a
+typed :class:`ResilienceError`, and ``latest_valid()`` falls back to an
+older intact checkpoint or ``None``."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from pencilarrays_tpu import Pencil, PencilArray, Permutation, Topology, gather
+from pencilarrays_tpu.io import BinaryDriver, HDF5Driver, has_hdf5, open_file
+from pencilarrays_tpu.parallel import distributed
+from pencilarrays_tpu.resilience import (
+    CheckpointManager,
+    CheckpointNotFoundError,
+    CorruptCheckpointError,
+    CorruptSidecarError,
+    InjectedFault,
+    ResilienceError,
+    RetryDeadlineExceeded,
+    RetryPolicy,
+    faults,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def topo(devices):
+    return Topology((2, 4))
+
+
+@pytest.fixture
+def pen(topo):
+    return Pencil(topo, (11, 13, 10), (1, 2), permutation=Permutation(2, 0, 1))
+
+
+def make_data(pen, extra=(), seed=0, dtype=np.float64):
+    shape = pen.size_global() + extra
+    u = np.random.default_rng(seed).standard_normal(shape).astype(dtype)
+    return u, PencilArray.from_global(pen, u)
+
+
+# -- faults ----------------------------------------------------------------
+def test_fault_spec_parsing():
+    r, = faults.parse("io.write_block:torn@3")
+    assert (r.point, r.mode, r.times, r.first) == ("io.write_block", "torn",
+                                                   1, 3)
+    r1, r2 = faults.parse("dist.initialize:error*3, barrier:kill@2")
+    assert (r1.mode, r1.times, r1.first) == ("error", 3, 1)
+    assert (r2.mode, r2.times, r2.first) == ("kill", 1, 2)
+    with pytest.raises(ValueError, match="unknown injection point"):
+        faults.parse("io.wrte_block:error")
+    with pytest.raises(ValueError, match="mode"):
+        faults.parse("barrier:explode")
+
+
+def test_fault_counters_are_deterministic():
+    with faults.active("io.flush_meta:error*2@2"):
+        faults.fire("io.flush_meta")  # hit 1: passes
+        for _ in range(2):            # hits 2-3: trigger
+            with pytest.raises(InjectedFault):
+                faults.fire("io.flush_meta")
+        faults.fire("io.flush_meta")  # hit 4: exhausted, passes
+        faults.fire("io.open")        # other points untouched
+    faults.fire("io.flush_meta")      # rules cleared
+
+
+def test_injected_fault_is_transient_oserror():
+    from pencilarrays_tpu.resilience import is_transient
+
+    with faults.active("barrier:error"):
+        with pytest.raises(InjectedFault) as ei:
+            distributed.sync_global_devices("probe")
+    assert isinstance(ei.value, OSError)
+    assert isinstance(ei.value, ResilienceError)
+    assert is_transient(ei.value)
+
+
+def test_fault_env_rearm(monkeypatch):
+    """The env spec is re-read when it changes — a worker can arm itself
+    after import (the killwrite phase relies on this)."""
+    monkeypatch.setenv(faults.ENV_VAR, "io.open:error")
+    with pytest.raises(InjectedFault):
+        faults.fire("io.open")
+    monkeypatch.setenv(faults.ENV_VAR, "")
+    faults.fire("io.open")
+
+
+# -- retry -----------------------------------------------------------------
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("not up yet")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.001, deadline=5.0)
+    assert policy.call(flaky, label="flaky") == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_does_not_touch_nontransient():
+    def boom():
+        raise FileNotFoundError("missing is not transient")
+
+    with pytest.raises(FileNotFoundError):
+        RetryPolicy(max_attempts=5, base_delay=0.001).call(boom)
+
+
+def test_retry_deadline_exceeded():
+    def always():
+        raise ConnectionError("down")
+
+    policy = RetryPolicy(max_attempts=100, base_delay=0.2, max_delay=0.2,
+                         deadline=0.05)
+    with pytest.raises(RetryDeadlineExceeded) as ei:
+        policy.call(always, label="down-service")
+    assert isinstance(ei.value.__cause__, ConnectionError)
+
+
+def test_retry_exhausts_attempts_reraises_original():
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        RetryPolicy(max_attempts=3, base_delay=0.001).call(always)
+
+
+def test_retry_policy_env_knobs(monkeypatch):
+    monkeypatch.setenv("PENCILARRAYS_TPU_RETRIES", "7")
+    monkeypatch.setenv("PENCILARRAYS_TPU_RETRY_DEADLINE", "1.5")
+    p = RetryPolicy.from_env()
+    assert p.max_attempts == 7 and p.deadline == 1.5
+
+
+# -- distributed guards ----------------------------------------------------
+def test_initialize_retries_transient_then_succeeds(monkeypatch):
+    """``dist.initialize`` under an injected transient failure succeeds
+    within the retry deadline instead of crashing (acceptance
+    criterion)."""
+    import jax
+
+    connected = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda *a, **k: connected.append(a))
+    monkeypatch.setattr(distributed, "_initialized", False)
+    policy = RetryPolicy(max_attempts=10, base_delay=0.001, deadline=10.0)
+    with faults.active("dist.initialize:error*3"):
+        distributed.initialize("127.0.0.1:1", 1, 0, retry=policy)
+    assert len(connected) == 1
+    assert distributed.is_initialized()
+    # double-init is a clear error up front, not an opaque jax failure
+    with pytest.raises(RuntimeError, match="ensure_initialized"):
+        distributed.initialize("127.0.0.1:1", 1, 0)
+    # ...and the idempotent path is a no-op
+    assert distributed.ensure_initialized("127.0.0.1:1", 1, 0) is False
+
+
+def test_initialize_deadline_bounds_persistent_failure(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda *a, **k: None)
+    monkeypatch.setattr(distributed, "_initialized", False)
+    policy = RetryPolicy(max_attempts=100, base_delay=0.2, max_delay=0.2,
+                         deadline=0.05)
+    with faults.active("dist.initialize:error"):
+        with pytest.raises(RetryDeadlineExceeded):
+            distributed.initialize("127.0.0.1:1", 1, 0, retry=policy)
+    assert not distributed._initialized  # only set on success
+
+
+def test_initialize_retry_resets_partial_jax_state(monkeypatch):
+    """jax's State.initialize creates client/service BEFORE connect();
+    a failed connect leaves them set, and without a rollback every
+    retry would die on jax's 'should only be called once' guard while
+    is_initialized() lied.  Simulate that exact state machine."""
+    import jax
+
+    class FakeHandle:
+        def __init__(self):
+            self.shut = False
+
+        def shutdown(self):
+            self.shut = True
+
+    class FakeState:
+        client = None
+        service = None
+        preemption_sync_manager = None
+        coordinator_address = None
+
+    state = FakeState()
+    attempts = []
+
+    def fake_init(*a, **k):
+        if state.client is not None:
+            raise RuntimeError(
+                "distributed.initialize should only be called once.")
+        state.client = FakeHandle()  # set BEFORE the connect...
+        state.service = FakeHandle()
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RuntimeError(
+                "timed out connecting to coordinator")  # ...which fails
+
+    monkeypatch.setattr(jax.distributed, "global_state", state,
+                        raising=False)
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(distributed, "_initialized", False)
+    fast = RetryPolicy(max_attempts=5, base_delay=0.001, deadline=5.0)
+    distributed.initialize("127.0.0.1:1", 2, 0, retry=fast)
+    assert len(attempts) == 3
+    assert distributed.is_initialized()
+    assert state.client is not None  # the successful connection survives
+
+
+def test_initialize_runtime_error_classification(monkeypatch):
+    """Transient-looking RuntimeErrors from jax (coordinator not up yet)
+    are retried; config errors fail fast on the first attempt."""
+    import jax
+
+    calls = []
+
+    def flaky(*a, **k):
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError(
+                "DEADLINE_EXCEEDED: timed out connecting to coordinator")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky)
+    monkeypatch.setattr(distributed, "_initialized", False)
+    fast = RetryPolicy(max_attempts=5, base_delay=0.001, deadline=5.0)
+    distributed.initialize("127.0.0.1:1", 1, 0, retry=fast)
+    assert len(calls) == 3
+
+    bad_calls = []
+
+    def bad(*a, **k):
+        bad_calls.append(1)
+        raise RuntimeError("process_id 7 out of range")
+
+    monkeypatch.setattr(jax.distributed, "initialize", bad)
+    monkeypatch.setattr(distributed, "_initialized", False)
+    with pytest.raises(RuntimeError, match="out of range"):
+        distributed.initialize("127.0.0.1:1", 1, 0, retry=fast)
+    assert len(bad_calls) == 1  # no useless backoff on a config error
+
+
+def test_ensure_initialized_single_process_noop():
+    assert distributed.ensure_initialized(None, num_processes=1,
+                                          process_id=0) is False
+    assert distributed.ensure_initialized() is False
+
+
+def test_ensure_initialized_autodetects_pod_env(monkeypatch):
+    """On a Cloud TPU pod (metadata env markers present) the
+    argument-less ensure_initialized still runs the auto-detected
+    bootstrap instead of silently acting single-process."""
+    import jax
+
+    connected = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda *a, **k: connected.append(a))
+    monkeypatch.setattr(distributed, "_initialized", False)
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    assert distributed.ensure_initialized() is True
+    assert len(connected) == 1
+    # explicit single-process stays a no-op even on a pod machine
+    monkeypatch.setattr(distributed, "_initialized", False)
+    assert distributed.ensure_initialized(num_processes=1) is False
+
+
+# -- corrupt sidecar (satellite) -------------------------------------------
+def test_corrupt_sidecar_is_typed_error(tmp_path, pen):
+    u, x = make_data(pen)
+    path = str(tmp_path / "data.bin")
+    with open_file(BinaryDriver(), path, write=True, create=True) as f:
+        f.write("u", x)
+    with open(path + ".json", "w") as f:
+        f.write('{"datasets": [{"name": "u", "off')  # truncated mid-JSON
+    with pytest.raises(CorruptSidecarError, match="latest_valid"):
+        open_file(BinaryDriver(), path, read=True).__enter__()
+
+
+# -- checkpoint manager ----------------------------------------------------
+def test_checkpoint_roundtrip_and_layout(tmp_path, pen, topo):
+    u, x = make_data(pen, seed=1)
+    v, y = make_data(pen, extra=(2,), seed=2)
+    mgr = CheckpointManager(str(tmp_path), keep=4)
+    p = mgr.save(7, {"u": x, "v": y})
+    assert sorted(os.listdir(p)) == ["COMMIT", "MANIFEST.json", "data.bin",
+                                     "data.bin.json"]
+    with open(os.path.join(p, "MANIFEST.json")) as f:
+        mf = json.load(f)
+    assert mf["step"] == 7 and mf["driver"] == "BinaryDriver"
+    assert set(mf["datasets"]) == {"u", "v"}
+    blocks = mf["datasets"]["u"]["blocks"]
+    assert blocks and all({"start", "shape", "crc"} <= set(b) for b in blocks)
+    # blocks tile the global array exactly
+    assert sum(int(np.prod(b["shape"])) for b in blocks) == u.size
+
+    mgr.verify(7)
+    assert mgr.latest_valid() == 7
+    ck = mgr.restore()
+    assert ck.datasets == ["u", "v"]
+    # restore under different decompositions (the drivers' contract)
+    pen2 = Pencil(topo, (11, 13, 10), (0, 1))
+    pen3 = Pencil(Topology((8,)), (11, 13, 10), (1,))
+    np.testing.assert_array_equal(gather(ck.read("u", pen2)), u)
+    np.testing.assert_array_equal(gather(ck.read("v", pen3)), v)
+
+
+def test_checkpoint_collections(tmp_path, pen, topo):
+    fields = [make_data(pen, seed=20 + i) for i in range(3)]
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"state": tuple(x for _, x in fields)})
+    pen2 = Pencil(topo, (11, 13, 10), (0, 2))
+    back = mgr.restore().read("state", pen2)
+    assert isinstance(back, tuple) and len(back) == 3
+    for (u, _), b in zip(fields, back):
+        np.testing.assert_array_equal(gather(b), u)
+
+
+def test_checkpoint_retention_gc(tmp_path, pen):
+    _, x = make_data(pen)
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    for step in (1, 2, 3, 4):
+        mgr.save(step, {"u": x})
+    assert mgr.steps() == [3, 4]
+    assert sorted(os.listdir(tmp_path)) == ["step-00000003", "step-00000004"]
+
+
+def test_checkpoint_uncommitted_is_skipped(tmp_path, pen):
+    u, x = make_data(pen, seed=3)
+    w, z = make_data(pen, seed=4)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"u": x})
+    p2 = mgr.save(2, {"u": z})
+    os.unlink(os.path.join(p2, "COMMIT"))  # simulate crash-before-commit
+    assert mgr.latest_valid() == 1
+    np.testing.assert_array_equal(gather(mgr.restore().read("u", pen)), u)
+    with pytest.raises(CheckpointNotFoundError):
+        mgr.restore(2)
+    # ...and the next save's GC sweeps the torn directory
+    mgr.save(3, {"u": x})
+    assert not os.path.exists(p2)
+
+
+def test_resave_same_step_never_destroys_committed_copy(tmp_path, pen):
+    """Re-saving an existing committed step moves the old directory
+    aside instead of deleting it, so no crash window destroys the only
+    copy; a clean re-save replaces the content and leaves no debris."""
+    u, x = make_data(pen, seed=16)
+    v, y = make_data(pen, seed=17)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"u": x})
+    mgr.save(1, {"u": y})  # clean replace
+    assert mgr.steps() == [1]
+    assert sorted(os.listdir(tmp_path)) == ["step-00000001"]
+    np.testing.assert_array_equal(gather(mgr.restore(1).read("u", pen)), v)
+
+
+def test_unknown_manifest_algo_degrades_not_fails(tmp_path, pen):
+    """A checkpoint whose checksum algorithm this host cannot compute is
+    NOT falsely failed: verification degrades to structural checks."""
+    u, x = make_data(pen, seed=18)
+    mgr = CheckpointManager(str(tmp_path))
+    p = mgr.save(1, {"u": x})
+    mpath = os.path.join(p, "MANIFEST.json")
+    with open(mpath) as f:
+        mf = json.load(f)
+    mf["algo"] = "crc64-nvme"  # written by some future host
+    with open(mpath, "w") as f:
+        json.dump(mf, f)
+    mgr.verify(1)  # structural only, no false CorruptCheckpointError
+    assert mgr.latest_valid() == 1
+    np.testing.assert_array_equal(gather(mgr.restore().read("u", pen)), u)
+
+
+def test_checkpoint_crash_before_commit_fault(tmp_path, pen):
+    """``ckpt.commit:error`` aborts the save between manifest flush and
+    rename: the temp directory never becomes visible and the previous
+    checkpoint survives."""
+    u, x = make_data(pen, seed=5)
+    _, z = make_data(pen, seed=6)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"u": x})
+    with faults.active("ckpt.commit:error"):
+        with pytest.raises(InjectedFault):
+            mgr.save(2, {"u": z})
+    assert mgr.latest_valid() == 1
+    assert not os.path.exists(mgr._step_dir(2))
+    np.testing.assert_array_equal(gather(mgr.restore().read("u", pen)), u)
+
+
+def test_checkpoint_transient_flush_faults_are_retried(tmp_path, pen):
+    """A transient error at the sidecar flush and at the driver open is
+    absorbed by the retry policy — the save/restore still succeeds."""
+    u, x = make_data(pen, seed=7)
+    fast = RetryPolicy(max_attempts=5, base_delay=0.001, deadline=5.0)
+    mgr = CheckpointManager(str(tmp_path), retry=fast)
+    with faults.active("io.flush_meta:error*1, io.open:error*1"):
+        mgr.save(1, {"u": x})
+    assert mgr.latest_valid() == 1
+    np.testing.assert_array_equal(gather(mgr.restore().read("u", pen)), u)
+
+
+def test_checkpoint_corruption_names_dataset_and_block(tmp_path, pen):
+    u, x = make_data(pen, seed=8)
+    v, y = make_data(pen, seed=9)
+    mgr = CheckpointManager(str(tmp_path))
+    p = mgr.save(1, {"u": x, "v": y})
+    with open(os.path.join(p, "data.bin.json")) as f:
+        d = next(d for d in json.load(f)["datasets"] if d["name"] == "v")
+    with open(os.path.join(p, "data.bin"), "r+b") as f:
+        f.seek(d["offset_bytes"] + 128)
+        b = f.read(1)
+        f.seek(d["offset_bytes"] + 128)
+        f.write(bytes([b[0] ^ 0x01]))
+    with pytest.raises(CorruptCheckpointError, match=r"'v' block \d+"):
+        mgr.verify(1)
+    try:
+        mgr.verify(1)
+    except CorruptCheckpointError as e:
+        assert e.dataset == "v" and e.block is not None and e.step == 1
+    # the reader refuses to hand out the corrupt dataset...
+    with pytest.raises(CorruptCheckpointError):
+        mgr.restore(1).read("v", pen)
+    # ...but verification is per-dataset: the intact one still restores
+    np.testing.assert_array_equal(gather(mgr.restore(1).read("u", pen)), u)
+    assert mgr.latest_valid() is None
+    with pytest.raises(CheckpointNotFoundError):
+        mgr.restore()
+
+
+def test_checkpoint_hdf5_driver(tmp_path, pen, topo):
+    if not has_hdf5():
+        pytest.skip("h5py unavailable")
+    u, x = make_data(pen, seed=10)
+    mgr = CheckpointManager(str(tmp_path), driver=HDF5Driver())
+    p = mgr.save(1, {"u": x})
+    assert os.path.exists(os.path.join(p, "data.h5"))
+    mgr.verify(1)
+    pen2 = Pencil(topo, (11, 13, 10), (0, 1))
+    np.testing.assert_array_equal(gather(mgr.restore().read("u", pen2)), u)
+    # flip one byte inside the dataset's storage (h5py exposes the
+    # contiguous dataset's file offset)
+    import h5py
+
+    with h5py.File(os.path.join(p, "data.h5"), "r") as h:
+        off = h["u"].id.get_offset()
+    assert off is not None
+    with open(os.path.join(p, "data.h5"), "r+b") as f:
+        f.seek(off + 40)
+        b = f.read(1)
+        f.seek(off + 40)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(ResilienceError):
+        mgr.verify(1)
+
+
+def test_checkpoint_checksums_off(tmp_path, pen):
+    u, x = make_data(pen, seed=11)
+    mgr = CheckpointManager(str(tmp_path), checksums=False)
+    p = mgr.save(1, {"u": x})
+    with open(os.path.join(p, "MANIFEST.json")) as f:
+        mf = json.load(f)
+    assert mf["algo"] is None and mf["datasets"]["u"]["blocks"] is None
+    assert mgr.latest_valid() == 1  # commit + metadata checks still apply
+    np.testing.assert_array_equal(gather(mgr.restore().read("u", pen)), u)
+    # a silent bit flip is the documented cost of checksums=False: the
+    # manager still refuses STRUCTURALLY broken checkpoints (sidecar)
+    with open(os.path.join(p, "data.bin.json"), "w") as f:
+        f.write("{not json")
+    assert mgr.latest_valid() is None
+
+
+def test_checksums_off_validates_chunks_and_orbax_layouts(tmp_path, pen):
+    """Checksums-off verification is structural only and must accept
+    layouts the block reader cannot describe: a chunks-layout binary
+    checkpoint and an Orbax checkpoint both verify and restore."""
+    from pencilarrays_tpu.io import OrbaxDriver, has_orbax
+
+    u, x = make_data(pen, seed=21)
+    mgr = CheckpointManager(str(tmp_path / "ck"), checksums=False)
+    mgr.save(0, {"u": x}, chunks=True)
+    assert mgr.latest_valid() == 0
+    np.testing.assert_array_equal(gather(mgr.restore().read("u", pen)), u)
+    if has_orbax():
+        mgro = CheckpointManager(str(tmp_path / "cko"),
+                                 driver=OrbaxDriver(), checksums=False)
+        mgro.save(0, {"u": x})
+        assert mgro.latest_valid() == 0
+        np.testing.assert_array_equal(
+            gather(mgro.restore().read("u", pen)), u)
+
+
+def test_interrupted_resave_is_recovered(tmp_path, pen):
+    """Simulate a crash between moving the old committed step aside and
+    committing its replacement: latest_valid() recovers the moved-aside
+    copy instead of losing the step (and GC must not sweep it)."""
+    u, x = make_data(pen, seed=22)
+    _, y = make_data(pen, seed=23)
+    mgr = CheckpointManager(str(tmp_path), keep=1)
+    p = mgr.save(5, {"u": x})
+    # crash mid-re-save: old dir parked in the -replaced namespace, torn
+    # replacement present without COMMIT
+    os.rename(p, str(tmp_path / ".tmp-step-00000005-replaced"))
+    os.makedirs(p)
+    with open(os.path.join(p, "data.bin"), "wb") as f:
+        f.write(b"torn")
+    assert mgr.latest_valid() == 5  # recovered, not lost
+    np.testing.assert_array_equal(gather(mgr.restore(5).read("u", pen)), u)
+    mgr.save(6, {"u": y})  # next save's GC leaves the recovered world sane
+    assert mgr.steps() == [6]  # keep=1
+
+
+def test_checkpoint_rejects_bad_configs(tmp_path, pen):
+    from pencilarrays_tpu.io import OrbaxDriver
+
+    _, x = make_data(pen)
+    with pytest.raises(ValueError, match="checksums"):
+        CheckpointManager(str(tmp_path), driver=OrbaxDriver())
+    mgr = CheckpointManager(str(tmp_path))
+    with pytest.raises(ValueError, match="chunks"):
+        mgr.save(1, {"u": x}, chunks=True)
+    if has_hdf5():
+        mgr_h = CheckpointManager(str(tmp_path), driver=HDF5Driver(),
+                                  checksums=False)
+        with pytest.raises(ValueError, match="BinaryDriver layout"):
+            mgr_h.save(1, {"u": x}, chunks=True)
+    with pytest.raises(ValueError, match="empty"):
+        mgr.save(1, {})
+    with pytest.raises(CheckpointNotFoundError):
+        mgr.restore()
+
+
+# -- the truncation/corruption fuzz ---------------------------------------
+def test_truncation_fuzz_never_returns_garbage(tmp_path, pen):
+    """Truncate/corrupt checkpoint files at seeded random offsets: every
+    outcome is either a bit-identical restore of an INTACT checkpoint or
+    a typed ResilienceError — never silently wrong data (acceptance
+    criterion)."""
+    u, x = make_data(pen, seed=12)
+    pristine = str(tmp_path / "pristine")
+    mgr0 = CheckpointManager(pristine, keep=1)
+    mgr0.save(1, {"u": x})
+
+    rng = np.random.default_rng(2026)
+    targets = ["data.bin", "data.bin.json", "MANIFEST.json", "COMMIT"]
+    outcomes = {"restored": 0, "typed_error": 0}
+    for trial in range(24):
+        work = str(tmp_path / f"fuzz{trial}")
+        shutil.copytree(os.path.join(pristine, "step-00000001"),
+                        os.path.join(work, "step-00000001"))
+        victim = os.path.join(work, "step-00000001",
+                              targets[trial % len(targets)])
+        size = os.path.getsize(victim)
+        mode = ["truncate", "flip", "zero"][trial % 3]
+        with open(victim, "r+b") as f:
+            if mode == "truncate" or size == 0:
+                f.truncate(int(rng.integers(0, max(size, 1))))
+            else:
+                off = int(rng.integers(0, size))
+                f.seek(off)
+                b = f.read(1) or b"\0"
+                f.seek(off)
+                f.write(bytes([b[0] ^ (0xFF if mode == "flip" else b[0])]))
+        mgr = CheckpointManager(work, keep=1)
+        step = mgr.latest_valid()
+        if step is None:
+            outcomes["typed_error"] += 1
+            continue
+        try:
+            back = mgr.restore(step).read("u", pen)
+        except ResilienceError:
+            outcomes["typed_error"] += 1
+            continue
+        # whatever survived validation MUST be the true data
+        np.testing.assert_array_equal(gather(back), u)
+        outcomes["restored"] += 1
+    # both outcomes must actually occur: corruption is detected AND
+    # benign damage (e.g. inside COMMIT's content) still restores
+    assert outcomes["typed_error"] > 0
+    assert outcomes["restored"] > 0
+
+
+def test_fuzz_older_checkpoint_fallback(tmp_path, pen):
+    """Corrupting the newest checkpoint makes ``latest_valid`` fall back
+    to the older intact one, and the restore is bit-identical."""
+    u1, x1 = make_data(pen, seed=13)
+    u2, x2 = make_data(pen, seed=14)
+    mgr = CheckpointManager(str(tmp_path), keep=5)
+    mgr.save(1, {"u": x1})
+    p2 = mgr.save(2, {"u": x2})
+    with open(os.path.join(p2, "data.bin"), "r+b") as f:
+        f.truncate(os.path.getsize(os.path.join(p2, "data.bin")) // 2)
+    assert mgr.latest_valid() == 1
+    np.testing.assert_array_equal(gather(mgr.restore().read("u", pen)), u1)
+
+
+# -- checksum plumbing -----------------------------------------------------
+def test_blocks_stream_through_observer_without_extra_copy(pen):
+    """The manifest CRCs come from the write path's own block streaming:
+    the observer sees exactly the logical-order blocks iter_local_blocks
+    yields, and their CRCs match an independent full-array computation
+    per block."""
+    from pencilarrays_tpu.io.binary import iter_local_blocks
+    from pencilarrays_tpu.resilience.checksum import (BlockChecksums,
+                                                      crc_of_array)
+
+    u, x = make_data(pen, seed=15)
+    crcs = BlockChecksums()
+    obs = crcs.observer("u")
+    for start, block in iter_local_blocks(x):
+        obs(start, block)
+    blocks = crcs.blocks("u")
+    assert sum(int(np.prod(b["shape"])) for b in blocks) == u.size
+    for b in blocks:
+        sl = tuple(slice(s, s + e) for s, e in zip(b["start"], b["shape"]))
+        assert crc_of_array(u[sl]) == b["crc"]
