@@ -38,6 +38,123 @@ def _timeit(body, x0, k0=1, k1=6, repeats=5):
     return device_seconds_per_iter(body, x0, k0=k0, k1=k1, repeats=repeats)
 
 
+def _noise_floor():
+    """The just-measured metric's per-repeat spread + slope-guard
+    verdict (``benchtime.last_spread``): attached to every artifact
+    entry so each number carries its own noise floor."""
+    from pencilarrays_tpu.utils.benchtime import last_spread
+
+    sp = last_spread()
+    return {"k1_spread": sp.get("k1_worst_over_best"),
+            "slope_fallback": sp.get("slope_fallback")}
+
+
+def _measure_obs_overhead(topo, devs, n=64, dispatches=200, repeats=5):
+    """The ``--obs`` arm: per-dispatch wall time of an eager transpose
+    with observability DISABLED (the shipped default path, whose only
+    addition over the pre-obs baseline is one cached env probe) vs
+    ENABLED (journal + metrics + drift taps live), vs the bare compiled
+    executable (the floor nothing can beat).  Small arrays on purpose:
+    the measurement targets DISPATCH overhead, not wire time."""
+    import tempfile
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from pencilarrays_tpu import Pencil, PencilArray, transpose
+    from pencilarrays_tpu import obs
+    from pencilarrays_tpu.parallel.transpositions import (AllToAll,
+                                                          _compiled_transpose)
+
+    if len(devs) > 1:
+        pen_x = Pencil(topo, (n, n, n), (1, 2))
+        pen_y = Pencil(topo, (n, n, n), (0, 2))
+    else:
+        pen_x = Pencil(topo, (n, n, n), (2,))
+        pen_y = Pencil(topo, (n, n, n), (1,))
+    u = PencilArray.zeros(pen_x, dtype=jnp.float32)
+
+    def timed_loop(fn):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = _time.perf_counter()
+            for _ in range(dispatches):
+                fn()
+            best = min(best, (_time.perf_counter() - t0) / dispatches)
+        return best
+
+    def via_transpose():
+        transpose(transpose(u, pen_y), pen_x)
+
+    # The off arm must time the SHIPPED default path — env var truly
+    # unset, no programmatic override (obs.disable() would short-circuit
+    # enabled() before the env probe and understate the gate).
+    # events._forced() scopes each arm and restores the caller's full
+    # obs state (override, env, run id, journal fd) on every exit, so
+    # an exception mid-arm cannot leave journaling suppressed for the
+    # rest of the suite run, and nothing leaks into the removed tempdir.
+    from pencilarrays_tpu.obs.events import _forced
+
+    jdir = tempfile.mkdtemp(prefix="pa_obs_bench_")
+    try:
+        from pencilarrays_tpu.ops.pallas_kernels import pallas_enabled
+        from pencilarrays_tpu.parallel.transpositions import \
+            assert_compatible
+
+        R = assert_compatible(pen_x, pen_y)
+        fwd = _compiled_transpose(pen_x, pen_y, R, 0, AllToAll(), False,
+                                  pallas_enabled())
+        bwd = _compiled_transpose(pen_y, pen_x, R, 0, AllToAll(), False,
+                                  pallas_enabled())
+        data = u.data
+        with _forced("unset"):
+            via_transpose()  # warm every executable before any timing
+        t_floor = timed_loop(lambda: bwd(fwd(data))) / 2
+        samples_off, samples_on = [], []
+        for _ in range(3):  # interleave arms: drift hits both equally,
+            # and both report min-of-3 (symmetric estimators)
+            with _forced("unset"):
+                via_transpose()  # re-warm this mode's gate path
+                samples_off.append(timed_loop(via_transpose) / 2)
+            with _forced("on", jdir):
+                via_transpose()  # opens the journal outside the timing
+                samples_on.append(timed_loop(via_transpose) / 2)
+        t_on = min(samples_on)
+        t_off = min(samples_off)
+        spread_off = max(samples_off) / t_off if t_off else None
+        # What the disabled path ADDS over the pre-obs baseline is
+        # exactly one enabled() probe per dispatch: time the probe (on
+        # the same env-unset path) and state it as a fraction of a
+        # dispatch — "within noise" holds when that fraction is far
+        # below the off-arm's own repeat spread.
+        K = 100_000
+        with _forced("unset"):
+            t0 = _time.perf_counter()
+            for _ in range(K):
+                obs.enabled()
+            gate_s = (_time.perf_counter() - t0) / K
+    finally:
+        import shutil
+
+        shutil.rmtree(jdir, ignore_errors=True)
+    return {
+        "what": "per-transpose-dispatch host wall seconds (eager, "
+                f"{n}^3 f32, {len(devs)} devices)",
+        "dispatch_s_compiled_floor": t_floor,
+        "dispatch_s_obs_off": t_off,
+        "dispatch_s_obs_on": t_on,
+        "obs_off_spread": spread_off,
+        "on_over_off": t_on / t_off if t_off else None,
+        "gate_probe_s": gate_s,
+        "gate_fraction_of_dispatch": gate_s / t_off if t_off else None,
+        # the acceptance claim: the disabled-path addition (the gate
+        # probe) is far below the measurement's own repeat jitter
+        "disabled_overhead_within_noise":
+            (gate_s / t_off) < max((spread_off or 1.0) - 1.0, 0.01)
+            if t_off else None,
+    }
+
+
 def _raw_ns_state(n):
     """Taylor-Green spectral state for the raw-jnp NS baseline: physical
     (n,n,n,3) f32 -> rfftn over the spatial axes."""
@@ -111,6 +228,13 @@ def main():
     parser.add_argument("--resilience-n", type=int, default=192,
                         help="cube edge of the resilience benchmark state "
                              "(f32; 192^3 = 28 MiB per dataset)")
+    parser.add_argument("--obs", action="store_true",
+                        help="also measure instrumented-vs-uninstrumented "
+                             "transpose dispatch overhead (the obs "
+                             "subsystem's disabled-path guarantee)")
+    parser.add_argument("--obs-only", action="store_true",
+                        help="run ONLY the --obs overhead arm (fast; used "
+                             "to commit the BENCH_OBS.json artifact)")
     args = parser.parse_args()
 
     import jax
@@ -125,11 +249,24 @@ def main():
     devs = jax.devices()[: args.devices]
     results = {"platform": devs[0].platform, "n_devices": len(devs)}
 
-    # -- 2. transpose cycle 256^3 f32 ------------------------------------
-    n = 256
     dims = dims_create(len(devs), 2) if len(devs) > 1 else (1,)
     topo = Topology(dims, devices=devs) if len(dims) > 1 else Topology(
         (1,), devices=devs)
+
+    # -- 8. obs: instrumentation overhead (opt-in) ------------------------
+    # The acceptance contract of the telemetry subsystem: with
+    # PENCILARRAYS_TPU_OBS unset, instrumented dispatch must be within
+    # noise of the pre-obs baseline (the addition is ONE gate probe).
+    if args.obs or args.obs_only:
+        results["obs_overhead"] = _measure_obs_overhead(topo, devs)
+        if args.obs_only:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+            print(json.dumps(results, indent=1))
+            return
+
+    # -- 2. transpose cycle 256^3 f32 ------------------------------------
+    n = 256
     from pencilarrays_tpu import Permutation
 
     nbytes = n ** 3 * 4
@@ -166,6 +303,7 @@ def main():
     results["transpose_hop_256"] = {
         "seconds": dt,
         "gb_per_s_per_chip": nbytes * 2 / dt / 1e9 / len(devs),
+        **_noise_floor(),
     }
 
     # -- 3. 3-D r2c FFT 256^3 --------------------------------------------
@@ -182,6 +320,7 @@ def main():
     results["fft_r2c_roundtrip_256"] = {
         "seconds": dt,
         "gflops_per_chip": flops / dt / 1e9 / len(devs),
+        **_noise_floor(),
     }
 
     # -- 4. NS step 128^3 -------------------------------------------------
@@ -193,7 +332,8 @@ def main():
 
     dt = _timeit(step, uh.data, k0=2, k1=42)
     results["navier_stokes_step_128"] = {"seconds": dt,
-                                         "steps_per_s": 1.0 / dt}
+                                         "steps_per_s": 1.0 / dt,
+                                         **_noise_floor()}
 
     # -- 4b. same physics, raw jnp (framework-overhead baseline) ----------
     # The same rotational-form RK2 written directly on jnp.fft with no
@@ -205,6 +345,7 @@ def main():
                 _raw_ns_step_fn(128, 1e-3), _raw_ns_state(128), k0=2, k1=42)),
             "steps_per_s": 1.0 / dt_raw,
             "raw_over_framework": dt_raw / dt,  # >1: framework faster
+            **_noise_floor(),
         }
 
     # -- 5. pallas tiled permute vs XLA transpose (local path) ------------
@@ -218,14 +359,20 @@ def main():
         t_pal = _timeit(
             lambda a: pk.pallas_permute(a, (2, 0, 1)) + a.ravel()[0] * 1e-30,
             xp, k0=10, k1=510)
+        nf_pal = _noise_floor()
         t_xla = _timeit(
             lambda a: jnp.transpose(a, (2, 0, 1)) + a.ravel()[0] * 1e-30,
             xp, k0=10, k1=510)
+        nf_xla = _noise_floor()
         nb = xp.size * 4 * 2
         results["pallas_permute_256"] = {
             "pallas_gb_per_s": nb / t_pal / 1e9,
             "xla_gb_per_s": nb / t_xla / 1e9,
             "speedup": t_xla / t_pal,
+            # per-arm noise floors: the speedup claim is only as good as
+            # the noisier of its two measurements
+            "pallas": nf_pal,
+            "xla": nf_xla,
         }
 
     # -- 6. pipelined-hop sweep (opt-in: serialized vs fused K) -----------

@@ -339,8 +339,13 @@ class BinaryFile:
             while name in existing:
                 n += 1
                 name = f"{base}({n})"
-        with timeit(x.pencil.timer, "write parallel"):
-            self._write_dataset(name, x, chunks, ncomp, block_observer)
+        from ..obs import io_op
+
+        with io_op("io.write", "BinaryDriver", self.filename, name,
+                   x.sizeof_global(),
+                   layout="chunks" if chunks else "discontiguous"):
+            with timeit(x.pencil.timer, "write parallel"):
+                self._write_dataset(name, x, chunks, ncomp, block_observer)
 
     def _write_dataset(self, name: str, x: PencilArray, chunks: bool,
                        ncomp: int = None, block_observer=None):
@@ -526,6 +531,13 @@ class BinaryFile:
         (reference ``read!``, ``mpi_io.jl:239-263``): dtype/dims/endianness
         are verified against the sidecar (``mpi_io.jl:293-324``).
         Collection datasets come back as the original tuple."""
+        from ..obs import io_op
+
+        with io_op("io.read", "BinaryDriver", self.filename, name):
+            return self._read_impl(name, pencil, extra_dims)
+
+    def _read_impl(self, name: str, pencil: Pencil,
+                   extra_dims: Tuple[int, ...] = None):
         from .core import maybe_unstack
 
         d = self.dataset_meta(name)

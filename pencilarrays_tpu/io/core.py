@@ -47,6 +47,7 @@ def open_file(driver: ParallelIODriver, filename: str, retry=None, **mode):
     run a collective barrier inside the driver, and a one-sided retry
     would re-enter it while peers have advanced to a later named barrier
     (deadlock) — so the collective case fails fast instead."""
+    from .. import obs
     from ..parallel.distributed import is_multiprocess
     from ..resilience import faults
     from ..resilience.retry import RetryPolicy
@@ -62,6 +63,13 @@ def open_file(driver: ParallelIODriver, filename: str, retry=None, **mode):
         return driver.open(filename, **mode)
 
     f = policy.call(_open, label=f"open {filename}")
+    if obs.enabled():
+        obs.counter("io.opens",
+                    driver=type(driver).__name__,
+                    mode="write" if writable else "read").inc()
+        obs.record_event("io.open", path=str(filename),
+                         mode="write" if writable else "read",
+                         driver=type(driver).__name__)
     try:
         yield f
     finally:

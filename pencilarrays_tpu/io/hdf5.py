@@ -182,6 +182,13 @@ class HDF5File:
         from .core import pack_collection
 
         x, ncomp = pack_collection(x)
+        from ..obs import io_op
+
+        with io_op("io.write", "HDF5Driver", self.filename, name,
+                   x.sizeof_global(), multiproc=self._multi):
+            self._write_any(name, x, ncomp, block_observer)
+
+    def _write_any(self, name: str, x, ncomp, block_observer) -> None:
         if self._multi:
             return self._write_multiproc(name, x, ncomp, block_observer)
         from ..utils.timers import timeit
@@ -349,12 +356,15 @@ class HDF5File:
         """Hyperslab reads per target block, assembled into the sharded
         array — restartable under any decomposition.  Collection
         datasets come back as the original tuple."""
+        from ..obs import io_op
         from ..utils.timers import timeit
-        with timeit(pencil.timer, "read parallel"):
-            if self._multi:
-                with self._master_ro() as mf:
-                    return self._read_impl(mf[name], pencil, extra_dims)
-            return self._read_impl(self._f[name], pencil, extra_dims)
+
+        with io_op("io.read", "HDF5Driver", self.filename, name):
+            with timeit(pencil.timer, "read parallel"):
+                if self._multi:
+                    with self._master_ro() as mf:
+                        return self._read_impl(mf[name], pencil, extra_dims)
+                return self._read_impl(self._f[name], pencil, extra_dims)
 
     def _read_impl(self, dset, pencil: Pencil,
                    extra_dims: Optional[Tuple[int, ...]]) -> PencilArray:

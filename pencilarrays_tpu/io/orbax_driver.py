@@ -102,9 +102,15 @@ class OrbaxFile:
         """``x`` may be a tuple/list of same-pencil arrays — stored as
         ONE stacked item (collection-level I/O); :meth:`read` returns
         the tuple back."""
+        from ..obs import io_op
         from .core import pack_collection
 
         x, ncomp = pack_collection(x)
+        with io_op("io.write", "OrbaxDriver", self._item_dir(name), name,
+                   x.sizeof_global(), async_write=self.async_write):
+            self._write_impl(name, x, ncomp)
+
+    def _write_impl(self, name: str, x, ncomp) -> None:
         if not self.writable:
             raise PermissionError("checkpoint not opened for writing")
         item = self._item_dir(name)

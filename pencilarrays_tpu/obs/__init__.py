@@ -1,0 +1,88 @@
+"""Unified telemetry — metrics, event journal, spans/profiling, drift.
+
+The reference instruments every hot section through one shared
+``TimerOutput`` (``Pencils.jl:191,434``, ``Transpositions.jl:173-177``).
+This package is the production-scale re-design of that single sink: one
+place where the runtime's *behavior* — transpose hops, plan builds,
+Auto-method verdicts, checkpoint commits, retries, fault firings — is
+observable at runtime and reconstructable after a crash.
+
+Four layers (see ``docs/Observability.md``):
+
+* :mod:`~pencilarrays_tpu.obs.metrics` — a thread-safe registry of
+  counters / gauges / histograms with JSON-snapshot and
+  Prometheus-textfile exporters;
+* :mod:`~pencilarrays_tpu.obs.events` — the **flight recorder**: an
+  append-only JSONL journal (run id, process index, monotonic + wall
+  timestamps, durability via ``resilience/fsutil.py``) that survives a
+  SIGKILL mid-write and leaves a readable timeline;
+* :mod:`~pencilarrays_tpu.obs.tracing` — spans unifying
+  ``utils/timers.py`` with ``jax.named_scope``, plus
+  :func:`~pencilarrays_tpu.obs.tracing.profile`, which wraps
+  ``jax.profiler.trace`` and stamps plan metadata into the capture;
+* :mod:`~pencilarrays_tpu.obs.drift` — the cost-model drift tracker
+  pairing each hop's predicted byte cost (``transpose_cost`` /
+  ``utils/hlo.py``) with measured time (the ``utils/benchtime.py``
+  protocol where available).
+
+Everything is **off by default** and near-zero overhead when off: call
+sites guard with :func:`enabled` (one cached env lookup) and never build
+payloads on the disabled path — the observability analog of the
+reference's ``@timeit_debug`` being compiled out.  Enable with the
+``PENCILARRAYS_TPU_OBS`` environment variable (``1`` — journal under
+``PENCILARRAYS_TPU_OBS_DIR`` or ``./pa_obs``; any other value is itself
+the journal directory) or programmatically with :func:`enable`.
+"""
+
+from __future__ import annotations
+
+from .events import (  # noqa: F401
+    ENV_VAR,
+    disable,
+    enable,
+    enabled,
+    journal_dir,
+    read_journal,
+    record_event,
+    run_id,
+)
+from .metrics import (  # noqa: F401
+    counter,
+    gauge,
+    histogram,
+    registry,
+    snapshot,
+    to_prometheus,
+    write_prometheus,
+    write_snapshot,
+)
+from .tracing import io_op, profile, span  # noqa: F401
+from .drift import drift_report, drift_tracker, record_hop_sample  # noqa: F401
+from .schema import lint_event, lint_journal  # noqa: F401
+
+__all__ = [
+    "ENV_VAR",
+    "enabled",
+    "enable",
+    "disable",
+    "journal_dir",
+    "run_id",
+    "record_event",
+    "read_journal",
+    "counter",
+    "gauge",
+    "histogram",
+    "registry",
+    "snapshot",
+    "write_snapshot",
+    "to_prometheus",
+    "write_prometheus",
+    "span",
+    "profile",
+    "io_op",
+    "drift_tracker",
+    "drift_report",
+    "record_hop_sample",
+    "lint_event",
+    "lint_journal",
+]

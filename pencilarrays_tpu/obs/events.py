@@ -1,0 +1,365 @@
+"""The event flight recorder: an append-only JSONL journal.
+
+PRs 1-2 gave the runtime rich behaviors — pipelined hops, checkpoint
+commits, retries, deterministic fault injection — that were invisible at
+runtime and *gone* after a crash.  The flight recorder is the durable
+timeline: every record is one JSON line carrying the run id, process
+index, wall + monotonic timestamps and a per-process sequence number, so
+a post-mortem (e.g. after the SIGKILL-mid-write drill in
+``tests/test_multiprocess.py``) can reconstruct exactly what the process
+was doing when it died.
+
+Durability discipline (shared with ``resilience/fsutil.py``):
+
+* the journal fd is opened ``O_APPEND`` — concurrent writers (threads,
+  or two processes that race before ``jax.distributed`` assigns indices)
+  interleave whole lines, never tear them;
+* every record is flushed to the OS immediately, so a SIGKILL cannot
+  lose it (page cache survives process death);
+* *critical* records (checkpoint commits, faults, retries, run
+  boundaries) are additionally ``fsync``'d so even an OS crash keeps
+  the commit timeline; ``PENCILARRAYS_TPU_OBS_FSYNC`` =
+  ``always | critical | never`` tunes this (default ``critical``);
+* the journal directory itself is fsync'd at creation
+  (:func:`~pencilarrays_tpu.resilience.fsutil.fsync_dir`).
+
+Enablement: ``PENCILARRAYS_TPU_OBS`` unset/empty/``0`` = off (the
+default; :func:`record_event` is then one cached env probe).  ``1`` /
+``on`` / ``true`` = on, journal under ``PENCILARRAYS_TPU_OBS_DIR``
+(default ``./pa_obs``).  Any other value is itself the journal
+directory.  The variable is re-read whenever it changes — a worker can
+arm observability after import, exactly like the fault-injection env
+(``resilience/faults.py``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import List, Optional
+
+from ..resilience.fsutil import fsync_dir
+
+__all__ = [
+    "ENV_VAR",
+    "DIR_VAR",
+    "FSYNC_VAR",
+    "SCHEMA_VERSION",
+    "enabled",
+    "enable",
+    "disable",
+    "journal_dir",
+    "run_id",
+    "record_event",
+    "read_journal",
+]
+
+ENV_VAR = "PENCILARRAYS_TPU_OBS"
+DIR_VAR = "PENCILARRAYS_TPU_OBS_DIR"
+FSYNC_VAR = "PENCILARRAYS_TPU_OBS_FSYNC"
+DEFAULT_DIR = "pa_obs"
+SCHEMA_VERSION = 1
+
+# events whose loss would blind a post-mortem: fsync'd under the default
+# "critical" policy.  High-rate events (per-hop dispatch) only flush.
+CRITICAL_EVENTS = frozenset({
+    "run.start", "ckpt.save", "ckpt.commit", "ckpt.restore", "ckpt.verify",
+    "fault", "retry", "dist.init",
+})
+
+_lock = threading.Lock()
+_override: Optional[bool] = None     # programmatic enable()/disable()
+_override_dir: Optional[str] = None
+_env_cache: Optional[str] = None
+_env_on = False
+_run_id: Optional[str] = None
+_file = None
+_file_dir: Optional[str] = None
+_file_proc: Optional[int] = None
+_seq = 0
+
+
+def _env_enabled() -> bool:
+    """Re-read ``ENV_VAR`` on change (workers arm late, like faults)."""
+    global _env_cache, _env_on
+    env = os.environ.get(ENV_VAR, "")
+    if env != _env_cache:
+        _env_cache = env
+        _env_on = env not in ("", "0", "off", "false")
+    return _env_on
+
+
+def enabled() -> bool:
+    """THE gate every instrumented call site probes first.  One branch +
+    one cached env lookup on the disabled path — payloads are never
+    built unless this returns True."""
+    if _override is not None:
+        return _override
+    return _env_enabled()
+
+
+def enable(directory: Optional[str] = None) -> None:
+    """Programmatic enable (overrides the environment until
+    :func:`disable`); ``directory`` overrides the journal location.
+    Starts a fresh observability run: a new run id, and per-run dedup
+    state (e.g. the planner's one-verdict-per-config journal filter)
+    starts over."""
+    global _override, _override_dir, _run_id
+    with _lock:
+        _close_locked()
+        _override = True
+        _override_dir = os.fspath(directory) if directory else None
+        _run_id = None  # a fresh run id per enable (docstring contract)
+
+
+def disable() -> None:
+    """Programmatic disable: closes the journal and wins over the
+    environment until the next :func:`enable`."""
+    global _override, _override_dir
+    with _lock:
+        _close_locked()
+        _override = False
+        _override_dir = None
+
+
+def _reset_for_tests() -> None:
+    """Full reset: drop overrides AND the env cache (tests toggle the
+    env between cases; production code never needs this)."""
+    global _override, _override_dir, _env_cache, _env_on, _run_id, _seq
+    with _lock:
+        _close_locked()
+        _override = None
+        _override_dir = None
+        _env_cache = None
+        _env_on = False
+        _run_id = None
+        _seq = 0
+
+
+def journal_dir() -> str:
+    """Resolved journal directory for the current configuration."""
+    if _override_dir:
+        return _override_dir
+    env = os.environ.get(ENV_VAR, "")
+    if env not in ("", "0", "1", "on", "true", "off", "false"):
+        return env
+    return os.environ.get(DIR_VAR, DEFAULT_DIR)
+
+
+def run_id() -> str:
+    """Stable id of this process's observability run (new per enable)."""
+    global _run_id
+    if _run_id is None:
+        _run_id = f"{os.getpid():x}-{uuid.uuid4().hex[:8]}"
+    return _run_id
+
+
+def _process_index() -> int:
+    """Best-effort process index; never initializes anything.
+
+    Deliberately does NOT call ``jax.process_index()``: that builds the
+    local XLA backend as a side effect, and an event recorded before
+    ``jax.distributed.initialize`` (e.g. ``dist.init connecting``) would
+    then make the real initialize raise 'must be called before any JAX
+    computations'.  The coordinator-assigned index is read from jax's
+    distributed global state instead — absent (single-process or
+    pre-init) means 0, and the journal filename re-resolves on change."""
+    try:
+        import jax
+
+        state = getattr(jax.distributed, "global_state", None)
+        pid = getattr(state, "process_id", None)
+        return int(pid) if pid is not None else 0
+    except Exception:
+        return 0
+
+
+def _close_locked() -> None:
+    global _file, _file_dir, _file_proc
+    if _file is not None:
+        try:
+            _file.close()
+        except OSError:
+            pass
+    _file = None
+    _file_dir = None
+    _file_proc = None
+
+
+def _open_locked(proc: Optional[int] = None):
+    """(Re)open the journal for the resolved directory; emits the
+    ``run.start`` boundary record on a fresh open.  The filename is
+    re-resolved when the process index CHANGES — events recorded before
+    ``jax.distributed`` connects (e.g. ``dist.init connecting``) land in
+    ``journal.r0.jsonl`` on every process, but the first post-connect
+    record moves each process to its own ``journal.r<p>.jsonl`` (shared
+    filesystems make cross-host O_APPEND to one file unreliable)."""
+    global _file, _file_dir, _file_proc
+    d = journal_dir()
+    if proc is None:
+        proc = _process_index()
+    if _file is not None and _file_dir == d and _file_proc == proc:
+        return _file
+    _close_locked()
+    os.makedirs(d, exist_ok=True)
+    fsync_dir(d)
+    path = os.path.join(d, f"journal.r{proc}.jsonl")
+    # O_APPEND: whole-line atomicity for concurrent small appends
+    _file = open(path, "a", buffering=1)
+    _file_dir = d
+    _file_proc = proc
+    _write_locked("run.start", {
+        "pid": os.getpid(),
+        "argv": list(sys.argv[:4]),
+    }, proc=proc)
+    return _file
+
+
+def _atexit_flush() -> None:
+    """Normal-exit epilogue: publish the metrics snapshot next to the
+    journal (a SIGKILL skips this by design — the journal itself is the
+    crash-safe artifact).  Registered at import so metrics-only runs
+    (counters/gauges bumped, no journal event ever recorded) still get
+    their snapshot; a no-op while observability is off."""
+    try:
+        if enabled():
+            from .metrics import write_snapshot
+
+            record_event("run.stop")
+            write_snapshot()
+    except Exception:
+        pass
+
+
+atexit.register(_atexit_flush)
+
+
+@contextmanager
+def _forced(mode: str, directory: Optional[str] = None):
+    """Temporarily force the gate — ``"on"`` (journal to ``directory``)
+    or ``"unset"`` (override cleared AND env var removed: the true
+    shipped-default path) — restoring EVERY piece of gate state after:
+    override, env var, run id, and the journal fd (closed on exit, so a
+    caller deleting ``directory`` afterwards leaks nothing).  The obs
+    overhead bench arm uses this; keeping the surgery here keeps it
+    next to the state it touches."""
+    global _override, _override_dir, _run_id
+    with _lock:
+        saved = (_override, _override_dir, _run_id,
+                 os.environ.get(ENV_VAR))
+        _close_locked()
+        if mode == "on":
+            _override = True
+            _override_dir = os.fspath(directory) if directory else None
+        elif mode == "unset":
+            _override = None
+            _override_dir = None
+            os.environ.pop(ENV_VAR, None)
+        else:
+            raise ValueError(f"unknown forced mode {mode!r}")
+    try:
+        yield
+    finally:
+        with _lock:
+            _close_locked()
+            _override, _override_dir, _run_id = saved[0], saved[1], saved[2]
+            if saved[3] is None:
+                os.environ.pop(ENV_VAR, None)
+            else:
+                os.environ[ENV_VAR] = saved[3]
+
+
+def _json_safe(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    try:
+        import numpy as np
+
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, np.floating):
+            return float(v)
+    except Exception:
+        pass
+    return str(v)
+
+
+def _fsync_policy() -> str:
+    return os.environ.get(FSYNC_VAR, "critical")
+
+
+def _write_locked(ev: str, fields: dict,
+                  proc: Optional[int] = None) -> None:
+    global _seq
+    _seq += 1
+    rec = {"v": SCHEMA_VERSION, "ev": ev, "run": run_id(),
+           "proc": _process_index() if proc is None else proc,
+           "seq": _seq,
+           "t_wall": time.time(), "t_mono": time.monotonic()}
+    for k, v in fields.items():
+        if k not in rec:
+            rec[k] = _json_safe(v)
+    _file.write(json.dumps(rec, separators=(",", ":")) + "\n")
+    _file.flush()
+    policy = _fsync_policy()
+    if policy == "always" or (policy == "critical" and ev in CRITICAL_EVENTS):
+        try:
+            os.fsync(_file.fileno())
+        except OSError:
+            pass
+
+
+def record_event(ev: str, **fields) -> bool:
+    """Append one record to the journal.  Returns False (doing NOTHING,
+    allocating nothing beyond the kwargs dict) when observability is
+    disabled — the contract that keeps instrumented hot paths free."""
+    if not enabled():
+        return False
+    try:
+        proc = _process_index()  # once per event, outside the lock
+        with _lock:
+            if not enabled():
+                return False  # lost a race with disable(): a stale
+                # thread must not resurrect the journal while off
+            _open_locked(proc)
+            _write_locked(ev, fields, proc=proc)
+        return True
+    except OSError:
+        return False  # a full/readonly disk must never take down the job
+
+
+def read_journal(directory: Optional[str] = None) -> List[dict]:
+    """Parse every ``journal.r*.jsonl`` under ``directory`` (default:
+    the active journal dir) into one timeline ordered by wall time then
+    per-process sequence.  Unparseable lines (a torn final line from a
+    crash without O_APPEND atomicity, foreign garbage) are skipped — the
+    reader is a forensic tool and must not die on wreckage."""
+    import glob
+
+    d = directory or journal_dir()
+    events = []
+    for path in sorted(glob.glob(os.path.join(d, "journal.r*.jsonl"))):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(e, dict):
+                    events.append(e)
+    events.sort(key=lambda e: (e.get("t_wall", 0.0), e.get("proc", 0),
+                               e.get("seq", 0)))
+    return events

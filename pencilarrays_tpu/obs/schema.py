@@ -1,0 +1,93 @@
+"""Journal event schema + lint — event shapes cannot silently drift.
+
+Every record type the flight recorder emits is registered here with its
+required payload fields.  The test suite lints every journal it produces
+(``tests/test_obs.py``, and the SIGKILL drill's timeline in
+``tests/test_multiprocess.py``), so adding an event type without
+registering it — or dropping a field a consumer relies on — fails CI
+instead of quietly producing unreadable timelines.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple, Union
+
+from .events import SCHEMA_VERSION
+
+__all__ = ["COMMON_FIELDS", "EVENT_TYPES", "lint_event", "lint_journal"]
+
+# fields every record carries (written by events.record_event itself)
+COMMON_FIELDS: Tuple[str, ...] = (
+    "v", "ev", "run", "proc", "seq", "t_wall", "t_mono")
+
+# ev -> required payload fields (extra fields are allowed; missing ones
+# and unknown event types are lint errors)
+EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
+    # run boundaries
+    "run.start": ("pid",),
+    "run.stop": (),
+    # planner / transpose engine
+    "plan.build": ("shape", "transforms", "topo", "pipeline", "steps"),
+    "auto.verdict": ("mode", "winner", "config"),
+    "hop": ("method", "r", "chunks", "predicted_bytes", "dispatch_s"),
+    # I/O drivers
+    "io.open": ("path", "mode"),
+    "io.write": ("path", "dataset", "bytes", "seconds"),
+    "io.read": ("path", "dataset", "seconds"),
+    # checkpoint lifecycle
+    "ckpt.save": ("step", "status"),
+    "ckpt.commit": ("step",),
+    "ckpt.restore": ("step", "dataset", "seconds"),
+    "ckpt.verify": ("step", "ok"),
+    "ckpt.gc": ("removed",),
+    # resilience
+    "retry": ("label", "attempt", "max_attempts", "delay_s", "error"),
+    "fault": ("point", "mode", "hit"),
+    "dist.init": ("status",),
+    # profiling / drift
+    "profile": ("dir", "status"),
+    "drift.sample": ("hop", "predicted_bytes", "measured_s", "source"),
+}
+
+
+def lint_event(e: dict) -> List[str]:
+    """Schema errors of one record ([] = clean)."""
+    errors = []
+    if not isinstance(e, dict):
+        return [f"record is not an object: {e!r}"]
+    for f in COMMON_FIELDS:
+        if f not in e:
+            errors.append(f"missing common field {f!r}: {e!r}")
+    v = e.get("v")
+    if v is not None and not isinstance(v, (int, float)):
+        errors.append(f"schema version is not a number: {v!r}")
+    elif v is not None and v > SCHEMA_VERSION:
+        errors.append(f"schema version {v} is newer than supported "
+                      f"{SCHEMA_VERSION}")
+    ev = e.get("ev")
+    if ev is None:
+        return errors
+    req = EVENT_TYPES.get(ev)
+    if req is None:
+        errors.append(f"unknown event type {ev!r} (register it in "
+                      f"obs/schema.py EVENT_TYPES)")
+        return errors
+    for f in req:
+        if f not in e:
+            errors.append(f"event {ev!r} missing required field {f!r}: {e!r}")
+    return errors
+
+
+def lint_journal(events_or_dir: Union[str, Iterable[dict]]) -> List[str]:
+    """Lint a whole journal (a directory path or an event iterable).
+    Returns every error found; [] means the timeline is schema-clean."""
+    if isinstance(events_or_dir, str):
+        from .events import read_journal
+
+        events = read_journal(events_or_dir)
+    else:
+        events = list(events_or_dir)
+    errors = []
+    for e in events:
+        errors.extend(lint_event(e))
+    return errors

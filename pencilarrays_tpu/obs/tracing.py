@@ -1,0 +1,152 @@
+"""Span/trace layer: one annotation API across host and device time.
+
+Unifies the two channels ``utils/timers.py`` established — host-side
+hierarchical :class:`~pencilarrays_tpu.utils.timers.TimerOutput` wall
+time and trace-time ``jax.named_scope`` annotations (visible in XLA
+device profiles) — with the metrics registry: a :func:`span` is all
+three at once.  :func:`profile` adds the capture story: it wraps
+``jax.profiler.trace`` and stamps plan metadata (schedule, predicted
+collective costs) into the capture directory, so a trace pulled off a
+pod months later still says what program it was profiling.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = ["span", "profile", "io_op"]
+
+
+@contextmanager
+def io_op(event: str, driver: str, path, dataset: str,
+          nbytes: Optional[int] = None, **extra):
+    """Time + meter + journal one driver operation (``event`` is
+    ``"io.write"`` or ``"io.read"``) — the ONE instrumentation wrapper
+    every I/O driver shares, so the event shape cannot drift between
+    drivers.  No-op (a bare yield) when observability is disabled.
+
+    A raising operation is journaled too — with ``ok: false`` and the
+    error, and WITHOUT counting its bytes as written: the post-mortem
+    timeline must show a failed write as failed.
+
+    ``nbytes`` is the GLOBAL dataset size (what the event records — the
+    post-mortem wants the dataset, not a share); the ``io.bytes_written``
+    counter is incremented by this process's 1/P share of it, so
+    per-process Prometheus textfiles sum to the true volume across a
+    collective write instead of P times it."""
+    from .events import enabled, record_event
+    from .metrics import counter, histogram
+
+    if not enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    err = None
+    try:
+        yield
+    except BaseException as e:
+        err = e
+        raise
+    finally:
+        dt = time.perf_counter() - t0
+        kind = event.rsplit(".", 1)[-1]
+        if nbytes is not None and err is None:
+            try:
+                import jax
+
+                share = nbytes // max(1, jax.process_count())
+            except Exception:
+                share = nbytes
+            counter("io.bytes_written", driver=driver).inc(share)
+        histogram(f"io.{kind}_seconds", driver=driver).observe(dt)
+        payload = dict(path=str(path), dataset=dataset, seconds=dt,
+                       driver=driver, ok=err is None, **extra)
+        if err is not None:
+            payload["error"] = f"{type(err).__name__}: {err}"
+        if nbytes is not None:
+            payload["bytes"] = nbytes
+        record_event(event, **payload)
+
+
+@contextmanager
+def span(label: str, timer=None):
+    """One section annotation, three sinks:
+
+    * ``jax.named_scope`` — always (free: trace-time metadata only);
+    * the host :class:`TimerOutput` — when debug timings are enabled
+      and a timer is passed (the reference's ``@timeit_debug``);
+    * an obs histogram ``span.seconds{label=...}`` — when observability
+      is enabled.
+
+    Drop-in superset of :func:`~pencilarrays_tpu.utils.timers.timeit`.
+    """
+    from ..utils.timers import timeit
+    from .events import enabled
+    from .metrics import histogram
+
+    if not enabled():
+        with timeit(timer, label):
+            yield
+        return
+    t0 = time.perf_counter()
+    try:
+        with timeit(timer, label):
+            yield
+    finally:
+        histogram("span.seconds", label=label).observe(
+            time.perf_counter() - t0)
+
+
+@contextmanager
+def profile(logdir: str, plan=None, **metadata):
+    """Capture a ``jax.profiler`` trace of the block into ``logdir`` and
+    stamp run metadata into the capture directory
+    (``pa_capture_metadata.json``): the obs run id, free-form
+    ``metadata`` kwargs, and — when ``plan`` is a
+    :class:`~pencilarrays_tpu.ops.fft.PencilFFTPlan` — the plan's
+    transforms, schedule summary and predicted collective costs.  The
+    capture works with observability disabled too (it is its own
+    opt-in); the ``profile`` start/stop events land in the journal only
+    when obs is on."""
+    import os
+
+    import jax
+
+    from ..resilience.fsutil import atomic_write_json
+    from .events import record_event, run_id
+
+    logdir = os.fspath(logdir)
+    os.makedirs(logdir, exist_ok=True)
+    stamp = {"run": run_id(), "t_wall": time.time()}
+    if metadata:
+        stamp["metadata"] = {k: str(v) for k, v in metadata.items()}
+    if plan is not None:
+        stamp["plan"] = _plan_stamp(plan)
+    atomic_write_json(os.path.join(logdir, "pa_capture_metadata.json"),
+                      stamp)
+    record_event("profile", dir=logdir, status="start",
+                 plan=stamp.get("plan", {}).get("repr"))
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.trace(logdir):
+            yield logdir
+    finally:
+        record_event("profile", dir=logdir, status="stop",
+                     seconds=time.perf_counter() - t0)
+
+
+def _plan_stamp(plan) -> dict:
+    """JSON summary of a PencilFFTPlan for capture stamping."""
+    out = {"repr": repr(plan)}
+    try:
+        out["transforms"] = list(plan.transforms)
+        out["shape"] = list(plan.shape_physical)
+        out["topo"] = list(plan.topology.dims)
+        out["pipeline_chunks"] = plan.pipeline_chunks
+        out["steps"] = [s[0] for s in plan._steps]
+        out["predicted_costs"] = plan.collective_costs()
+    except Exception:
+        pass  # stamping is best-effort; never break a capture
+    return out

@@ -696,6 +696,12 @@ class PencilFFTPlan:
             if kinds[d] == "rfft":
                 sh[d] = sh[d] // 2 + 1
 
+        from .. import obs
+
+        if obs.enabled():
+            obs.counter("fft.plans_built").inc()
+            obs.record_event("plan.build", **self._obs_summary())
+
     def _fuse_pipeline_steps(self, steps: tuple, K: int) -> tuple:
         """Rewrite eligible hop+transform pairs into fused ``("ft", src,
         tgt, hop_dtype, post, ops, pre_complex, base, chunk_dim,
@@ -760,6 +766,51 @@ class PencilFFTPlan:
             return None
         return ("ft", src, tgt, hop_dtype, post, tuple(ops), pre_complex,
                 base, c, bounds)
+
+    def _obs_summary(self) -> dict:
+        """The ``plan.build`` journal payload: the static schedule and
+        its predicted collective costs — what a post-mortem needs to
+        know which program this run was executing."""
+        from ..parallel.transpositions import _method_label
+
+        steps = []
+        for s in self._steps:
+            if s[0] == "t":
+                _, src, tgt, hop_dtype = s
+                steps.append({"kind": "t",
+                              "hop": f"{src.decomposition}->"
+                                     f"{tgt.decomposition}",
+                              "dtype": str(jnp.dtype(hop_dtype))})
+            elif s[0] == "ft":
+                (_, src, tgt, hop_dtype, _post, ops, _pc, base, c,
+                 bounds) = s
+                steps.append({"kind": "ft",
+                              "hop": f"{src.decomposition}->"
+                                     f"{tgt.decomposition}",
+                              "dtype": str(jnp.dtype(hop_dtype)),
+                              "base": _method_label(base),
+                              "chunk_dim": c, "chunks": len(bounds),
+                              "transforms": [op[0] for op in ops]})
+            else:
+                _, pre, _post, ops, _pc = s
+                steps.append({"kind": "f",
+                              "transforms": [op[0] for op in ops]})
+        try:
+            costs = self.collective_costs()
+        except (TypeError, ValueError):
+            costs = {}  # e.g. a Gspmd plan: partitioner-owned collectives
+        return {
+            "shape": list(self.shape_physical),
+            "transforms": list(self.transforms),
+            "topo": list(self.topology.dims),
+            "method": _method_label(self.method)
+            if not isinstance(self.method, Auto)
+            else f"Auto({self.method.mode})",
+            "pipeline": self.pipeline_chunks,
+            "normalization": self.normalization,
+            "steps": steps,
+            "predicted_costs": costs,
+        }
 
     # -- pencils ----------------------------------------------------------
     @property
@@ -830,6 +881,33 @@ class PencilFFTPlan:
 
     # -- transforms -------------------------------------------------------
     @staticmethod
+    def _dispatch_fused(fn, x: PencilArray, hop_src: Pencil,
+                        hop_tgt: Pencil, hop_dtype, base, bounds):
+        """Dispatch one fused pipelined hop, journaling it when
+        observability is on (same tap as standalone ``transpose`` —
+        ``hop_src -> hop_tgt`` is the direction the wire actually moves
+        data, so forward and backward price identically; eager
+        dispatches only, like the transpose tap — under an outer jit
+        this runs at trace time)."""
+        import jax.core
+
+        from .. import obs
+
+        if not obs.enabled() or isinstance(x.data, jax.core.Tracer):
+            return fn(x.data)
+        import time as _time
+
+        from ..parallel.transpositions import _obs_record_hop
+
+        t0 = _time.perf_counter()
+        data = fn(x.data)
+        _obs_record_hop(hop_src, hop_tgt, assert_compatible(hop_src,
+                                                            hop_tgt),
+                        base, x.extra_dims, hop_dtype,
+                        _time.perf_counter() - t0, fused_k=len(bounds))
+        return data
+
+    @staticmethod
     def _hop_donate(x: PencilArray, owned: bool) -> bool:
         """Donate a hop's input buffer when it is an intermediate this
         plan created (``owned``) and we are NOT tracing — under an outer
@@ -870,12 +948,14 @@ class PencilFFTPlan:
                  chunk_dim, bounds) = step
                 from .pallas_kernels import pallas_enabled
 
-                data = _fused_hop_fn(src, tgt, post, nd_extra, ops,
-                                     False, pre_complex,
-                                     self.normalization, base,
-                                     chunk_dim, bounds,
-                                     self._hop_donate(x, owned),
-                                     pallas_enabled())(x.data)
+                fn = _fused_hop_fn(src, tgt, post, nd_extra, ops,
+                                   False, pre_complex,
+                                   self.normalization, base,
+                                   chunk_dim, bounds,
+                                   self._hop_donate(x, owned),
+                                   pallas_enabled())
+                data = self._dispatch_fused(fn, x, src, tgt, hop_dtype,
+                                            base, bounds)
                 x = PencilArray(post, data, x.extra_dims)
             else:
                 _, pre, post, ops, pre_complex = step
@@ -911,12 +991,14 @@ class PencilFFTPlan:
                  chunk_dim, bounds) = step
                 from .pallas_kernels import pallas_enabled
 
-                data = _fused_hop_fn(src, tgt, post, nd_extra, ops,
-                                     True, pre_complex,
-                                     self.normalization, base,
-                                     chunk_dim, bounds,
-                                     self._hop_donate(x, owned),
-                                     pallas_enabled())(x.data)
+                fn = _fused_hop_fn(src, tgt, post, nd_extra, ops,
+                                   True, pre_complex,
+                                   self.normalization, base,
+                                   chunk_dim, bounds,
+                                   self._hop_donate(x, owned),
+                                   pallas_enabled())
+                data = self._dispatch_fused(fn, x, tgt, src, hop_dtype,
+                                            base, bounds)
                 x = PencilArray(src, data, x.extra_dims)
             else:
                 _, pre, post, ops, pre_complex = step

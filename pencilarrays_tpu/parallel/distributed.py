@@ -112,8 +112,29 @@ def initialize(coordinator_address: Optional[str] = None,
             _reset_jax_partial_state()
             raise
 
-    policy.call(_connect, label="dist.initialize")
+    from ..obs import enabled as _obs_enabled, record_event as _record_event
+
+    if _obs_enabled():
+        _record_event("dist.init", status="connecting",
+                      coordinator=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    try:
+        policy.call(_connect, label="dist.initialize")
+    except BaseException as e:
+        if _obs_enabled():
+            _record_event("dist.init", status="failed",
+                          error=f"{type(e).__name__}: {e}")
+        raise
     _initialized = True
+    if _obs_enabled():
+        # read the coordinator-assigned identity from jax.distributed's
+        # own state — jax.process_index() here would eagerly build the
+        # XLA backend as a side effect, which is not this function's job
+        state = getattr(jax.distributed, "global_state", None)
+        _record_event(
+            "dist.init", status="connected",
+            process_id=getattr(state, "process_id", process_id),
+            num_processes=getattr(state, "num_processes", num_processes))
 
 
 def _reset_jax_partial_state() -> None:

@@ -42,6 +42,7 @@ Three methods (reference ``Transpositions.jl:17-24``):
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from functools import lru_cache, partial
 from typing import Optional, Tuple
@@ -50,6 +51,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from .. import obs
 from ..utils.jaxcompat import shard_map
 from ..utils.timers import timeit
 from .arrays import PencilArray, _fwd_axes, _inv_axes
@@ -610,6 +612,35 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
 
 
 _MEASURE_REPORTS: dict = {}
+_MEASURE_TIMINGS: dict = {}
+
+
+def _obs_record_measure_verdict(pin: Pencil, pout: Pencil, R: int,
+                                extra_dims: tuple, dtype) -> None:
+    """Journal a measure-mode Auto verdict + its candidate timings as
+    drift samples, once per (obs run, config).  Reads the cached
+    measurement, so late-armed observability still journals configs
+    measured earlier in the process."""
+    import numpy as np
+
+    key = (pin, pout, R, extra_dims, np.dtype(dtype).str)
+    report = _MEASURE_REPORTS.get(key)
+    if report is None:
+        return
+    dedup = (obs.run_id(), "measure", report["config"])
+    if dedup in _ESTIMATE_LOGGED:
+        return
+    _ESTIMATE_LOGGED.add(dedup)
+    obs.record_event("auto.verdict", mode="measure", **report)
+    for cand, t in _MEASURE_TIMINGS.get(key, ()):
+        # candidate timings are fwd+back pairs of the SAME hop shape:
+        # halve to per-hop seconds and feed the drift tracker (true
+        # device timings — they outrank dispatch samples)
+        cost = transpose_cost(pin, pout, extra_dims, dtype, cand)
+        obs.record_hop_sample(
+            _hop_label(pin, pout, cand, dtype),
+            sum(v["bytes"] for v in cost.values()), t / 2.0,
+            source="auto_measure")
 
 
 def _method_label(m: AbstractTransposeMethod) -> str:
@@ -617,6 +648,71 @@ def _method_label(m: AbstractTransposeMethod) -> str:
     if isinstance(m, Pipelined):
         return f"Pipelined(chunks={m.chunks}, base={_method_label(m.base)})"
     return type(m).__name__
+
+
+# ---------------------------------------------------------------------------
+# observability taps (active only when obs.enabled(); see obs/ package)
+# ---------------------------------------------------------------------------
+
+
+def _hop_label(pin: Pencil, pout: Pencil, method: AbstractTransposeMethod,
+               dtype=None) -> str:
+    """Stable per-configuration key for metrics/drift: global shape,
+    mesh, decomposition change, method, dtype — everything the byte
+    model prices."""
+    import numpy as np
+
+    dt = np.dtype(dtype if dtype is not None else np.float32).name
+    return (f"{pin.size_global()}@{pin.topology.dims} "
+            f"{pin.decomposition}->{pout.decomposition} "
+            f"{_method_label(method)} {dt}")
+
+
+@lru_cache(maxsize=512)
+def _cached_hop_cost(pin: Pencil, pout: Pencil, extra_dims: tuple,
+                     dtype_str: str, method: AbstractTransposeMethod) -> dict:
+    """transpose_cost cached per static configuration, so per-dispatch
+    instrumentation never re-prices a hop it has already priced."""
+    import numpy as np
+
+    return transpose_cost(pin, pout, extra_dims, np.dtype(dtype_str), method)
+
+
+def _obs_record_hop(pin: Pencil, pout: Pencil, R: Optional[int],
+                    method: AbstractTransposeMethod, extra_dims: tuple,
+                    dtype, dispatch_s: float, fused_k: int = 0) -> None:
+    """Journal + meter one dispatched hop (obs-enabled paths only).
+    ``fused_k > 0`` marks a pipelined hop fused with its transform stage
+    (``ops/fft.py``), whose chunk count is owned by the fused program."""
+    import numpy as np
+
+    label = _method_label(method)
+    chunks = fused_k or (method.chunks if isinstance(method, Pipelined) else 1)
+    dtype_str = np.dtype(dtype).str
+    try:
+        cost = (_cached_hop_cost(pin, pout, tuple(extra_dims), dtype_str,
+                                 method) if R is not None else {})
+    except (TypeError, ValueError):
+        cost = {}  # e.g. Gspmd: the partitioner owns the collectives
+    nbytes = sum(v["bytes"] for v in cost.values())
+    hop = _hop_label(pin, pout, method, dtype)
+    if fused_k:
+        # a fused hop's dispatch time includes its transform stage — it
+        # must not share a drift key with the bare exchange's samples
+        hop += f" fused(K={fused_k})"
+    obs.counter("transpose.dispatches", method=label).inc()
+    obs.counter("transpose.predicted_bytes").inc(nbytes)
+    obs.histogram("transpose.dispatch_seconds", method=label).observe(
+        dispatch_s)
+    if nbytes:
+        # per-dispatch host wall time: the free drift proxy (benchtime /
+        # auto-measure samples outrank it in the report)
+        obs.record_hop_sample(hop, nbytes, dispatch_s, source="dispatch")
+    obs.record_event(
+        "hop", method=label, hop=hop, r=R, chunks=chunks,
+        fused=bool(fused_k), predicted_bytes=nbytes, predicted=cost,
+        dispatch_s=dispatch_s,
+        shape=list(pin.size_global()), topo=list(pin.topology.dims))
 
 
 def last_measure_reports() -> list:
@@ -684,7 +780,7 @@ def _measured_choice(pin: Pencil, pout: Pencil, R: int, extra_dims: tuple,
         if len(times) > 1 else best_t
     noise = max(s for s in spreads if s is not None) if any(
         s is not None for s in spreads) else None
-    _MEASURE_REPORTS[(pin, pout, R, extra_dims, dtype_str)] = {
+    report = {
         "config": f"{pin.size_global()}@{pin.topology.dims} R={R} "
                   f"{dtype_str}",
         "candidates": [_method_label(c) for c in candidates],
@@ -696,6 +792,13 @@ def _measured_choice(pin: Pencil, pout: Pencil, R: int, extra_dims: tuple,
         "margin_over_noise": (round((loser_t / best_t) / noise, 3)
                               if noise and best_t > 0 else None),
     }
+    _MEASURE_REPORTS[(pin, pout, R, extra_dims, dtype_str)] = report
+    # timings are kept (method objects + seconds) for the obs tap in
+    # resolve_method — journaling must NOT live inside this lru_cache,
+    # or a config resolved before obs was armed would never appear in a
+    # later run's journal (the late-arming contract)
+    _MEASURE_TIMINGS[(pin, pout, R, extra_dims, dtype_str)] = tuple(
+        zip(candidates, times))
     if jax.process_count() > 1:
         # Multi-controller: every process MUST run the same collective
         # program — local timing noise could split the vote, issuing
@@ -724,7 +827,10 @@ def resolve_method(pin: Pencil, pout: Pencil,
         import numpy as np
 
         dt = np.dtype(dtype if dtype is not None else np.float32)
-        return _measured_choice(pin, pout, R, tuple(extra_dims), dt.str)
+        choice = _measured_choice(pin, pout, R, tuple(extra_dims), dt.str)
+        if obs.enabled():
+            _obs_record_measure_verdict(pin, pout, R, tuple(extra_dims), dt)
+        return choice
     P = pin.topology.dims[R]
     ring = transpose_cost(pin, pout, tuple(extra_dims), dtype, Ring())
     if not ring:
@@ -735,7 +841,24 @@ def resolve_method(pin: Pencil, pout: Pencil,
     L = method.latency_bytes
     score_ring = rounds * (L + tile)
     score_a2a = L + (P - 1) * tile
-    return Ring() if score_ring < score_a2a else AllToAll()
+    winner = Ring() if score_ring < score_a2a else AllToAll()
+    if obs.enabled():
+        config = _hop_label(pin, pout, method, dtype)
+        # one journaled verdict per config PER OBS RUN (run ids are
+        # fresh per obs.enable(), so a later run's journal is complete)
+        key = (obs.run_id(), config)
+        if key not in _ESTIMATE_LOGGED:
+            _ESTIMATE_LOGGED.add(key)
+            obs.record_event(
+                "auto.verdict", mode="estimate", config=config,
+                winner=_method_label(winner),
+                score_ring_bytes=int(score_ring),
+                score_a2a_bytes=int(score_a2a),
+                latency_bytes=int(L))
+    return winner
+
+
+_ESTIMATE_LOGGED: set = set()
 
 
 # ---------------------------------------------------------------------------
@@ -823,9 +946,24 @@ def transpose(src: PencilArray, dest: Pencil, *,
         method = resolve_method(pin, dest, src.extra_dims, src.dtype, method)
     from ..ops.pallas_kernels import pallas_enabled
 
+    import jax.core
+
     with timeit(pin.timer, "transpose!"):
-        out = _compiled_transpose(pin, dest, R, src.ndims_extra, method,
-                                  donate, pallas_enabled())(src.data)
+        fn = _compiled_transpose(pin, dest, R, src.ndims_extra, method,
+                                 donate, pallas_enabled())
+        # the hop tap observes EAGER dispatches only: under an outer
+        # jit this call runs at trace time (once per compile), where a
+        # "duration" would be lowering time, not a dispatch — it must
+        # neither flood the journal per compile nor poison the drift
+        # fit (use obs.profile for device-side visibility of jitted
+        # programs)
+        if obs.enabled() and not isinstance(src.data, jax.core.Tracer):
+            t0 = time.perf_counter()
+            out = fn(src.data)
+            _obs_record_hop(pin, dest, R, method, src.extra_dims,
+                            src.dtype, time.perf_counter() - t0)
+        else:
+            out = fn(src.data)
     return PencilArray(dest, out, src.extra_dims)
 
 

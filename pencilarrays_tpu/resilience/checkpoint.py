@@ -41,6 +41,7 @@ import json
 import os
 import re
 import shutil
+import time
 from typing import Dict, List, Mapping, Optional, Tuple
 
 import numpy as np
@@ -181,6 +182,17 @@ class CheckpointManager:
                 "chunks=True is a BinaryDriver layout option; "
                 f"{type(self.driver).__name__} does not accept it")
         tmp, final = self._tmp_dir(step), self._step_dir(step)
+        from .. import obs
+
+        t_save0 = None
+        if obs.enabled():
+            t_save0 = time.perf_counter()
+            obs.counter("ckpt.saves").inc()
+            obs.record_event("ckpt.save", step=step, status="begin",
+                             dir=self.directory,
+                             driver=type(self.driver).__name__,
+                             datasets=sorted(state),
+                             checksums=self.checksums)
         if self._is_proc0():
             if os.path.exists(tmp):
                 shutil.rmtree(tmp)
@@ -261,10 +273,16 @@ class CheckpointManager:
                 # the one atomic commit point: COMMIT appears via replace
                 atomic_write_text(os.path.join(final, COMMIT_NAME),
                                   f"step {step}\n")
+                obs.record_event("ckpt.commit", step=step, dir=final)
             self._barrier("pa_ckpt_commit")
             if self._is_proc0():
                 self._gc(current=step)
             self._barrier("pa_ckpt_done")
+        if t_save0 is not None:
+            dt = time.perf_counter() - t_save0
+            obs.histogram("ckpt.save_seconds").observe(dt)
+            obs.record_event("ckpt.save", step=step, status="committed",
+                             seconds=dt)
         return final
 
     def _recover_replaced(self) -> None:
@@ -302,8 +320,10 @@ class CheckpointManager:
         directories.  Runs only after the current step's COMMIT landed,
         so everything left in the temp namespace is garbage by then."""
         self._recover_replaced()
+        removed = []
         for entry in os.listdir(self.directory):
             if entry.startswith(".tmp-"):
+                removed.append(entry)
                 shutil.rmtree(os.path.join(self.directory, entry),
                               ignore_errors=True)
         committed, torn = [], []
@@ -313,10 +333,19 @@ class CheckpointManager:
             if path != (self._step_dir(current) if current is not None
                         else None):
                 logger.warning("GC removing torn checkpoint %s", path)
+                removed.append(os.path.basename(path))
                 shutil.rmtree(path, ignore_errors=True)
         if self.keep is not None:
             for path in committed[:-self.keep]:
+                removed.append(os.path.basename(path))
                 shutil.rmtree(path, ignore_errors=True)
+        if removed:
+            from .. import obs
+
+            if obs.enabled():
+                obs.counter("ckpt.gc_removed").inc(len(removed))
+                obs.record_event("ckpt.gc", removed=sorted(removed),
+                                 dir=self.directory)
 
     # -- verify / discover -------------------------------------------------
     def _load_manifest(self, step: int) -> dict:
@@ -338,14 +367,25 @@ class CheckpointManager:
         manifest, dataset presence, and (when recorded) every block's
         checksum.  Raises :class:`CorruptCheckpointError` naming the
         first failing dataset/block."""
-        if not self.is_committed(step):
-            raise CorruptCheckpointError(
-                f"checkpoint step {step} has no COMMIT marker "
-                f"(missing or torn write)", step=step,
-                path=self._step_dir(step))
-        manifest = self._load_manifest(step)
-        for name, ds in manifest["datasets"].items():
-            self._verify_dataset(step, manifest, name, ds)
+        from .. import obs
+
+        try:
+            if not self.is_committed(step):
+                raise CorruptCheckpointError(
+                    f"checkpoint step {step} has no COMMIT marker "
+                    f"(missing or torn write)", step=step,
+                    path=self._step_dir(step))
+            manifest = self._load_manifest(step)
+            for name, ds in manifest["datasets"].items():
+                self._verify_dataset(step, manifest, name, ds)
+        except ResilienceError as e:
+            if obs.enabled():
+                obs.counter("ckpt.verify_failures").inc()
+                obs.record_event("ckpt.verify", step=step, ok=False,
+                                 error=str(e))
+            raise
+        if obs.enabled():
+            obs.record_event("ckpt.verify", step=step, ok=True)
 
     def _verify_dataset(self, step: int, manifest: dict, name: str,
                         ds: dict) -> None:
@@ -584,6 +624,11 @@ class Checkpoint:
                 f"dataset {name!r} not in checkpoint step {self.step} "
                 f"(has {self.datasets})")
         do_verify = self.verify if verify is None else verify
+        from .. import obs
+
+        t0 = None
+        if obs.enabled():
+            t0 = time.perf_counter()
         with timeit(self.manager.timer, "checkpoint restore"):
             if do_verify:
                 self.manager._verify_dataset(self.step, mf, name,
@@ -592,7 +637,14 @@ class Checkpoint:
                 self.path, mf.get("data_file", self.manager._data_name))
             with open_file(self.manager.driver, data_path, read=True,
                            retry=self.manager.retry) as f:
-                return f.read(name, pencil, extra_dims)
+                out = f.read(name, pencil, extra_dims)
+        if t0 is not None:
+            dt = time.perf_counter() - t0
+            obs.counter("ckpt.restores").inc()
+            obs.histogram("ckpt.restore_seconds").observe(dt)
+            obs.record_event("ckpt.restore", step=self.step, dataset=name,
+                             seconds=dt, verified=do_verify)
+        return out
 
     def read_state(self, pencil, names: Optional[List[str]] = None) -> Dict:
         """Restore several datasets (default: all) onto one pencil."""

@@ -234,6 +234,7 @@ def fire(point: str, **ctx) -> Optional[str]:
     for r in matching:
         if not r.triggers(hit):
             continue
+        _obs_firing(point, r.mode, hit, ctx)
         if r.mode == "kill":
             kill_now()
         if r.mode == "torn":
@@ -243,3 +244,19 @@ def fire(point: str, **ctx) -> Optional[str]:
             f"injected fault at {point} (hit {hit}){where}",
             point=point, hit=hit)
     return None
+
+
+def _obs_firing(point: str, mode: str, hit: int, ctx: dict) -> None:
+    """Journal a triggered rule through the obs flight recorder BEFORE
+    the fault takes effect — for ``kill``/``torn`` the record is the
+    only trace the dead process leaves (it is fsync'd: ``fault`` is a
+    critical event), which is what makes the SIGKILL restart drill's
+    timeline readable."""
+    from ..obs import enabled, record_event
+    from ..obs.metrics import counter
+
+    if not enabled():
+        return
+    counter("faults.fired", point=point, mode=mode).inc()
+    record_event("fault", point=point, mode=mode, hit=hit, **{
+        k: v for k, v in ctx.items() if k not in ("point", "mode", "hit")})

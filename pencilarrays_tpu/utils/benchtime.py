@@ -27,13 +27,18 @@ from typing import Callable
 
 __all__ = ["device_seconds_per_iter", "last_spread"]
 
-_LAST_SPREAD: dict = {"k1_worst_over_best": None}
+_LAST_SPREAD: dict = {"k1_worst_over_best": None, "slope_fallback": None}
 
 
 def last_spread() -> dict:
     """Per-repeat variance of the most recent measurement: the k1 arm's
     worst/best wall-clock ratio (1.0 = perfectly stable; tunnel noise
-    shows up here first)."""
+    shows up here first) plus ``slope_fallback`` — whether the slope
+    guard rejected the K-differenced slope and reported the conservative
+    ``t(k1)/k1`` upper bound instead.  Bench artifacts attach this per
+    metric so every number carries its own noise floor; with
+    observability enabled it also lands in the metrics snapshot
+    (``obs.snapshot()["benchtime"]``)."""
     return dict(_LAST_SPREAD)
 
 
@@ -61,11 +66,24 @@ def device_seconds_per_iter(body: Callable, x0, *, k0: int, k1: int,
 
     t_k0, _ = timed(k0)
     t_k1, w_k1 = timed(k1)
-    _LAST_SPREAD["k1_worst_over_best"] = round(w_k1 / t_k1, 3) if t_k1 else None
+    spread = round(w_k1 / t_k1, 3) if t_k1 else None
+    _LAST_SPREAD["k1_worst_over_best"] = spread
     slope = (t_k1 - t_k0) / (k1 - k0)
     upper = t_k1 / k1  # includes amortized dispatch: always >= true slope
-    if slope <= 0 or slope < 1e-3 * upper:
+    fallback = slope <= 0 or slope < 1e-3 * upper
+    _LAST_SPREAD["slope_fallback"] = fallback
+    if fallback:
         # noise swamped the difference (a stalled k0 arm, or jitter larger
         # than the loop): report the upper bound rather than an absurdity
         slope = upper
+    from ..obs import enabled as _obs_enabled
+
+    if _obs_enabled():
+        from ..obs import counter, gauge
+
+        counter("benchtime.measurements").inc()
+        if fallback:
+            counter("benchtime.slope_fallbacks").inc()
+        if spread is not None:
+            gauge("benchtime.last_spread").set(spread)
     return slope

@@ -85,6 +85,11 @@ def main():
     elif phase in ("ckpt", "killwrite"):
         from pencilarrays_tpu.resilience import CheckpointManager, faults
 
+        # arm the flight recorder: the SIGKILL drill must leave a
+        # readable event timeline (journal under <tmpdir>/obs; env is
+        # re-read on change, so arming after import works — the same
+        # late-arming contract as the faults env)
+        os.environ["PENCILARRAYS_TPU_OBS"] = os.path.join(tmpdir, "obs")
         topo = pa.Topology((2, 4))
         pen = pa.Pencil(topo, shape, (1, 2),
                         permutation=pa.Permutation(2, 0, 1))
@@ -109,6 +114,7 @@ def main():
     elif phase == "recover":
         from pencilarrays_tpu.resilience import CheckpointManager
 
+        os.environ["PENCILARRAYS_TPU_OBS"] = os.path.join(tmpdir, "obs")
         mgr = CheckpointManager(ckdir, keep=3)
         # the torn step-2 attempt must be invisible: only its temp
         # directory (never renamed, never committed) may remain

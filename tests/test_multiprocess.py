@@ -125,7 +125,10 @@ def test_restart_across_process_counts(tmp_path):
 def _run_kill_sequence(tmp_path, nprocs_ckpt, nprocs_kill, nprocs_recover):
     """commit step 1 -> SIGKILL mid-step-2-write -> restart: the torn
     attempt is invisible, ``latest_valid()`` lands on step 1, and the
-    recovered array is bit-identical to ground truth."""
+    recovered array is bit-identical to ground truth.  The obs flight
+    recorder (armed by the worker) must leave a schema-clean timeline
+    that tells the whole story — including from inside the dead
+    processes."""
     import signal
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -139,7 +142,43 @@ def _run_kill_sequence(tmp_path, nprocs_ckpt, nprocs_kill, nprocs_recover):
     leftovers = sorted(os.listdir(ckdir))
     assert "step-00000001" in leftovers
     assert "step-00000002" not in leftovers, leftovers
+    _assert_kill_timeline(os.path.join(str(tmp_path), "obs"), after_kill=True)
     _run_phase(worker, tmp_path, nprocs_recover, "recover")
+    _assert_kill_timeline(os.path.join(str(tmp_path), "obs"),
+                          after_kill=False)
+
+
+def _assert_kill_timeline(obs_dir, after_kill):
+    """The journal is the post-mortem: step 1 committed, step 2 began
+    and hit the injected torn fault, step 2 NEVER committed — and after
+    recovery, step 1 was restored.  Every record passes the schema
+    lint."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from pencilarrays_tpu.obs import lint_journal, read_journal
+
+    events = read_journal(obs_dir)
+    assert lint_journal(events) == [], lint_journal(events)[:5]
+    commits = {e["step"] for e in events if e["ev"] == "ckpt.commit"}
+    assert commits == {1}, commits  # step 2's commit must never exist
+    begins = {e["step"] for e in events
+              if e["ev"] == "ckpt.save" and e["status"] == "begin"}
+    assert begins == {1, 2}, begins
+    done = {e["step"] for e in events
+            if e["ev"] == "ckpt.save" and e["status"] == "committed"}
+    assert done == {1}, done
+    # the dying processes journaled the torn firing before SIGKILL
+    faults_fired = [e for e in events if e["ev"] == "fault"]
+    assert faults_fired and all(
+        e["point"] == "io.write_block" and e["mode"] == "torn"
+        for e in faults_fired), faults_fired
+    restores = [e for e in events if e["ev"] == "ckpt.restore"]
+    if after_kill:
+        assert restores == []
+    else:
+        assert {e["step"] for e in restores} == {1}
 
 
 @pytest.mark.chaos

@@ -1,0 +1,435 @@
+"""Observability subsystem: journal round-trip + schema, disabled-path
+no-op, TimerOutput thread-safety regression, drift arithmetic, exporters.
+
+The contracts under test (ISSUE 3 acceptance):
+
+* with ``PENCILARRAYS_TPU_OBS`` unset nothing is written, created or
+  allocated — instrumented hot paths stay no-op;
+* with it set, one run produces a JSONL journal whose every record
+  passes the schema lint (``obs/schema.py``), plus a metrics snapshot
+  carrying per-hop predicted-vs-measured drift;
+* ``TimerOutput`` survives concurrent use (the PR-2 checksum thread
+  pool corrupted the old shared stack) and merges across timers;
+* the drift tracker's fitted-bandwidth arithmetic is exact on synthetic
+  timings.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu import obs
+from pencilarrays_tpu.obs import drift as obs_drift
+from pencilarrays_tpu.obs import events as obs_events
+from pencilarrays_tpu.obs import metrics as obs_metrics
+from pencilarrays_tpu.utils.timers import TimerOutput
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(monkeypatch):
+    """Every test starts disabled with empty registries and no journal."""
+    monkeypatch.delenv(obs.ENV_VAR, raising=False)
+    monkeypatch.delenv("PENCILARRAYS_TPU_OBS_DIR", raising=False)
+    monkeypatch.delenv("PENCILARRAYS_TPU_OBS_FSYNC", raising=False)
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    obs_drift.drift_tracker.reset()
+    yield
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    obs_drift.drift_tracker.reset()
+
+
+def _mk_pencils():
+    topo = pa.Topology((2, 4))
+    pen_x = pa.Pencil(topo, (9, 12, 10), (1, 2))
+    pen_y = pa.Pencil(topo, (9, 12, 10), (0, 2))
+    return pen_x, pen_y
+
+
+# ---------------------------------------------------------------------------
+# disabled path: strict no-op
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_is_noop(tmp_path):
+    assert not obs.enabled()
+    assert obs.record_event("hop", method="AllToAll") is False
+    # instrumented operations must neither create files nor metrics
+    pen_x, pen_y = _mk_pencils()
+    u = pa.PencilArray.zeros(pen_x)
+    pa.transpose(u, pen_y)
+    assert not os.path.exists(obs_events.DEFAULT_DIR)
+    snap = obs.snapshot()
+    assert snap["counters"] == {} and snap["histograms"] == {}
+    assert snap["drift"]["hops"] == {}
+
+
+def test_env_gate_re_read_on_change(tmp_path, monkeypatch):
+    """Workers arm observability after import (the faults.py contract)."""
+    assert not obs.enabled()
+    monkeypatch.setenv(obs.ENV_VAR, str(tmp_path / "j"))
+    assert obs.enabled()
+    assert obs.journal_dir() == str(tmp_path / "j")
+    monkeypatch.setenv(obs.ENV_VAR, "0")
+    assert not obs.enabled()
+
+
+# ---------------------------------------------------------------------------
+# journal round-trip + schema
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_and_schema(tmp_path, monkeypatch):
+    """One instrumented run -> parseable, schema-clean, ordered journal
+    containing the event families the flight recorder promises."""
+    jdir = str(tmp_path / "obs")
+    monkeypatch.setenv(obs.ENV_VAR, jdir)
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+    from pencilarrays_tpu.resilience import (CheckpointManager, RetryPolicy,
+                                             faults)
+
+    topo = pa.Topology((2, 4))
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True, pipeline=2)
+    plan.backward(plan.forward(plan.allocate_input()))
+    pen_x, pen_y = _mk_pencils()
+    pa.transpose(pa.PencilArray.zeros(pen_x), pen_y)
+    mgr = CheckpointManager(
+        str(tmp_path / "ck"), keep=2,
+        retry=RetryPolicy(max_attempts=3, base_delay=0.001, jitter=0.0))
+    state = {"u": pa.PencilArray.from_global(
+        pen_x, np.arange(9 * 12 * 10, dtype=np.float32).reshape(9, 12, 10))}
+    with faults.active("io.open:error*1@1"):
+        mgr.save(0, state)  # first open errors -> fault + retry events
+    mgr.restore().read("u", pen_x)
+
+    events = obs.read_journal(jdir)
+    assert obs.lint_journal(events) == []
+    kinds = {e["ev"] for e in events}
+    for required in ("run.start", "plan.build", "hop", "io.open", "io.write",
+                     "ckpt.save", "ckpt.commit", "ckpt.verify",
+                     "ckpt.restore", "fault", "retry"):
+        assert required in kinds, f"missing {required} in {sorted(kinds)}"
+    # common envelope: one run id, per-process monotonic seq
+    runs = {e["run"] for e in events}
+    assert len(runs) == 1
+    seqs = [e["seq"] for e in events if e["proc"] == 0]
+    assert seqs == sorted(seqs)
+    # the save timeline is ordered: begin < commit < committed-status
+    t_begin = next(e["t_mono"] for e in events
+                   if e["ev"] == "ckpt.save" and e["status"] == "begin")
+    t_commit = next(e["t_mono"] for e in events if e["ev"] == "ckpt.commit")
+    t_done = next(e["t_mono"] for e in events
+                  if e["ev"] == "ckpt.save" and e["status"] == "committed")
+    assert t_begin < t_commit < t_done
+    # fault fired at the io.open point, retry references the same label
+    fault = next(e for e in events if e["ev"] == "fault")
+    assert fault["point"] == "io.open" and fault["mode"] == "error"
+    retry = next(e for e in events if e["ev"] == "retry")
+    assert retry["attempt"] == 1 and "InjectedFault" in retry["error"]
+    # hop events carry the cost-model prediction
+    hop = next(e for e in events if e["ev"] == "hop" and e["r"] is not None)
+    assert hop["predicted_bytes"] > 0 and hop["dispatch_s"] >= 0
+
+
+def test_journal_survives_torn_line(tmp_path, monkeypatch):
+    jdir = str(tmp_path / "obs")
+    monkeypatch.setenv(obs.ENV_VAR, jdir)
+    obs.record_event("run.stop")
+    path = os.path.join(jdir, "journal.r0.jsonl")
+    with open(path, "a") as f:
+        f.write('{"v":1,"ev":"hop","tor')  # the torn tail of a crash
+    events = obs.read_journal(jdir)
+    assert [e["ev"] for e in events] == ["run.start", "run.stop"]
+
+
+def test_schema_lint_catches_drift(tmp_path):
+    ok = {"v": 1, "ev": "fault", "run": "r", "proc": 0, "seq": 1,
+          "t_wall": 0.0, "t_mono": 0.0, "point": "io.open",
+          "mode": "error", "hit": 1}
+    assert obs.lint_event(ok) == []
+    unknown = dict(ok, ev="not.registered")
+    assert any("unknown event type" in e for e in obs.lint_event(unknown))
+    missing = dict(ok)
+    del missing["point"]
+    assert any("missing required field 'point'" in e
+               for e in obs.lint_event(missing))
+    torn = dict(ok)
+    del torn["seq"]
+    assert any("missing common field" in e for e in obs.lint_event(torn))
+
+
+def test_metrics_snapshot_and_prometheus(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.ENV_VAR, str(tmp_path / "obs"))
+    obs.counter("test.count", kind="a").inc(3)
+    obs.gauge("test.gauge").set(2.5)
+    h = obs.histogram("test.seconds")
+    for v in (0.5, 1.5, 4.0):
+        h.observe(v)
+    snap = obs.snapshot()
+    assert snap["counters"]["test.count{kind=a}"] == 3
+    assert snap["gauges"]["test.gauge"] == 2.5
+    hs = snap["histograms"]["test.seconds"]
+    assert hs["count"] == 3 and hs["min"] == 0.5 and hs["max"] == 4.0
+    assert hs["mean"] == pytest.approx(2.0)
+    assert "slope_fallback" in snap["benchtime"]  # the bench noise floor
+    # snapshot is JSON-serializable and atomically publishable
+    path = obs.write_snapshot()
+    with open(path) as f:
+        assert json.load(f)["counters"]["test.count{kind=a}"] == 3
+    text = obs.to_prometheus()
+    assert 'pa_test_count_total{kind="a"} 3' in text
+    assert "pa_test_gauge 2.5" in text
+    assert "pa_test_seconds_count 3" in text
+    pp = obs.write_prometheus(str(tmp_path / "metrics.prom"))
+    with open(pp) as f:
+        assert f.read() == text
+
+
+# ---------------------------------------------------------------------------
+# TimerOutput thread-safety regression + merge
+# ---------------------------------------------------------------------------
+
+
+def test_timer_output_concurrent_nesting():
+    """The pre-obs TimerOutput shared ONE mutable stack: concurrent
+    ``timeit`` blocks interleaved push/pop and corrupted the tree (the
+    PR-2 checksum pool dispatches concurrently).  The stack is now
+    thread-local; every nested call must land under its own parent with
+    exact counts."""
+    t = TimerOutput("conc")
+    NT, REPS = 8, 200
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(REPS):
+                with t("outer"):
+                    with t("inner"):
+                        pass
+        except Exception as e:  # pre-fix: IndexError / wrong nesting
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(NT)]
+    for th in threads:
+        th.start()
+    # reading WHILE timing must never crash (merge snapshots racy
+    # children with a bounded retry, not a lock on the hot path)
+    for _ in range(50):
+        t.report()
+        t.snapshot()
+    for th in threads:
+        th.join()
+    assert errors == []
+    snap = t.snapshot()
+    outer = snap["children"]["outer"]
+    assert outer["ncalls"] == NT * REPS
+    assert outer["children"]["inner"]["ncalls"] == NT * REPS
+    assert "inner" not in snap["children"]  # nesting never flattened
+
+
+def test_timer_output_merge_cross_timer_and_snapshot():
+    a, b = TimerOutput("a"), TimerOutput("b")
+    with a("transpose!"):
+        pass
+    with b("transpose!"):
+        with b("pack data"):
+            pass
+    a.merge(b)
+    snap = a.snapshot()
+    assert snap["children"]["transpose!"]["ncalls"] == 2
+    assert snap["children"]["transpose!"]["children"][
+        "pack data"]["ncalls"] == 1
+    # cross-process wire format: a peer ships snapshot(), proc0 merges
+    c = TimerOutput("c").merge(json.loads(json.dumps(b.snapshot())))
+    assert c.snapshot()["children"]["transpose!"]["ncalls"] == 1
+
+
+def test_timer_output_thread_churn_is_bounded():
+    """Short-lived threads (the I/O layer spawns a pool per write) must
+    not grow timer state without bound — dead threads' trees fold into
+    the retired accumulator, losing nothing."""
+    t = TimerOutput("churn")
+
+    def one_shot():
+        with t("w"):
+            pass
+
+    for _ in range(50):
+        th = threading.Thread(target=one_shot)
+        th.start()
+        th.join()
+    snap = t.snapshot()  # prunes dead-thread roots
+    assert snap["children"]["w"]["ncalls"] == 50
+    assert len(t._roots) <= 1  # only (at most) the caller's root remains
+    assert t.snapshot()["children"]["w"]["ncalls"] == 50  # idempotent
+
+
+def test_timer_output_reset_under_threads():
+    t = TimerOutput("r")
+    with t("s"):
+        pass
+    t.reset()
+    assert t.snapshot()["children"] == {}
+    with t("s2"):
+        pass
+    assert t._root.children["s2"].ncalls == 1  # back-compat accessor
+
+
+# ---------------------------------------------------------------------------
+# drift tracker arithmetic (synthetic timings)
+# ---------------------------------------------------------------------------
+
+
+def test_drift_arithmetic_synthetic():
+    tr = obs_drift.DriftTracker()
+    tr.record("A", 100, 1.0, source="benchtime")
+    tr.record("B", 300, 3.0, source="benchtime")
+    rep = tr.report()
+    # byte-weighted fit: (100+300) bytes / (1+3) s = 100 B/s, zero drift
+    assert rep["fitted_bytes_per_s"] == pytest.approx(100.0)
+    assert rep["hops"]["A"]["drift"] == pytest.approx(1.0)
+    assert rep["hops"]["B"]["drift"] == pytest.approx(1.0)
+    # min-tracking: a slower repeat must not move the representative
+    tr.record("B", 300, 9.0, source="benchtime")
+    rep = tr.report()
+    assert rep["hops"]["B"]["measured_s"] == pytest.approx(3.0)
+    assert rep["hops"]["B"]["count"] == 2
+    assert rep["hops"]["B"]["last_s"] == pytest.approx(9.0)
+    # a hop 3x over its byte-predicted time drifts to exactly 15/7
+    tr.record("C", 100, 3.0, source="benchtime")
+    rep = tr.report()
+    assert rep["fitted_bytes_per_s"] == pytest.approx(500.0 / 7.0)
+    assert rep["hops"]["C"]["drift"] == pytest.approx(15.0 / 7.0)
+    assert rep["hops"]["A"]["drift"] == pytest.approx(5.0 / 7.0)
+
+
+def test_drift_source_ranking_and_zero_bytes():
+    tr = obs_drift.DriftTracker()
+    tr.record("A", 100, 50.0, source="dispatch")
+    tr.record("A", 100, 1.0, source="benchtime")
+    tr.record("A", 100, 70.0, source="dispatch")
+    rep = tr.report()
+    assert rep["hops"]["A"]["source"] == "benchtime"
+    assert rep["hops"]["A"]["measured_s"] == pytest.approx(1.0)
+    # local permute: nothing on the wire, drift undefined (never inf)
+    tr.record("L", 0, 1.0, source="dispatch")
+    rep = tr.report()
+    assert rep["hops"]["L"]["drift"] is None
+    with pytest.raises(ValueError):
+        tr.record("A", 1, 1.0, source="bogus")
+
+
+def test_no_trace_time_hop_events_under_jit(tmp_path, monkeypatch):
+    """transpose() inside a user jit runs the tap at TRACE time: it must
+    journal nothing (one event per compile would misrepresent thousands
+    of executions) and feed no lowering-time garbage to the drift fit."""
+    import jax
+
+    monkeypatch.setenv(obs.ENV_VAR, str(tmp_path / "obs"))
+    pen_x, pen_y = _mk_pencils()
+    u = pa.PencilArray.zeros(pen_x)
+
+    @jax.jit
+    def step(d):
+        return pa.transpose(pa.PencilArray(pen_x, d), pen_y).data
+
+    for _ in range(3):
+        step(u.data)
+    assert [e for e in obs.read_journal() if e["ev"] == "hop"] == []
+    assert obs.drift_report()["hops"] == {}
+
+
+def test_drift_fits_are_per_source_class():
+    """A dispatch sample is a LOWER bound on wire time (enqueue only):
+    it must be fitted among dispatch samples and never pollute the
+    device-protocol fit (one enqueue-timed hop in a shared fit would
+    invert every other hop's verdict)."""
+    tr = obs_drift.DriftTracker()
+    tr.record("D1", 100, 0.001, source="dispatch")   # absurdly fast
+    tr.record("T1", 100, 1.0, source="benchtime")
+    rep = tr.report()
+    assert rep["fitted_bytes_per_s"] == pytest.approx(100.0)
+    assert rep["dispatch_fitted_bytes_per_s"] == pytest.approx(1e5)
+    assert rep["hops"]["T1"]["drift"] == pytest.approx(1.0)  # unpolluted
+    assert rep["hops"]["D1"]["drift"] == pytest.approx(1.0)
+
+
+def test_io_op_journals_failures_honestly(tmp_path, monkeypatch):
+    """A raising driver operation lands in the journal as failed, and
+    its bytes are NOT counted as written."""
+    monkeypatch.setenv(obs.ENV_VAR, str(tmp_path / "obs"))
+    with pytest.raises(RuntimeError, match="boom"):
+        with obs.io_op("io.write", "BinaryDriver", "/nowhere", "u", 1000):
+            raise RuntimeError("boom")
+    ev = next(e for e in obs.read_journal() if e["ev"] == "io.write")
+    assert ev["ok"] is False and "boom" in ev["error"]
+    assert ev["bytes"] == 1000  # the intended size, for the post-mortem
+    snap = obs.snapshot()
+    assert "io.bytes_written{driver=BinaryDriver}" not in snap["counters"]
+    assert obs.lint_journal(obs.read_journal()) == []
+
+
+def test_dispatch_feeds_drift_and_measure_transpose(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.ENV_VAR, str(tmp_path / "obs"))
+    pen_x, pen_y = _mk_pencils()
+    u = pa.PencilArray.zeros(pen_x)
+    pa.transpose(u, pen_y)
+    rep = obs.drift_report()
+    assert len(rep["hops"]) == 1
+    (hop, entry), = rep["hops"].items()
+    assert "AllToAll" in hop and entry["source"] == "dispatch"
+    assert entry["predicted_bytes"] > 0
+    # the benchtime-protocol entry point upgrades the hop's source
+    out = obs_drift.measure_transpose(u, pen_y, k0=1, k1=2, repeats=1)
+    assert out["predicted_bytes"] == entry["predicted_bytes"]
+    rep = obs.drift_report()
+    assert rep["hops"][hop]["source"] == "benchtime"
+    snap = obs.snapshot()
+    assert snap["drift"]["hops"][hop]["source"] == "benchtime"
+    # benchtime satellites: measurement count + spread landed as metrics
+    assert snap["counters"]["benchtime.measurements"] >= 1
+    assert "drift.sample" in {e["ev"] for e in obs.read_journal()}
+
+
+# ---------------------------------------------------------------------------
+# span / profile
+# ---------------------------------------------------------------------------
+
+
+def test_span_three_sinks(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.ENV_VAR, str(tmp_path / "obs"))
+    timer = TimerOutput("spans")
+    pa.enable_debug_timings()
+    try:
+        with obs.span("drill section", timer=timer):
+            pass
+    finally:
+        pa.disable_debug_timings()
+    assert timer._root.children["drill section"].ncalls == 1
+    snap = obs.snapshot()
+    assert snap["histograms"]["span.seconds{label=drill section}"][
+        "count"] == 1
+
+
+def test_profile_stamps_capture_metadata(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.ENV_VAR, str(tmp_path / "obs"))
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+
+    topo = pa.Topology((2, 4))
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True)
+    cap = str(tmp_path / "capture")
+    with obs.profile(cap, plan=plan, note="unit test"):
+        plan.forward(plan.allocate_input())
+    with open(os.path.join(cap, "pa_capture_metadata.json")) as f:
+        stamp = json.load(f)
+    assert stamp["plan"]["transforms"] == list(plan.transforms)
+    assert stamp["plan"]["predicted_costs"] == plan.collective_costs()
+    assert stamp["metadata"]["note"] == "unit test"
+    evs = [e for e in obs.read_journal() if e["ev"] == "profile"]
+    assert [e["status"] for e in evs] == ["start", "stop"]
+    assert obs.lint_journal(obs.read_journal()) == []
