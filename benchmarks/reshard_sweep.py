@@ -1,0 +1,171 @@
+"""Routed-vs-GSPMD reshard sweep — writes ``RESHARD_SWEEP.json``.
+
+For each multi-slot redistribution config: plan the route
+(``parallel/routing.py``), time the routed fused chain against the
+GSPMD single-exchange executable (forward+back pair — shape-preserving,
+as the hardened K-differenced protocol requires), and record the
+planner's predicted bytes for both so the artifact shows prediction
+next to measurement.  The sweep is the evidence base for the planner's
+verdict rule (route only when the model prices it cheaper than GSPMD).
+
+Honest-measurement note: on the CPU virtual mesh (used automatically
+when fewer than 2 real devices exist) collectives lower synchronously
+and both pipelines run the same wire bytes, so CPU numbers mostly
+measure launch/fusion overhead; the artifact records the platform, as
+with ``PIPELINE_SWEEP.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _utcnow():
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def _configs(topo, shape):
+    """Multi-slot pencil pairs exercising even shards, uneven shards and
+    permuted memory orders on an M=2 topology."""
+    from pencilarrays_tpu import Pencil, Permutation
+
+    pairs = [
+        ("both-slots", Pencil(topo, shape, (1, 2)),
+         Pencil(topo, shape, (0, 1))),
+        ("both-slots-permuted",
+         Pencil(topo, shape, (1, 2), permutation=Permutation(2, 0, 1)),
+         Pencil(topo, shape, (0, 1), permutation=Permutation(1, 2, 0))),
+        ("slot-swap", Pencil(topo, shape, (1, 2)),
+         Pencil(topo, shape, (2, 1))),
+    ]
+    return pairs
+
+
+def measure_reshards(topo, shape, *, dtype=None, k0=1, k1=8, repeats=3):
+    """Per-config routed vs GSPMD seconds + predicted bytes; returns the
+    ``points`` list of the artifact."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pencilarrays_tpu import PencilArray, plan_reshard_route
+    from pencilarrays_tpu.parallel.routing import _compiled_route
+    from pencilarrays_tpu.parallel.transpositions import _compiled_reshard
+    from pencilarrays_tpu.ops.pallas_kernels import pallas_enabled
+    from pencilarrays_tpu.utils.benchtime import (device_seconds_per_iter,
+                                                  last_spread)
+
+    dtype = dtype or jnp.float32
+    points = []
+    for name, pin, pout in _configs(topo, shape):
+        x = PencilArray.zeros(pin, dtype=dtype)
+        fwd_plan = plan_reshard_route(pin, pout, (), dtype)
+        bwd_plan = plan_reshard_route(pout, pin, (), dtype)
+        entry = {
+            "config": f"{name} {tuple(shape)}@{topo.dims} "
+                      f"{pin.decomposition}->{pout.decomposition}",
+            "verdict": fwd_plan.verdict,
+            "gspmd_predicted_bytes":
+                (sum(v["bytes"] for v in fwd_plan.gspmd_cost.values())
+                 if fwd_plan.gspmd_cost else None),
+        }
+        g_fwd = _compiled_reshard(pin, pout, 0)
+        g_bwd = _compiled_reshard(pout, pin, 0)
+        entry["gspmd_seconds"] = device_seconds_per_iter(
+            lambda d: g_bwd(g_fwd(d)), x.data, k0=k0, k1=k1,
+            repeats=repeats) / 2
+        entry["gspmd_k1_spread"] = last_spread()["k1_worst_over_best"]
+        if fwd_plan.hops and bwd_plan.hops:
+            r_fwd = _compiled_route(
+                fwd_plan.pencils, tuple(h.method for h in fwd_plan.hops),
+                0, False, pallas_enabled())
+            r_bwd = _compiled_route(
+                bwd_plan.pencils, tuple(h.method for h in bwd_plan.hops),
+                0, False, pallas_enabled())
+            entry.update({
+                "route": [list(h.dest.decomposition)
+                          for h in fwd_plan.hops],
+                "routed_predicted_bytes": sum(
+                    v["bytes"] for h in fwd_plan.hops
+                    for v in h.cost.values()),
+                "routed_peak_hbm_bytes": fwd_plan.peak_hbm_bytes,
+                "routed_seconds": device_seconds_per_iter(
+                    lambda d: r_bwd(r_fwd(d)), x.data, k0=k0, k1=k1,
+                    repeats=repeats) / 2,
+                "routed_k1_spread": last_spread()["k1_worst_over_best"],
+            })
+            if entry["routed_seconds"] > 0:
+                entry["gspmd_over_routed"] = (
+                    entry["gspmd_seconds"] / entry["routed_seconds"])
+            np.testing.assert_array_equal(  # the sweep never times a lie
+                np.asarray(g_fwd(x.data)), np.asarray(r_fwd(x.data)))
+        else:
+            entry["route"] = None  # no admissible single-slot chain
+        points.append(entry)
+    return points
+
+
+def write_artifact(topo, shape, points, out, devs=None):
+    """Assemble + write the RESHARD_SWEEP.json document — the ONE
+    schema both entry points (this script and ``suite.py --reshard``)
+    emit."""
+    if devs is None:
+        import jax
+
+        devs = topo.mesh.devices.flat[:1] if hasattr(topo, "mesh") else \
+            jax.devices()[:1]
+    d0 = devs[0]
+    doc = {
+        "captured_utc": _utcnow(),
+        "platform": d0.platform,
+        "device_kind": getattr(d0, "device_kind", "?"),
+        "n_devices": int(len(topo)) if hasattr(topo, "__len__") else None,
+        "topology": list(topo.dims),
+        "shape": list(shape),
+        "points": points,
+    }
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--shape", type=int, nargs=3, default=(96, 80, 72))
+    parser.add_argument("--devices", type=int, default=0,
+                        help="0 = all available (CPU fallback forces 8)")
+    parser.add_argument("--out", default=os.path.join(
+        _REPO, "RESHARD_SWEEP.json"))
+    parser.add_argument("--k1", type=int, default=8)
+    args = parser.parse_args(argv)
+
+    n_virtual = args.devices if args.devices > 1 else 8
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform"
+                                 f"_device_count={n_virtual}")
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        devs = jax.devices("cpu")
+
+    from pencilarrays_tpu import Topology, dims_create
+
+    n_use = args.devices or len(devs)
+    dims = dims_create(n_use, 2)
+    topo = Topology(dims, devices=devs[:n_use])
+    points = measure_reshards(topo, tuple(args.shape), k1=args.k1)
+    doc = write_artifact(topo, tuple(args.shape), points, args.out,
+                         devs=devs[:n_use])
+    print(json.dumps(doc, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -228,6 +228,10 @@ def main():
     parser.add_argument("--resilience-n", type=int, default=192,
                         help="cube edge of the resilience benchmark state "
                              "(f32; 192^3 = 28 MiB per dataset)")
+    parser.add_argument("--reshard", action="store_true",
+                        help="also run the routed-vs-GSPMD reshard sweep "
+                             "(benchmarks/reshard_sweep.py; needs >= 2 "
+                             "devices, writes RESHARD_SWEEP.json)")
     parser.add_argument("--obs", action="store_true",
                         help="also measure instrumented-vs-uninstrumented "
                              "transpose dispatch overhead (the obs "
@@ -384,6 +388,18 @@ def main():
 
         points, verdict = measure_roundtrips(topo, (n, n, n), k1=12)
         results["pipeline_sweep"] = {"points": points, "verdict": verdict}
+
+    # -- 6b. reshard route sweep (opt-in: routed chain vs GSPMD) ----------
+    # Registered here but OFF by default (slow-marked smoke test on the
+    # pytest side); full artifact via ``python benchmarks/reshard_sweep.py``.
+    if args.reshard and len(devs) > 1:
+        from benchmarks.reshard_sweep import measure_reshards, write_artifact
+
+        reshard_shape = (96, 80, 72)
+        points = measure_reshards(topo, reshard_shape)
+        results["reshard_sweep"] = {"points": points}
+        write_artifact(topo, reshard_shape, points, "RESHARD_SWEEP.json",
+                       devs=devs)
 
     # -- 7. resilience: checkpoint throughput, checksums on vs off --------
     # Opt-in (wall-clock disk I/O, several hundred MB written): what does

@@ -32,7 +32,14 @@ from .utils.permuted_indices import (  # noqa: F401
     PermutedCartesianIndices,
     PermutedLinearIndices,
 )
-from .parallel import (  # noqa: F401
+from .utils.jaxcompat import configure_compilation_cache  # noqa: F401
+
+# env knob PENCILARRAYS_TPU_COMPILE_CACHE=<dir>: persistent executable
+# cache across process restarts (hits/misses of the in-process caches
+# are obs-metered as compile.cache_hits|misses)
+configure_compilation_cache()
+
+from .parallel import (  # noqa: F401,E402
     AllToAll,
     Alltoallv,
     Auto,
@@ -47,13 +54,17 @@ from .parallel import (  # noqa: F401
     MemoryOrder,
     Pencil,
     PencilArray,
+    ReshardRoute,
     Topology,
     Transposition,
     dims_create,
+    execute_route,
     gather,
     global_view,
+    gspmd_reshard_cost,
     local_data_range,
     make_pencil,
+    plan_reshard_route,
     reshard,
     transpose,
     transpose_cost,
@@ -70,7 +81,7 @@ from .resilience import (  # noqa: F401
     RetryPolicy,
 )
 from .parallel import distributed  # noqa: F401
-from .ops.fft import PencilFFTPlan  # noqa: F401
+from .ops.fft import CompiledPlan, PencilFFTPlan  # noqa: F401
 from .compat import (  # noqa: F401
     GlobalPencilArray,
     PencilArrayCollection,

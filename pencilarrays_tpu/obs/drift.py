@@ -51,6 +51,19 @@ class DriftTracker:
     def __init__(self):
         self._lock = threading.Lock()
         self._samples: Dict[tuple, dict] = {}
+        self._version = 0
+
+    def version(self) -> int:
+        """Monotonic TRUSTED-sample-state counter (bumped by reset and
+        by every non-``dispatch`` record): consumers that cache
+        decisions derived from the report — the reshard route planner's
+        edge weights — key on it so fresh device-protocol samples
+        invalidate stale plans.  Per-dispatch samples deliberately do
+        NOT bump it: the planner ignores them, and with obs armed every
+        eager hop records one — bumping would churn the plan cache on
+        every transpose.  0 means no trusted sample has ever landed."""
+        with self._lock:
+            return self._version
 
     def record(self, hop: str, predicted_bytes: int, measured_s: float,
                source: str = "dispatch") -> None:
@@ -61,6 +74,8 @@ class DriftTracker:
         measured_s = float(measured_s)
         key = (str(hop), source)
         with self._lock:
+            if source != "dispatch":
+                self._version += 1
             s = self._samples.get(key)
             if s is None:
                 self._samples[key] = {
@@ -78,6 +93,7 @@ class DriftTracker:
 
     def reset(self) -> None:
         with self._lock:
+            self._version += 1
             self._samples.clear()
 
     @staticmethod

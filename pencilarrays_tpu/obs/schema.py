@@ -29,6 +29,8 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     # planner / transpose engine
     "plan.build": ("shape", "transforms", "topo", "pipeline", "steps"),
     "auto.verdict": ("mode", "winner", "config"),
+    "route.plan": ("src", "dest", "verdict", "candidates",
+                   "predicted_bytes"),
     "hop": ("method", "r", "chunks", "predicted_bytes", "dispatch_s"),
     # I/O drivers
     "io.open": ("path", "mode"),

@@ -19,10 +19,17 @@ from .transpositions import (
     Gspmd,
     Transposition,
     assert_compatible,
+    gspmd_reshard_cost,
     reshard,
     resolve_method,
     transpose,
     transpose_cost,
+)
+from .routing import (
+    ReshardRoute,
+    RouteHop,
+    execute_route,
+    plan_reshard_route,
 )
 from .gather import gather
 from .multiarrays import ManyPencilArray
@@ -42,7 +49,12 @@ __all__ = [
     "AllToAll",
     "Gspmd",
     "Transposition",
+    "ReshardRoute",
+    "RouteHop",
     "assert_compatible",
+    "execute_route",
+    "gspmd_reshard_cost",
+    "plan_reshard_route",
     "reshard",
     "transpose",
     "transpose_cost",

@@ -124,6 +124,28 @@ class ManyPencilArray:
             self._index = nxt
         return self._array
 
+    def reshard_to(self, i: int, *, donate: bool = True,
+                   method: Optional[AbstractTransposeMethod] = None
+                   ) -> PencilArray:
+        """Jump the live data straight to configuration ``i`` as ONE
+        routed reshard: the route planner (``parallel/routing.py``)
+        searches the pencil graph and the winner executes as a single
+        fused program — unlike :meth:`transpose_to`, which Python-loops
+        through this chain's intermediate configurations one dispatch
+        per hop.  Equivalent data movement, fewer dispatches; the
+        planner may even find a cheaper chain than the stored one."""
+        from .transpositions import Auto, reshard
+
+        if not (0 <= i < len(self._pencils)):
+            raise IndexError(f"configuration {i} out of range")
+        if i == self._index:
+            return self._array
+        self._array = reshard(self._array, self._pencils[i],
+                              method=method if method is not None else Auto(),
+                              donate=donate)
+        self._index = i
+        return self._array
+
     def cycle(self, *, method: AbstractTransposeMethod = AllToAll()):
         """Generator over the full chain 0 -> 1 -> ... -> M-1, yielding
         each configuration's array (the x->y->z sweep of a PencilFFT)."""

@@ -292,6 +292,22 @@ class Pencil:
     def length_local(self, coords=None) -> int:
         return math.prod(self.size_local(coords))
 
+    def bytes_per_device(self, extra_dims: Sequence[int] = (),
+                         dtype=None, *, isize: Optional[int] = None) -> int:
+        """Per-chip bytes of the padded backing block (+ replicated
+        extra dims) — the HBM accounting unit the reshard route
+        planner's peak bound uses (``parallel/routing.py``).  ``isize``
+        overrides the dtype's itemsize when the caller already has it."""
+        import numpy as np
+
+        if isize is None:
+            isize = np.dtype(dtype if dtype is not None
+                             else np.float32).itemsize
+        n = math.prod(self.padded_size_local(LogicalOrder))
+        for e in extra_dims:
+            n *= int(e)
+        return n * int(isize)
+
     def to_local(self, global_inds: Sequence[int], coords: Sequence[int] = None,
                  order: IndexOrder = LogicalOrder) -> Tuple[int, ...]:
         """Convert global indices to indices local to the block at ``coords``

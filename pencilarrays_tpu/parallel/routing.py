@@ -1,0 +1,442 @@
+"""Cost-driven reshard route planner — searched single-axis hop chains.
+
+The unrestricted :func:`~pencilarrays_tpu.parallel.transpositions.reshard`
+historically punted every multi-slot redistribution to one opaque
+GSPMD-partitioned exchange.  "Memory-efficient array redistribution
+through portable collective communication" (arXiv:2112.01075) shows the
+alternative: decompose the redistribution into a *searched sequence* of
+cheap single-axis collectives, each of which the framework can price,
+schedule and verify.  This module is that planner for pencil
+configurations:
+
+* **nodes** — every valid decomposition assignment on the topology:
+  ordered tuples ``(d_0, ..., d_{M-1})`` of distinct logical dims,
+  slot ``i`` riding mesh axis ``i`` (the state space the reference's
+  x->y->z chains walk by hand);
+* **edges** — single-slot exchanges (exactly what
+  :func:`~pencilarrays_tpu.parallel.transpositions.transpose` executes),
+  priced by the validated analytic byte model
+  (:func:`~pencilarrays_tpu.parallel.transpositions.transpose_cost`) in
+  the same bytes-equivalent score :class:`Auto` uses
+  (``count * latency_bytes + bytes``), and **corrected by the PR-3
+  drift tracker** when trusted timing samples exist for an edge (a hop
+  drifting to 2x its modeled time gets its bytes doubled in the search);
+* **search** — Dijkstra from ``src.decomposition`` to
+  ``dest.decomposition`` with a per-hop peak-HBM bound (the exchange
+  operand + result must fit; routes whose intermediates spill are
+  pruned);
+* **baseline** — the GSPMD reshard, priced from its own partitioned HLO
+  (:func:`~pencilarrays_tpu.parallel.transpositions.gspmd_reshard_cost`),
+  so the verdict is a like-for-like byte comparison.  The planner never
+  selects a route the model prices worse than GSPMD; when the search
+  finds no admissible route at all (e.g. a fully-decomposed topology,
+  where no single-slot move exists) it falls back to GSPMD.
+
+The winning route executes as **one fused jitted chain**
+(:func:`execute_route`): every hop's pack -> exchange -> unpack is traced
+into a single XLA program, so intermediates are compiler-owned buffers
+(donated by construction) and per-hop Python dispatch disappears —
+the whole-redistribution analog of the FFT plan's fused pipelined hops.
+
+Every planning decision is journaled as a ``route.plan`` event
+(candidates, predicted bytes, verdict) when observability is armed.
+
+Determinism on pods: drift correction uses *process-local* samples, so
+with ``jax.process_count() > 1`` it is disabled and the plan is a pure
+function of the (identical) static configuration — every process builds
+the same collective program, the same discipline as measure-mode Auto's
+broadcast winner.
+"""
+
+from __future__ import annotations
+
+import heapq
+import warnings
+from dataclasses import dataclass
+from functools import lru_cache
+from itertools import permutations as _iperms
+from typing import Dict, Optional, Tuple
+
+import jax
+
+from .. import obs
+from ..obs.drift import drift_tracker
+from .arrays import PencilArray
+from .pencil import Pencil
+from .transpositions import (
+    AbstractTransposeMethod,
+    AllToAll,
+    Auto,
+    Gspmd,
+    Pipelined,
+    Ring,
+    _exchange_operand_extents,
+    _hop_label,
+    _method_label,
+    _metered_cached,
+    _transpose_all_to_all,
+    _transpose_local,
+    _transpose_pipelined,
+    _transpose_ring,
+    assert_compatible,
+    gspmd_reshard_cost,
+    resolve_method,
+    transpose_cost,
+)
+
+__all__ = [
+    "ReshardRoute",
+    "RouteHop",
+    "plan_reshard_route",
+    "execute_route",
+]
+
+
+@dataclass(frozen=True)
+class RouteHop:
+    """One edge of a planned route: a single-slot exchange ``src ->
+    dest`` via ``method``, with its priced collective cost, the
+    bytes-equivalent score the search charged it, and the per-chip HBM
+    high-water mark its exchange needs (operand + result)."""
+
+    src: Pencil
+    dest: Pencil
+    method: AbstractTransposeMethod
+    cost: dict
+    score_bytes: int
+    peak_hbm_bytes: int
+
+
+@dataclass(frozen=True)
+class ReshardRoute:
+    """A planning verdict: the best single-axis hop chain found (may be
+    empty when no admissible route exists), the GSPMD baseline price,
+    and whether :func:`~pencilarrays_tpu.parallel.transpositions.reshard`
+    should execute the route (``use_route``) or fall back.
+
+    ``verdict`` is one of ``"routed"`` (route wins the Auto price
+    comparison), ``"routed:forced"`` (an explicit non-Auto method asked
+    for explicit exchanges — no GSPMD substitution, no baseline
+    pricing), ``"gspmd"`` (route found but not cheaper),
+    ``"gspmd:no-route"`` (search exhausted — e.g. fully-decomposed
+    topologies have no single-slot moves) or ``"gspmd:unpriced"``
+    (route found, GSPMD baseline could not be priced — the priced
+    route wins by default)."""
+
+    src: Pencil
+    dest: Pencil
+    hops: Tuple[RouteHop, ...]
+    score_bytes: Optional[int]
+    peak_hbm_bytes: Optional[int]
+    gspmd_cost: Optional[dict]
+    gspmd_score_bytes: Optional[int]
+    use_route: bool
+    verdict: str
+    searched_nodes: int
+
+    @property
+    def pencils(self) -> Tuple[Pencil, ...]:
+        """The full configuration chain, ``src`` first, ``dest`` last."""
+        return (self.src,) + tuple(h.dest for h in self.hops)
+
+
+def _score(cost: dict, latency_bytes: int, drift: float = 1.0) -> int:
+    """Bytes-equivalent score of one priced hop — the Auto(estimate)
+    currency: each collective launch costs ``latency_bytes``
+    bytes-equivalent, wire bytes count at face value scaled by the
+    hop's observed drift ratio (1.0 when unmeasured)."""
+    count = sum(v["count"] for v in cost.values())
+    nbytes = sum(v["bytes"] for v in cost.values())
+    return int(count * latency_bytes + nbytes * drift)
+
+
+def _hop_peak_bytes(pin: Pencil, pout: Pencil, R: Optional[int],
+                    extra_dims: Tuple[int, ...], isize: int) -> int:
+    """Per-chip HBM high-water mark of one hop: the exchanged operand
+    (logical local block with the to-be-split dim padded — the shape the
+    byte model prices) plus its same-sized result, both live across the
+    collective.  Local permutes charge in+out blocks."""
+    import numpy as np
+
+    if R is None:  # local permute: in + out blocks
+        return (pin.bytes_per_device(extra_dims, isize=isize)
+                + pout.bytes_per_device(extra_dims, isize=isize))
+    ext = _exchange_operand_extents(pin, pout, R)
+    elems = int(np.prod(ext, dtype=np.int64))
+    for e in extra_dims:
+        elems *= int(e)
+    return 2 * elems * isize
+
+
+def _node_pencil(node: Tuple[int, ...], pin: Pencil, dest: Pencil) -> Pencil:
+    """Materialize a graph node: the endpoints keep their exact pencils
+    (permutation included — the final hop must land ON ``dest``);
+    intermediates take the default memory order.  Empty-rank warnings
+    are suppressed for intermediates: the planner prices their padding,
+    and stranded candidates simply score (and bound) worse."""
+    if node == dest.decomposition:
+        return dest
+    if node == pin.decomposition:
+        return pin
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return Pencil(pin.topology, pin.size_global(), node)
+
+
+@lru_cache(maxsize=512)
+def _plan_cached(pin: Pencil, dest: Pencil, extra_dims: Tuple[int, ...],
+                 dtype_str: str, method: AbstractTransposeMethod,
+                 latency_bytes: int, hbm_limit: Optional[int],
+                 _drift_v: int) -> ReshardRoute:
+    """The search proper, cached per static configuration.  ``_drift_v``
+    is the drift tracker's version counter: new timing samples invalidate
+    cached plans (the compiled route executors have their own cache, so
+    replanning never recompiles an unchanged winner)."""
+    import numpy as np
+
+    dtype = np.dtype(dtype_str)
+    N = pin.ndims
+    M = pin.topology.ndims
+    drift_hops: Dict[str, dict] = {}
+    if _drift_v:
+        drift_hops = drift_tracker.report()["hops"]
+
+    def edge(psrc: Pencil, pdst: Pencil):
+        m = resolve_method(psrc, pdst, extra_dims, dtype, method)
+        cost = transpose_cost(psrc, pdst, extra_dims, dtype, m)
+        drift = 1.0
+        e = drift_hops.get(_hop_label(psrc, pdst, m, dtype))
+        # trusted (device-protocol) samples only: dispatch wall times are
+        # lower bounds on wire time (drift.py) and host jitter must not
+        # flip routes
+        if e and e.get("drift") and e.get("source") != "dispatch":
+            drift = float(e["drift"])
+        R = assert_compatible(psrc, pdst)
+        peak = _hop_peak_bytes(psrc, pdst, R, extra_dims, dtype.itemsize)
+        return RouteHop(psrc, pdst, m, cost,
+                        _score(cost, latency_bytes, drift), peak)
+
+    hops: Tuple[RouteHop, ...] = ()
+    searched = 0
+    if pin.decomposition == dest.decomposition:
+        # permutation-only change: a single local-permute "hop"
+        hops = (edge(pin, dest),)
+        searched = 1
+    else:
+        # Dijkstra over ordered decomposition tuples (slot i <-> mesh
+        # axis i); neighbors differ in exactly one slot.  The state
+        # space is N!/(N-M)! nodes — single digits for real pencils.
+        nodes = set(_iperms(range(N), M))
+        start, goal = pin.decomposition, dest.decomposition
+        best_score: Dict[tuple, int] = {start: 0}
+        prev: Dict[tuple, Tuple[tuple, RouteHop]] = {}
+        heap = [(0, start)]
+        done = set()
+        while heap:
+            d, u = heapq.heappop(heap)
+            if u in done:
+                continue
+            done.add(u)
+            searched += 1
+            if u == goal:
+                break
+            pu = _node_pencil(u, pin, dest)
+            for slot in range(M):
+                for nd in range(N):
+                    v = u[:slot] + (nd,) + u[slot + 1:]
+                    if nd == u[slot] or v not in nodes or v in done:
+                        continue
+                    h = edge(pu, _node_pencil(v, pin, dest))
+                    if hbm_limit is not None and h.peak_hbm_bytes > hbm_limit:
+                        continue  # this exchange would not fit: prune
+                    nd_score = d + h.score_bytes
+                    if nd_score < best_score.get(v, 2 ** 62):
+                        best_score[v] = nd_score
+                        prev[v] = (u, h)
+                        heapq.heappush(heap, (nd_score, v))
+        if goal in best_score:
+            chain = []
+            u = goal
+            while u != start:
+                u, h = prev[u]
+                chain.append(h)
+            hops = tuple(reversed(chain))
+
+    if not hops:
+        return ReshardRoute(pin, dest, (), None, None, None, None, False,
+                            "gspmd:no-route", searched)
+
+    score = sum(h.score_bytes for h in hops)
+    peak = max(h.peak_hbm_bytes for h in hops)
+    if not isinstance(method, Auto):
+        # an EXPLICIT method is a user decision (pin collectives, dodge
+        # a partitioner bug): never silently substitute the GSPMD
+        # exchange for it — the baseline comparison is Auto's job
+        return ReshardRoute(pin, dest, hops, score, peak, None, None, True,
+                            "routed:forced", searched)
+    try:
+        gcost = gspmd_reshard_cost(pin, dest, extra_dims, dtype)
+    except Exception:  # pricing is best-effort: a lowering quirk must
+        gcost = None   # never make reshard() itself fail
+    if gcost is None:
+        return ReshardRoute(pin, dest, hops, score, peak, None, None, True,
+                            "gspmd:unpriced", searched)
+    gscore = _score(gcost, latency_bytes)
+    use = score < gscore
+    return ReshardRoute(pin, dest, hops, score, peak, gcost, gscore, use,
+                        "routed" if use else "gspmd", searched)
+
+
+def plan_reshard_route(pin: Pencil, dest: Pencil,
+                       extra_dims: Tuple[int, ...] = (), dtype=None, *,
+                       method: AbstractTransposeMethod = Auto(),
+                       hbm_limit: Optional[int] = None) -> ReshardRoute:
+    """Plan the redistribution ``pin -> dest``: search the pencil graph
+    for the cheapest admissible single-axis hop chain and compare it
+    against the priced GSPMD baseline.  See the module docstring for
+    the graph, scoring and fallback rules.
+
+    ``method`` resolves each edge (:class:`Auto` per hop; measure-mode
+    Auto plans with the estimate rule — planning must stay cheap and
+    deterministic).  ``hbm_limit`` bounds each hop's per-chip
+    operand+result bytes; routes needing more are pruned.
+    """
+    import numpy as np
+
+    if pin.topology != dest.topology:
+        raise ValueError("plan_reshard_route: pencil topologies differ")
+    if pin.size_global() != dest.size_global():
+        raise ValueError("plan_reshard_route: global shapes differ")
+    if isinstance(method, Gspmd):
+        raise ValueError("plan_reshard_route prices Gspmd as the baseline; "
+                         "pass an explicit exchange method or Auto()")
+    if isinstance(method, Auto) and method.mode == "measure":
+        # planning stays deterministic & benchmark-free (the fused-hop
+        # planner's convention, ops/fft.py:_try_fuse_hop)
+        method = Auto(mode="estimate", latency_bytes=method.latency_bytes)
+    latency = method.latency_bytes if isinstance(method, Auto) \
+        else Auto().latency_bytes
+    dt = np.dtype(dtype if dtype is not None else np.float32)
+    # drift samples are process-local: multi-controller planning must be
+    # a pure function of the static config (see module docstring)
+    v = drift_tracker.version() if jax.process_count() == 1 else 0
+    return _plan_cached(pin, dest, tuple(int(e) for e in extra_dims),
+                        dt.str, method, int(latency), hbm_limit, v)
+
+
+# ---------------------------------------------------------------------------
+# fused route execution
+# ---------------------------------------------------------------------------
+
+
+def _apply_hop(data, pin: Pencil, pout: Pencil, R: Optional[int],
+               method: AbstractTransposeMethod, extra_ndims: int):
+    if R is None:
+        return _transpose_local(data, pin, pout, extra_ndims)
+    if isinstance(method, AllToAll):
+        return _transpose_all_to_all(data, pin, pout, R, extra_ndims)
+    if isinstance(method, Ring):
+        return _transpose_ring(data, pin, pout, R, extra_ndims)
+    if isinstance(method, Pipelined):
+        return _transpose_pipelined(data, pin, pout, R, extra_ndims, method)
+    raise TypeError(f"no explicit hop executor for method {method!r}")
+
+
+@lru_cache(maxsize=256)
+def _compiled_route(pencils: Tuple[Pencil, ...],
+                    methods: Tuple[AbstractTransposeMethod, ...],
+                    extra_ndims: int, donate: bool = False,
+                    _pallas: bool = False):
+    """ONE jitted program for the whole hop chain: every hop's
+    pack -> exchange -> unpack traces into a single executable, so the
+    intermediates are compiler-owned (and reusable) buffers and the
+    latency-hiding scheduler sees the full chain at once — per-hop
+    Python dispatch happens exactly once per configuration, at trace
+    time.  ``_pallas`` rides the key only (the _compiled_transpose
+    convention: a toggled env flag must not reuse a stale executable)."""
+    hops = tuple((a, b, assert_compatible(a, b), m)
+                 for a, b, m in zip(pencils, pencils[1:], methods))
+
+    def chain(data):
+        for pin, pout, R, m in hops:
+            data = _apply_hop(data, pin, pout, R, m, extra_ndims)
+        return data
+
+    return jax.jit(chain, donate_argnums=(0,) if donate else ())
+
+
+def execute_route(src: PencilArray, route: ReshardRoute, *,
+                  donate: bool = False) -> PencilArray:
+    """Execute a planned route as its fused chain (one dispatch).
+    ``donate=True`` donates the SOURCE buffer to the chain (``src``
+    becomes invalid); intermediates are compiler-owned either way."""
+    import jax.core
+
+    from ..ops.pallas_kernels import pallas_enabled
+
+    if src.pencil != route.src:
+        raise ValueError(
+            f"array lives on {src.pencil!r}, route starts at {route.src!r}")
+    if not route.hops:
+        raise ValueError("route has no hops (planner fell back to Gspmd)")
+    donate = donate and not isinstance(src.data, jax.core.Tracer)
+    fn = _metered_cached(
+        _compiled_route, "route", route.pencils,
+        tuple(h.method for h in route.hops), src.ndims_extra, donate,
+        pallas_enabled())
+    return PencilArray(route.dest, fn(src.data), src.extra_dims)
+
+
+# ---------------------------------------------------------------------------
+# observability tap
+# ---------------------------------------------------------------------------
+
+
+_ROUTE_LOGGED: set = set()
+
+
+def _obs_record_route_plan(route: ReshardRoute, extra_dims: tuple,
+                           dtype) -> None:
+    """Journal one planning verdict per (obs run, configuration) — the
+    ``route.plan`` event: every candidate with its predicted bytes and
+    score, and which one reshard() will execute."""
+    import numpy as np
+
+    dt = np.dtype(dtype if dtype is not None else np.float32)
+    config = (f"{route.src.size_global()}@{route.src.topology.dims} "
+              f"{route.src.decomposition}->{route.dest.decomposition} "
+              f"{dt.name} extra={tuple(extra_dims)}")
+    key = (obs.run_id(), config)
+    if key in _ROUTE_LOGGED:
+        return
+    _ROUTE_LOGGED.add(key)
+    candidates = []
+    if route.hops:
+        candidates.append({
+            "kind": "routed",
+            "route": [list(h.dest.decomposition) for h in route.hops],
+            "methods": [_method_label(h.method) for h in route.hops],
+            "predicted_bytes": sum(
+                v["bytes"] for h in route.hops for v in h.cost.values()),
+            "score_bytes": route.score_bytes,
+            "peak_hbm_bytes": route.peak_hbm_bytes,
+        })
+    if route.gspmd_cost is not None:
+        candidates.append({
+            "kind": "gspmd",
+            "predicted_bytes": sum(
+                v["bytes"] for v in route.gspmd_cost.values()),
+            "score_bytes": route.gspmd_score_bytes,
+            "cost": route.gspmd_cost,
+        })
+    winner = candidates[0] if route.use_route else (
+        candidates[-1] if candidates else None)
+    obs.record_event(
+        "route.plan", src=str(route.src.decomposition),
+        dest=str(route.dest.decomposition),
+        shape=list(route.src.size_global()),
+        topo=list(route.src.topology.dims), dtype=dt.name,
+        verdict=route.verdict, candidates=candidates,
+        predicted_bytes=(winner or {}).get("predicted_bytes", 0),
+        searched_nodes=route.searched_nodes)
+    obs.counter("route.plans", verdict=route.verdict).inc()

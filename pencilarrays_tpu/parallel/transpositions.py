@@ -86,6 +86,7 @@ __all__ = [
     "Transposition",
     "transpose",
     "transpose_cost",
+    "gspmd_reshard_cost",
     "resolve_method",
     "reshard",
     "assert_compatible",
@@ -569,6 +570,12 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
     P = pin.topology.dims[R]
     if P == 1:
         return {}
+    if isinstance(method, Gspmd):
+        # no analytic model exists (the partitioner owns the collective
+        # choice), but the hop IS priceable: measure its own partitioned
+        # HLO once and cache it — so Auto/the route planner can compare
+        # Gspmd against explicit alternatives instead of skipping it
+        return gspmd_reshard_cost(pin, pout, extra_dims, dtype)
     a = pin.decomposition[R]
     b = pout.decomposition[R]
     ext = _exchange_operand_extents(pin, pout, R)
@@ -600,10 +607,7 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
                  if c is not None else 1)
         return {op: {"count": v["count"] * k_eff, "bytes": v["bytes"]}
                 for op, v in base.items()}
-    raise ValueError(
-        f"no analytic cost model for method {method!r} (Gspmd collectives "
-        f"are chosen by the partitioner; measure them with "
-        f"utils.hlo.collective_stats instead)")
+    raise ValueError(f"no analytic cost model for method {method!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -884,9 +888,66 @@ def _reshard_gspmd(data, pin: Pencil, pout: Pencil, extra_ndims: int):
     return jax.lax.with_sharding_constraint(x, pout.sharding(extra_ndims))
 
 
+@lru_cache(maxsize=256)
+def _gspmd_collective_cost(pin: Pencil, pout: Pencil,
+                           extra_dims: Tuple[int, ...],
+                           dtype_str: str) -> dict:
+    import numpy as np
+
+    from ..utils.hlo import collective_stats
+
+    extra_ndims = len(extra_dims)
+    shape = tuple(pin.padded_size_global(MemoryOrder)) + tuple(extra_dims)
+    aval = jax.ShapeDtypeStruct(shape, np.dtype(dtype_str),
+                                sharding=pin.sharding(extra_ndims))
+    hlo = (jax.jit(lambda d: _reshard_gspmd(d, pin, pout, extra_ndims))
+           .lower(aval).compile().as_text())
+    return collective_stats(hlo)
+
+
+def gspmd_reshard_cost(pin: Pencil, pout: Pencil,
+                       extra_dims: Tuple[int, ...] = (),
+                       dtype=None) -> dict:
+    """Measured per-chip collective cost of the GSPMD redistribution
+    ``pin -> pout`` (any number of differing slots), in the
+    ``transpose_cost`` / ``utils.hlo.collective_stats`` schema.
+
+    GSPMD hops have no analytic model — the partitioner owns the
+    collective choice — so the price IS the measurement: the layout
+    change is lowered, SPMD-partitioned and compiled once per static
+    configuration (cached), and the compiled HLO's collective
+    applications are counted and byte-priced.  This is what lets
+    ``Auto`` and the route planner (``parallel/routing.py``) compare
+    Gspmd against routed alternatives instead of skipping it."""
+    import numpy as np
+
+    if pin.topology != pout.topology:
+        raise ValueError("gspmd_reshard_cost: pencil topologies differ")
+    if pin.size_global() != pout.size_global():
+        raise ValueError("gspmd_reshard_cost: global shapes differ")
+    dt = np.dtype(dtype if dtype is not None else np.float32)
+    return _gspmd_collective_cost(pin, pout,
+                                  tuple(int(e) for e in extra_dims), dt.str)
+
+
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
+
+
+def _metered_cached(cache_fn, kind: str, *args):
+    """Call an ``lru_cache``'d executable factory, metering hit/miss on
+    the obs registry (``compile.cache_hits|misses{cache=<kind>}``) —
+    the per-cache counters the persistent-compilation-cache knob's
+    effectiveness is judged by.  Disabled-path cost: one ``enabled()``
+    probe (the metering itself only runs when obs is armed)."""
+    if not obs.enabled():
+        return cache_fn(*args)
+    before = cache_fn.cache_info().misses
+    out = cache_fn(*args)
+    label = ("misses" if cache_fn.cache_info().misses > before else "hits")
+    obs.counter(f"compile.cache_{label}", cache=kind).inc()
+    return out
 
 @lru_cache(maxsize=512)
 def _compiled_transpose(pin: Pencil, pout: Pencil, R: Optional[int],
@@ -922,8 +983,13 @@ def _compiled_transpose(pin: Pencil, pout: Pencil, R: Optional[int],
 
 
 @lru_cache(maxsize=512)
-def _compiled_reshard(pin: Pencil, pout: Pencil, extra_ndims: int):
-    return jax.jit(lambda data: _reshard_gspmd(data, pin, pout, extra_ndims))
+def _compiled_reshard(pin: Pencil, pout: Pencil, extra_ndims: int,
+                      donate: bool = False):
+    """Compiled GSPMD reshard, cached on the static configuration (the
+    ``_compiled_transpose`` discipline: without it every eager call
+    would jit a fresh lambda and recompile)."""
+    return jax.jit(lambda data: _reshard_gspmd(data, pin, pout, extra_ndims),
+                   donate_argnums=(0,) if donate else ())
 
 
 def transpose(src: PencilArray, dest: Pencil, *,
@@ -949,8 +1015,9 @@ def transpose(src: PencilArray, dest: Pencil, *,
     import jax.core
 
     with timeit(pin.timer, "transpose!"):
-        fn = _compiled_transpose(pin, dest, R, src.ndims_extra, method,
-                                 donate, pallas_enabled())
+        fn = _metered_cached(_compiled_transpose, "hop", pin, dest, R,
+                             src.ndims_extra, method, donate,
+                             pallas_enabled())
         # the hop tap observes EAGER dispatches only: under an outer
         # jit this call runs at trace time (once per compile), where a
         # "duration" would be lowering time, not a dispatch — it must
@@ -967,17 +1034,57 @@ def transpose(src: PencilArray, dest: Pencil, *,
     return PencilArray(dest, out, src.extra_dims)
 
 
-def reshard(src: PencilArray, dest: Pencil) -> PencilArray:
+def reshard(src: PencilArray, dest: Pencil, *,
+            method: AbstractTransposeMethod = Auto(),
+            donate: bool = False) -> PencilArray:
     """Unrestricted redistribution between *any* two pencils sharing a
     topology and global shape — capability beyond the reference's
-    single-slot transpose, via the GSPMD partitioner."""
+    single-slot transpose.
+
+    By default the **route planner** (``parallel/routing.py``) searches
+    the pencil graph for a chain of single-axis exchanges the cost
+    model prices cheaper than the one opaque GSPMD exchange, and
+    executes the winner as ONE fused jitted chain (per-hop dispatch
+    and intermediates are compiler-owned); it falls back to the GSPMD
+    partitioner when no cheaper route exists (``arXiv:2112.01075``'s
+    searched-decomposition redistribution).  ``method=Gspmd()`` forces
+    the legacy single-exchange path; an explicit exchange method
+    (``AllToAll()``/``Ring()``/``Pipelined(...)``) forces the ROUTED
+    path with that method on every edge (falling back to Gspmd only
+    when no single-slot chain exists at all).  Results are
+    bit-identical either way (test-pinned) — only scheduling differs.
+
+    ``donate=True`` donates the source buffer to the executable (``src``
+    becomes invalid), as with ``transpose(donate=True)``.
+    """
+    import jax.core
+
     pin = src.pencil
     if pin.topology != dest.topology:
         raise ValueError("reshard: pencil topologies differ")
     if pin.size_global() != dest.size_global():
         raise ValueError("reshard: global shapes differ")
-    out = _compiled_reshard(pin, dest, src.ndims_extra)(src.data)
-    return PencilArray(dest, out, src.extra_dims)
+    if pin == dest:
+        return src  # nothing to move (transpose() passthrough parity)
+    eager = not isinstance(src.data, jax.core.Tracer)
+    don = donate and eager
+    if not isinstance(method, Gspmd):
+        from .routing import (_obs_record_route_plan, execute_route,
+                              plan_reshard_route)
+
+        route = plan_reshard_route(pin, dest, src.extra_dims, src.dtype,
+                                   method=method)
+        if obs.enabled() and eager:
+            _obs_record_route_plan(route, src.extra_dims, src.dtype)
+            obs.counter("reshard.dispatches",
+                        path="routed" if route.use_route else "gspmd").inc()
+        if route.use_route:
+            return execute_route(src, route, donate=don)
+    elif obs.enabled() and eager:
+        obs.counter("reshard.dispatches", path="gspmd").inc()
+    fn = _metered_cached(_compiled_reshard, "reshard", pin, dest,
+                         src.ndims_extra, don)
+    return PencilArray(dest, fn(src.data), src.extra_dims)
 
 
 class Transposition:

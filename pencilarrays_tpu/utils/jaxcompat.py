@@ -17,9 +17,39 @@ import time — zero per-call overhead.
 
 from __future__ import annotations
 
+import os
+
 import jax
 
-__all__ = ["shard_map", "AxisType"]
+__all__ = ["shard_map", "AxisType", "configure_compilation_cache",
+           "COMPILE_CACHE_VAR"]
+
+COMPILE_CACHE_VAR = "PENCILARRAYS_TPU_COMPILE_CACHE"
+
+
+def configure_compilation_cache(env_var: str = COMPILE_CACHE_VAR):
+    """Wire jax's persistent compilation cache from one env knob:
+    ``PENCILARRAYS_TPU_COMPILE_CACHE=<dir>`` points
+    ``jax_compilation_cache_dir`` at ``<dir>`` (thresholds zeroed so
+    every executable persists — the in-process hop/plan/route caches
+    already dedupe, the disk cache's job is surviving process restarts).
+    Called at package import; a no-op when the variable is unset, and
+    best-effort on jax versions lacking a threshold knob.  Returns the
+    resolved directory (or None)."""
+    d = os.environ.get(env_var)
+    if not d:
+        return None
+    try:
+        jax.config.update("jax_compilation_cache_dir", os.path.abspath(d))
+    except Exception:
+        return None  # ancient jax: knob absent — feature degrades away
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:
+            pass  # threshold knobs vary by version; the dir is what matters
+    return os.path.abspath(d)
 
 try:  # modern surface: jax.sharding.AxisType (Auto/Explicit/Manual)
     from jax.sharding import AxisType  # type: ignore

@@ -11,15 +11,19 @@ import pytest
 
 from pencilarrays_tpu import (
     AllToAll,
+    Gspmd,
     Pencil,
     PencilArray,
     PencilFFTPlan,
     Permutation,
     Ring,
     Topology,
+    reshard,
     transpose,
 )
-from pencilarrays_tpu.parallel.transpositions import _compiled_transpose
+from pencilarrays_tpu.parallel.routing import _compiled_route
+from pencilarrays_tpu.parallel.transpositions import (_compiled_reshard,
+                                                      _compiled_transpose)
 
 
 @pytest.fixture
@@ -74,6 +78,44 @@ def test_methods_have_distinct_cache_keys(topo):
     assert mid.misses == before.misses + 1
     transpose(x, dst, method=Ring())     # same value: must hit
     assert _compiled_transpose.cache_info().misses == mid.misses
+
+
+def test_reshard_compiles_exactly_once(topo):
+    """ISSUE 4 satellite regression: repeated reshard() calls on the
+    same configuration must trigger exactly ONE compile per path —
+    counted as jit-executable cache misses on both the GSPMD
+    (_compiled_reshard) and the routed (_compiled_route) caches."""
+    shape = (12, 10, 14)
+    pen_a = Pencil(topo, shape, (1, 2))
+    pen_b = Pencil(topo, shape, (0, 1), permutation=Permutation(2, 0, 1))
+    u = np.random.default_rng(4).standard_normal(shape)
+    x = PencilArray.from_global(pen_a, u)
+
+    reshard(x, pen_b, method=Gspmd())  # populate: exactly one miss
+    g0 = _compiled_reshard.cache_info()
+    for _ in range(5):
+        reshard(x, pen_b, method=Gspmd())
+    g1 = _compiled_reshard.cache_info()
+    assert g1.misses == g0.misses, "GSPMD reshard re-jitted per call"
+    assert g1.hits == g0.hits + 5
+
+    reshard(x, pen_b)  # routed default: populate planner + executor
+    r0 = _compiled_route.cache_info()
+    g2 = _compiled_reshard.cache_info()
+    for _ in range(5):
+        reshard(x, pen_b)
+    assert _compiled_route.cache_info().misses == r0.misses
+    assert _compiled_reshard.cache_info().misses == g2.misses
+
+    # donate=True is a DIFFERENT executable (one more miss), then cached
+    # (fresh source per call: the donated buffer is dead afterwards on
+    # backends that implement donation)
+    reshard(PencilArray.from_global(pen_a, u), pen_b, method=Gspmd(),
+            donate=True)
+    d0 = _compiled_reshard.cache_info()
+    reshard(PencilArray.from_global(pen_a, u), pen_b, method=Gspmd(),
+            donate=True)
+    assert _compiled_reshard.cache_info().misses == d0.misses
 
 
 def test_jitted_plan_traces_once(topo):

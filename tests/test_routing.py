@@ -1,0 +1,534 @@
+"""Reshard route planner + whole-plan fused executables (ISSUE 4).
+
+Pins the tentpole contracts:
+
+* routed multi-slot reshard is BIT-identical to the GSPMD result
+  (padding included) across topologies, uneven shards and permuted
+  memory orders;
+* the planner returns the known-optimal route on hand-built pencil
+  graphs, and drift-tracker samples steer its edge weights;
+* each routed hop keeps its HLO-pinned collective budget (the chain's
+  compiled program contains exactly the predicted collectives);
+* ``Auto`` never executes a route the model prices worse than GSPMD,
+  and the verdict is journaled as a schema-clean ``route.plan`` event;
+* ``PencilFFTPlan.compile()`` is one dispatch per direction,
+  bit-identical to the eager hop-by-hop schedule;
+* GSPMD hops are priced from their partitioned HLO
+  (``gspmd_reshard_cost``), so the baseline comparison is real.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu import (
+    AllToAll,
+    Gspmd,
+    Pencil,
+    PencilArray,
+    PencilFFTPlan,
+    Permutation,
+    Topology,
+    gather,
+    gspmd_reshard_cost,
+    plan_reshard_route,
+    reshard,
+)
+from pencilarrays_tpu.obs import drift as obs_drift
+from pencilarrays_tpu.parallel import routing
+from pencilarrays_tpu.parallel import transpositions as tr
+from pencilarrays_tpu.utils.hlo import collective_stats
+
+
+@pytest.fixture(autouse=True)
+def _clean_drift():
+    """Route plans are drift-sensitive: isolate every test's samples."""
+    obs_drift.drift_tracker.reset()
+    yield
+    obs_drift.drift_tracker.reset()
+
+
+def global_ref(shape, dtype=np.float64):
+    n = int(np.prod(shape, dtype=int))
+    return (np.arange(n, dtype=dtype).reshape(shape) + 1.0) / 3.0
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: routed chain vs GSPMD, >= 3 topologies
+# ---------------------------------------------------------------------------
+
+
+TOPO_CASES = [
+    # (topo dims, n devices) — M=2 meshes so multi-slot reshards exist
+    ((2, 4), 8),
+    ((4, 2), 8),
+    ((2, 2), 4),
+]
+
+
+@pytest.mark.parametrize("dims,n", TOPO_CASES)
+@pytest.mark.parametrize("shape", [(16, 12, 8), (13, 10, 9)])
+def test_routed_bit_identical_to_gspmd(devices, dims, n, shape):
+    """Every topology x (even | uneven) shape, with permuted memory
+    orders on both ends: the routed fused chain and the one GSPMD
+    exchange must produce the same backing array BIT-for-bit (padding
+    included)."""
+    topo = Topology(dims, devices=jax.devices()[:n])
+    u = global_ref(shape)
+    pin = Pencil(topo, shape, (1, 2), permutation=Permutation(2, 0, 1))
+    dest = Pencil(topo, shape, (0, 1), permutation=Permutation(1, 2, 0))
+    x = PencilArray.from_global(pin, u)
+    plan = plan_reshard_route(pin, dest, (), x.dtype)
+    assert plan.hops, "expected an admissible route on an M=2 mesh"
+    y_routed = routing.execute_route(x, plan)
+    y_gspmd = reshard(x, dest, method=Gspmd())
+    np.testing.assert_array_equal(np.asarray(y_routed.data),
+                                  np.asarray(y_gspmd.data))
+    np.testing.assert_array_equal(gather(y_routed), u)
+
+
+@pytest.mark.parametrize("dims,n", TOPO_CASES)
+def test_default_reshard_matches_gspmd(devices, dims, n):
+    """The public reshard() (planner-routed by default) stays
+    bit-identical to the forced GSPMD path whatever the verdict."""
+    topo = Topology(dims, devices=jax.devices()[:n])
+    shape = (11, 9, 14)
+    u = global_ref(shape)
+    pin = Pencil(topo, shape, (1, 2))
+    dest = Pencil(topo, shape, (0, 1), permutation=Permutation(2, 0, 1))
+    x = PencilArray.from_global(pin, u)
+    y = reshard(x, dest)
+    y_ref = reshard(x, dest, method=Gspmd())
+    np.testing.assert_array_equal(np.asarray(y.data), np.asarray(y_ref.data))
+    np.testing.assert_array_equal(gather(y), u)
+
+
+def test_slot_swap_routes(devices):
+    """A slot swap ((1,2) -> (2,1)) has no single-slot shortcut; the
+    planner must chain through intermediates and stay exact."""
+    topo = Topology((2, 4))
+    shape = (10, 12, 8)
+    u = global_ref(shape)
+    pin = Pencil(topo, shape, (1, 2))
+    dest = Pencil(topo, shape, (2, 1))
+    x = PencilArray.from_global(pin, u)
+    plan = plan_reshard_route(pin, dest, (), x.dtype)
+    assert len(plan.hops) >= 2
+    y = routing.execute_route(x, plan)
+    np.testing.assert_array_equal(gather(y), u)
+    np.testing.assert_array_equal(
+        np.asarray(y.data),
+        np.asarray(reshard(x, dest, method=Gspmd()).data))
+
+
+def test_fully_decomposed_falls_back(devices):
+    """M == N leaves no single-slot moves (every logical dim is
+    sharded): the search is exhausted and reshard() falls back to
+    GSPMD — the pre-planner capability is never lost."""
+    topo = Topology((2, 4))
+    shape = (8, 12)
+    pin = Pencil(topo, shape, (0, 1))
+    dest = Pencil(topo, shape, (1, 0))
+    plan = plan_reshard_route(pin, dest, (), np.float32)
+    assert not plan.hops and not plan.use_route
+    assert plan.verdict == "gspmd:no-route"
+    u = global_ref(shape)
+    y = reshard(PencilArray.from_global(pin, u), dest)
+    np.testing.assert_array_equal(gather(y), u)
+
+
+# ---------------------------------------------------------------------------
+# planner unit tests: known-optimal routes on hand-built graphs
+# ---------------------------------------------------------------------------
+
+
+def test_single_slot_route_is_direct(devices):
+    topo = Topology((2, 4))
+    pin = Pencil(topo, (16, 12, 8), (1, 2))
+    dest = Pencil(topo, (16, 12, 8), (0, 2))
+    plan = plan_reshard_route(pin, dest, (), np.float32)
+    assert [h.dest.decomposition for h in plan.hops] == [(0, 2)]
+
+
+def test_two_hop_route_unique_path(devices):
+    """(1,2) -> (0,1) on N=3: the only 2-hop chain goes via (0,2), and
+    3-hop detours cost strictly more wire bytes."""
+    topo = Topology((2, 4))
+    pin = Pencil(topo, (16, 12, 8), (1, 2))
+    dest = Pencil(topo, (16, 12, 8), (0, 1))
+    plan = plan_reshard_route(pin, dest, (), np.float32)
+    assert [h.dest.decomposition for h in plan.hops] == [(0, 2), (0, 1)]
+
+
+def test_planner_picks_cheaper_of_two_routes(devices):
+    """N=4 (2,3) -> (0,1) has two 2-hop chains: via (0,3) or via (2,1).
+    With shape (9, 8, 6, 4) the (0,3) leg pays dim-0 tail padding
+    (9 -> 10) on BOTH hops while the (2,1) leg pays it once — hand
+    computation: 240+240 vs 216+240 operand elements — so the planner
+    must route via (2,1)."""
+    topo = Topology((2, 4))
+    shape = (9, 8, 6, 4)
+    pin = Pencil(topo, shape, (2, 3))
+    dest = Pencil(topo, shape, (0, 1))
+    plan = plan_reshard_route(pin, dest, (), np.float32)
+    assert [h.dest.decomposition for h in plan.hops] == [(2, 1), (0, 1)]
+    # and the hand-computed byte totals hold (f32)
+    assert sum(v["bytes"] for h in plan.hops
+               for v in h.cost.values()) == (216 + 240) * 4
+
+
+def test_drift_samples_steer_the_route(devices):
+    """The PR-3 drift tracker corrects edge weights: a trusted timing
+    sample showing the (2,3)->(2,1) exchange running far over its byte
+    model (and another showing (2,3)->(0,3) under it) must flip the
+    planned route onto the un-drifted path."""
+    topo = Topology((2, 4))
+    shape = (9, 8, 6, 4)
+    pin = Pencil(topo, shape, (2, 3))
+    dest = Pencil(topo, shape, (0, 1))
+    via_21 = Pencil(topo, shape, (2, 1))
+    via_03 = Pencil(topo, shape, (0, 3))
+    # baseline: the cheaper-bytes route via (2,1) wins
+    plan = plan_reshard_route(pin, dest, (), np.float32)
+    assert [h.dest.decomposition for h in plan.hops] == [(2, 1), (0, 1)]
+    # poison the (2,3)->(2,1) edge: measured 1s for its 864 bytes, while
+    # (2,3)->(0,3) moves 960 bytes in ~0s — the fitted bandwidth makes
+    # the poisoned edge's drift huge and the other's tiny
+    obs_drift.drift_tracker.record(
+        tr._hop_label(pin, via_21, AllToAll(), np.float32),
+        216 * 4, 1.0, source="benchtime")
+    obs_drift.drift_tracker.record(
+        tr._hop_label(pin, via_03, AllToAll(), np.float32),
+        240 * 4, 1e-7, source="benchtime")
+    plan2 = plan_reshard_route(pin, dest, (), np.float32)
+    assert [h.dest.decomposition for h in plan2.hops] == [(0, 3), (0, 1)]
+
+
+def test_explicit_method_forces_routed_path(devices):
+    """An explicit exchange method is a user decision: the planner must
+    execute it on every edge (verdict routed:forced, no GSPMD baseline
+    substitution), and the compiled chain must contain that method's
+    collectives."""
+    topo = Topology((2, 4))
+    shape = (16, 12, 8)
+    pin = Pencil(topo, shape, (1, 2))
+    dest = Pencil(topo, shape, (0, 1))
+    plan = plan_reshard_route(pin, dest, (), np.float32,
+                              method=pa.Ring())
+    assert plan.verdict == "routed:forced" and plan.use_route
+    assert all(isinstance(h.method, pa.Ring) for h in plan.hops)
+    u = global_ref(shape)
+    x = PencilArray.from_global(pin, u)
+    y = reshard(x, dest, method=pa.Ring())
+    np.testing.assert_array_equal(gather(y), u)
+    np.testing.assert_array_equal(
+        np.asarray(y.data),
+        np.asarray(reshard(x, dest, method=Gspmd()).data))
+
+
+def test_dispatch_samples_do_not_steer_or_invalidate(devices):
+    """Per-dispatch wall times are lower bounds on wire time: they must
+    neither flip routes nor churn the plan cache (the trusted-sample
+    contract of DriftTracker.version())."""
+    topo = Topology((2, 4))
+    shape = (9, 8, 6, 4)
+    pin = Pencil(topo, shape, (2, 3))
+    dest = Pencil(topo, shape, (0, 1))
+    via_21 = Pencil(topo, shape, (2, 1))
+    plan = plan_reshard_route(pin, dest, (), np.float32)
+    v0 = obs_drift.drift_tracker.version()
+    # a wildly slow DISPATCH sample on the winning edge: ignored
+    obs_drift.drift_tracker.record(
+        tr._hop_label(pin, via_21, AllToAll(), np.float32),
+        216 * 4, 10.0, source="dispatch")
+    assert obs_drift.drift_tracker.version() == v0
+    plan2 = plan_reshard_route(pin, dest, (), np.float32)
+    assert plan2 is plan  # same cached object: no replanning churn
+    assert [h.dest.decomposition for h in plan2.hops] == [(2, 1), (0, 1)]
+
+
+def test_hbm_limit_prunes_routes(devices):
+    """A peak-HBM bound below any hop's operand+result footprint leaves
+    no admissible route -> GSPMD fallback."""
+    topo = Topology((2, 4))
+    pin = Pencil(topo, (16, 12, 8), (1, 2))
+    dest = Pencil(topo, (16, 12, 8), (0, 1))
+    plan = plan_reshard_route(pin, dest, (), np.float32, hbm_limit=1)
+    assert not plan.hops and plan.verdict == "gspmd:no-route"
+    wide = plan_reshard_route(pin, dest, (), np.float32, hbm_limit=2 ** 40)
+    assert wide.hops and wide.peak_hbm_bytes <= 2 ** 40
+
+
+def test_route_never_priced_worse_than_gspmd(devices):
+    """The acceptance rule: use_route implies the routed score is
+    strictly cheaper than the priced GSPMD baseline."""
+    topo = Topology((2, 4))
+    for shape, perm in [((16, 12, 8), None), ((13, 10, 9),
+                                              Permutation(2, 0, 1))]:
+        pin = Pencil(topo, shape, (1, 2), permutation=perm)
+        dest = Pencil(topo, shape, (0, 1))
+        plan = plan_reshard_route(pin, dest, (), np.float32)
+        if plan.use_route and plan.gspmd_score_bytes is not None:
+            assert plan.score_bytes < plan.gspmd_score_bytes
+        if (not plan.use_route and plan.hops
+                and plan.gspmd_score_bytes is not None):
+            assert plan.score_bytes >= plan.gspmd_score_bytes
+
+
+# ---------------------------------------------------------------------------
+# HLO-pinned collective budget of the routed chain
+# ---------------------------------------------------------------------------
+
+
+def test_routed_chain_hlo_budget(devices):
+    """The compiled fused chain contains EXACTLY the collectives the
+    per-hop byte model predicts — count and bytes (the transpose-engine
+    validation, extended over a whole route)."""
+    topo = Topology((2, 4))
+    shape = (16, 12, 8)
+    pin = Pencil(topo, shape, (1, 2))
+    dest = Pencil(topo, shape, (0, 1))
+    plan = plan_reshard_route(pin, dest, (), np.float32)
+    assert plan.hops
+    expect: dict = {}
+    for h in plan.hops:
+        for op, c in h.cost.items():
+            e = expect.setdefault(op, {"count": 0, "bytes": 0})
+            e["count"] += c["count"]
+            e["bytes"] += c["bytes"]
+    x = PencilArray.zeros(pin, dtype=np.float32)
+    from pencilarrays_tpu.ops.pallas_kernels import pallas_enabled
+
+    fn = routing._compiled_route(plan.pencils,
+                                 tuple(h.method for h in plan.hops), 0,
+                                 False, pallas_enabled())
+    hlo = jax.jit(fn).lower(x.data).compile().as_text()
+    assert collective_stats(hlo) == expect
+
+
+# ---------------------------------------------------------------------------
+# GSPMD pricing (satellite: transpositions.py Gspmd hops)
+# ---------------------------------------------------------------------------
+
+
+def test_gspmd_reshard_cost_prices_collectives(devices):
+    topo = Topology((2, 4))
+    pin = Pencil(topo, (16, 12, 8), (1, 2))
+    dest = Pencil(topo, (16, 12, 8), (0, 1))
+    cost = gspmd_reshard_cost(pin, dest, (), np.float32)
+    assert cost, "a two-slot reshard must move bytes"
+    assert sum(v["bytes"] for v in cost.values()) > 0
+    assert all(v["count"] >= 1 for v in cost.values())
+
+
+def test_transpose_cost_gspmd_matches_compiled(devices):
+    """Single-slot Gspmd hops are priced too (no more skipping), and
+    the price equals the compiled transpose's measured collectives."""
+    topo = Topology((4,), devices=jax.devices()[:4])
+    pin = Pencil(topo, (8, 8), (0,))
+    pout = Pencil(topo, (8, 8), (1,))
+    cost = pa.transpose_cost(pin, pout, method=Gspmd())
+    x = PencilArray.zeros(pin, dtype=np.float32)
+    hlo = jax.jit(
+        lambda d: pa.transpose(PencilArray(pin, d), pout,
+                               method=Gspmd()).data
+    ).lower(x.data).compile().as_text()
+    assert collective_stats(hlo) == cost
+    assert sum(v["bytes"] for v in cost.values()) > 0
+
+
+# ---------------------------------------------------------------------------
+# route.plan journaling
+# ---------------------------------------------------------------------------
+
+
+def test_route_plan_event_journaled(devices, tmp_path, monkeypatch):
+    from pencilarrays_tpu import obs
+    from pencilarrays_tpu.obs import events as obs_events
+    from pencilarrays_tpu.obs import metrics as obs_metrics
+
+    jdir = str(tmp_path / "obs")
+    monkeypatch.setenv(obs.ENV_VAR, jdir)
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    try:
+        topo = Topology((2, 4))
+        shape = (16, 12, 8)
+        pin = Pencil(topo, shape, (1, 2))
+        dest = Pencil(topo, shape, (0, 1))
+        x = PencilArray.from_global(pin, global_ref(shape))
+        reshard(x, dest)
+        reshard(x, dest)  # dedup: one verdict per (run, config)
+        events = obs.read_journal(jdir)
+        assert obs.lint_journal(events) == []
+        plans = [e for e in events if e["ev"] == "route.plan"]
+        assert len(plans) == 1
+        e = plans[0]
+        assert e["verdict"] in ("routed", "gspmd", "gspmd:no-route",
+                                "gspmd:unpriced")
+        kinds = {c["kind"] for c in e["candidates"]}
+        assert "routed" in kinds
+        routed = next(c for c in e["candidates"] if c["kind"] == "routed")
+        assert routed["predicted_bytes"] > 0
+        if e["verdict"] == "routed" and "gspmd" in kinds:
+            gs = next(c for c in e["candidates"] if c["kind"] == "gspmd")
+            assert routed["score_bytes"] < gs["score_bytes"]
+        # executable-cache counters surfaced in the snapshot (satellite)
+        snap = obs.snapshot()
+        assert any(k.startswith("compile.cache") or
+                   k.startswith("reshard.dispatches")
+                   for k in snap["counters"]), snap["counters"]
+    finally:
+        obs_events._reset_for_tests()
+        obs_metrics.registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# reshard donate + whole-plan compile()
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_donate_api(devices):
+    """donate=True stays correct on both the routed and the GSPMD
+    path (buffer invalidation itself is backend-dependent; the contract
+    under test is correctness + a distinct donating executable)."""
+    topo = Topology((2, 4))
+    shape = (12, 10, 14)
+    u = global_ref(shape)
+    pin = Pencil(topo, shape, (1, 2))
+    dest = Pencil(topo, shape, (0, 1), permutation=Permutation(2, 0, 1))
+    for method in (None, Gspmd()):
+        x = PencilArray.from_global(pin, u)
+        kwargs = {} if method is None else {"method": method}
+        y = reshard(x, dest, donate=True, **kwargs)
+        np.testing.assert_array_equal(gather(y), u)
+
+
+def test_plan_compile_bit_identical_and_single_dispatch(devices):
+    """compile() executes the full chain bit-identically to the eager
+    schedule, and after the first (tracing) call the eager interpreter
+    is never re-entered — one executable dispatch per direction."""
+    topo = Topology((2, 4))
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True, dtype=np.float64)
+    u = PencilArray.from_global(
+        plan.input_pencil,
+        np.random.default_rng(7).standard_normal((16, 12, 10)))
+    uh_eager = plan.forward(u)
+    back_eager = plan.backward(uh_eager)
+
+    compiled = plan.compile()
+    assert plan.compile() is compiled  # cached per (extra_dims, donate)
+
+    calls = {"fwd": 0, "bwd": 0}
+    orig_fwd, orig_bwd = plan.forward, plan.backward
+    plan.forward = lambda *a, **k: (calls.__setitem__(
+        "fwd", calls["fwd"] + 1), orig_fwd(*a, **k))[1]
+    plan.backward = lambda *a, **k: (calls.__setitem__(
+        "bwd", calls["bwd"] + 1), orig_bwd(*a, **k))[1]
+    try:
+        uh_c = compiled.forward(u)       # traces once
+        back_c = compiled.backward(uh_c)
+        assert calls == {"fwd": 1, "bwd": 1}
+        for _ in range(3):               # pure executable dispatches
+            uh_c = compiled.forward(u)
+            back_c = compiled.backward(uh_c)
+        assert calls == {"fwd": 1, "bwd": 1}, (
+            "compiled plan re-entered the eager per-hop interpreter")
+    finally:
+        del plan.forward, plan.backward
+    np.testing.assert_array_equal(np.asarray(uh_c.data),
+                                  np.asarray(uh_eager.data))
+    np.testing.assert_array_equal(np.asarray(back_c.data),
+                                  np.asarray(back_eager.data))
+
+
+def test_plan_compile_validates_inputs(devices):
+    topo = Topology((2, 4))
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True)
+    compiled = plan.compile()
+    wrong = PencilArray.zeros(Pencil(topo, (16, 12, 10), (0, 2)),
+                              dtype=plan.dtype_physical)
+    with pytest.raises(ValueError, match="input_pencil"):
+        compiled.forward(wrong)
+    with pytest.raises(ValueError, match="extra_dims"):
+        compiled.forward(plan.allocate_input((3,)))
+
+
+def test_plan_compile_extra_dims_and_pipeline(devices):
+    """Batch dims and fused pipelined hops ride through the one-program
+    path unchanged."""
+    topo = Topology((2, 4))
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True, dtype=np.float64,
+                         pipeline=2)
+    u = PencilArray.from_global(
+        plan.input_pencil,
+        np.random.default_rng(8).standard_normal((16, 12, 10, 3)))
+    assert u.extra_dims == (3,)
+    compiled = plan.compile((3,))
+    uh_eager = plan.forward(u)
+    uh_c = compiled.forward(u)
+    np.testing.assert_array_equal(np.asarray(uh_c.data),
+                                  np.asarray(uh_eager.data))
+
+
+def test_many_pencil_reshard_to(devices):
+    """ManyPencilArray.reshard_to jumps non-adjacent configurations in
+    one routed dispatch, landing on the same data transpose_to reaches
+    hop by hop."""
+    from pencilarrays_tpu import ManyPencilArray
+
+    topo = Topology((2, 4))
+    shape = (12, 10, 8)
+    u = global_ref(shape)
+    pens = [Pencil(topo, shape, d) for d in [(1, 2), (0, 2), (0, 1)]]
+    a = ManyPencilArray(*pens, first=PencilArray.from_global(pens[0], u))
+    b = ManyPencilArray(*pens, first=PencilArray.from_global(pens[0], u))
+    a.reshard_to(2, donate=False)
+    b.transpose_to(2, donate=False)
+    assert a.index == b.index == 2
+    np.testing.assert_array_equal(np.asarray(a.current.data),
+                                  np.asarray(b.current.data))
+    np.testing.assert_array_equal(gather(a.current), u)
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache knob (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_cache_env_knob(tmp_path, monkeypatch):
+    from pencilarrays_tpu.utils.jaxcompat import (COMPILE_CACHE_VAR,
+                                                  configure_compilation_cache)
+
+    old = jax.config.jax_compilation_cache_dir
+    try:
+        monkeypatch.delenv(COMPILE_CACHE_VAR, raising=False)
+        assert configure_compilation_cache() is None
+        monkeypatch.setenv(COMPILE_CACHE_VAR, str(tmp_path / "cc"))
+        got = configure_compilation_cache()
+        assert got == str(tmp_path / "cc")
+        assert jax.config.jax_compilation_cache_dir == got
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+
+
+# ---------------------------------------------------------------------------
+# sweep smoke (opt-in CI arm)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_reshard_sweep_smoke(devices):
+    from benchmarks.reshard_sweep import measure_reshards
+
+    topo = Topology((2, 4))
+    points = measure_reshards(topo, (12, 10, 8), k1=3, repeats=2)
+    assert len(points) == 3
+    for p in points:
+        assert p["gspmd_seconds"] > 0
+        if p["route"] is not None:
+            assert p["routed_seconds"] > 0
+            assert p["routed_predicted_bytes"] > 0
