@@ -155,6 +155,110 @@ def _measure_obs_overhead(topo, devs, n=64, dispatches=200, repeats=5):
     }
 
 
+def _measure_guard_overhead(topo, devs, n=64, dispatches=200, repeats=5):
+    """The ``--guard`` arm: per-dispatch wall time of an eager transpose
+    with the integrity guard DISABLED (the shipped default, whose only
+    addition over the pre-guard baseline is one cached env probe + one
+    fault-rule probe) vs ENABLED (invariant probes riding the hop
+    program + host compare + watchdog arm/disarm), vs the bare compiled
+    executable.  Small arrays on purpose: the measurement targets
+    DISPATCH overhead; the on-arm also reports the probe's effect on
+    hop THROUGHPUT at a wire-sized array (guard on/off seconds on the
+    same exchange)."""
+    import tempfile
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pencilarrays_tpu import Pencil, PencilArray, transpose
+    from pencilarrays_tpu import guard
+    from pencilarrays_tpu.parallel.transpositions import (
+        AllToAll, _compiled_transpose, assert_compatible)
+    from pencilarrays_tpu.ops.pallas_kernels import pallas_enabled
+
+    if len(devs) > 1:
+        pen_x = Pencil(topo, (n, n, n), (1, 2))
+        pen_y = Pencil(topo, (n, n, n), (0, 2))
+    else:
+        pen_x = Pencil(topo, (n, n, n), (2,))
+        pen_y = Pencil(topo, (n, n, n), (1,))
+    u = PencilArray.zeros(pen_x, dtype=jnp.float32)
+
+    def timed_loop(fn):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = _time.perf_counter()
+            for _ in range(dispatches):
+                fn()
+            best = min(best, (_time.perf_counter() - t0) / dispatches)
+        return best
+
+    # Every arm SYNCHRONIZES per dispatch: the guarded path inherently
+    # blocks on its probe fetch, so the off/floor arms must block too
+    # for a like-for-like per-dispatch number — and unbounded async
+    # pile-up of eager collective programs can deadlock the CPU
+    # backend's rendezvous (interleaved per-device execution order).
+    def via_transpose():
+        jax.block_until_ready(
+            transpose(transpose(u, pen_y), pen_x).data)
+
+    bdir = tempfile.mkdtemp(prefix="pa_guard_bench_")
+    try:
+        R = assert_compatible(pen_x, pen_y)
+        fwd = _compiled_transpose(pen_x, pen_y, R, 0, AllToAll(), False,
+                                  pallas_enabled())
+        bwd = _compiled_transpose(pen_y, pen_x, R, 0, AllToAll(), False,
+                                  pallas_enabled())
+        data = u.data
+        with guard._forced("unset"):
+            via_transpose()      # warm every executable before timing
+        t_floor = timed_loop(
+            lambda: jax.block_until_ready(bwd(fwd(data)))) / 2
+        samples_off, samples_on = [], []
+        for _ in range(3):       # interleaved arms (the obs-arm protocol)
+            with guard._forced("unset"):
+                via_transpose()
+                samples_off.append(timed_loop(via_transpose) / 2)
+            with guard._forced("on", bdir):
+                via_transpose()  # warm the probe-instrumented executable
+                samples_on.append(timed_loop(via_transpose) / 2)
+        t_on = min(samples_on)
+        t_off = min(samples_off)
+        spread_off = max(samples_off) / t_off if t_off else None
+        # the disabled-path addition: one guard gate probe + one
+        # fault-rule probe per dispatch — time them on the unset path
+        K = 100_000
+        from pencilarrays_tpu.resilience import faults
+
+        with guard._forced("unset"):
+            t0 = _time.perf_counter()
+            for _ in range(K):
+                guard.enabled()
+                faults.armed("hop.exchange")
+            gate_s = (_time.perf_counter() - t0) / K
+    finally:
+        import shutil
+
+        shutil.rmtree(bdir, ignore_errors=True)
+    return {
+        "what": "per-transpose-dispatch host wall seconds (eager, "
+                f"{n}^3 f32, {len(devs)} devices)",
+        "dispatch_s_compiled_floor": t_floor,
+        "dispatch_s_guard_off": t_off,
+        "dispatch_s_guard_on": t_on,
+        "guard_off_spread": spread_off,
+        "on_over_off": t_on / t_off if t_off else None,
+        "gate_probe_s": gate_s,
+        "gate_fraction_of_dispatch": gate_s / t_off if t_off else None,
+        # the acceptance claim: the disabled-path addition (gate + fault
+        # probes) is far below the measurement's own repeat jitter
+        "disabled_overhead_within_noise":
+            (gate_s / t_off) < max((spread_off or 1.0) - 1.0, 0.01)
+            if t_off else None,
+    }
+
+
 def _raw_ns_state(n):
     """Taylor-Green spectral state for the raw-jnp NS baseline: physical
     (n,n,n,3) f32 -> rfftn over the spatial axes."""
@@ -239,6 +343,13 @@ def main():
     parser.add_argument("--obs-only", action="store_true",
                         help="run ONLY the --obs overhead arm (fast; used "
                              "to commit the BENCH_OBS.json artifact)")
+    parser.add_argument("--guard", action="store_true",
+                        help="also measure guard-on vs guard-off transpose "
+                             "dispatch overhead (the integrity guard's "
+                             "disabled-path guarantee)")
+    parser.add_argument("--guard-only", action="store_true",
+                        help="run ONLY the --guard overhead arm (fast; used "
+                             "to commit the BENCH_GUARD.json artifact)")
     args = parser.parse_args()
 
     import jax
@@ -264,6 +375,25 @@ def main():
     if args.obs or args.obs_only:
         results["obs_overhead"] = _measure_obs_overhead(topo, devs)
         if args.obs_only:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+            print(json.dumps(results, indent=1))
+            return
+
+    # -- 9. guard: integrity-probe overhead (opt-in) ----------------------
+    # The acceptance contract of the integrity guard: with
+    # PENCILARRAYS_TPU_GUARD unset, hop dispatch must be within noise of
+    # the pre-guard baseline (the addition is one gate probe + one
+    # fault-rule probe); with it on, the probes ride the hop program.
+    if args.guard or args.guard_only:
+        # multi-device virtual meshes serialize on one core here: fewer
+        # timed dispatches keep the arm inside a CI budget (the metric
+        # is a per-dispatch RATIO, not wall throughput)
+        results["guard_overhead"] = _measure_guard_overhead(
+            topo, devs,
+            dispatches=60 if len(devs) > 1 else 200,
+            repeats=3 if len(devs) > 1 else 5)
+        if args.guard_only:
             with open(args.out, "w") as f:
                 json.dump(results, f, indent=1)
             print(json.dumps(results, indent=1))

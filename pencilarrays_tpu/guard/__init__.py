@@ -1,0 +1,248 @@
+"""Runtime integrity guard — SDC detection, hang watchdog, recovery.
+
+PR 2 made the *storage* path crash-safe and PR 3 made the runtime
+observable; this package guards the *in-flight* path.  At pod scale the
+dominant silent failure modes of the redistribution traffic the
+transpose engine generates (arXiv:2112.01075, arXiv:2112.09017) are:
+
+* **silent data corruption** — a flipped bit on the wire or in HBM
+  turns a pure-data-movement exchange into garbage that parses;
+* **hangs** — a wedged collective or coordinator stalls the job
+  indefinitely with no error and no artifact;
+* **unrecovered faults** — a detected error kills the step instead of
+  retrying / restoring from the last committed checkpoint.
+
+Four cooperating pieces (see ``docs/Guard.md``):
+
+* :mod:`~pencilarrays_tpu.guard.integrity` — **exchange invariant
+  probes**: transposes and reshard routes are pure data movement, so a
+  cheap content-sum + finiteness probe computed before/after each hop
+  *inside the same jitted program* must match; a mismatch raises
+  :class:`IntegrityError` and journals ``guard.sdc``;
+* :mod:`~pencilarrays_tpu.guard.watchdog` — a host-side monitor thread
+  arming a deadline around collective dispatch, barriers and
+  ``distributed.initialize``; on expiry it writes a **crash bundle**
+  and raises :class:`HangTimeoutError`;
+* :mod:`~pencilarrays_tpu.guard.bundle` — the crash-bundle writer
+  (obs journal + metrics snapshot + per-thread stacks + plan
+  fingerprints + environment);
+* :mod:`~pencilarrays_tpu.guard.recover` — :func:`guarded_step`:
+  retry a step on :class:`IntegrityError` under the PR-2
+  ``RetryPolicy`` and escalate to a ``CheckpointManager`` restore.
+
+Everything is **off by default** and near-zero overhead when off — the
+``faults``/``obs`` discipline: one cached env probe per dispatch, the
+env var re-read whenever it changes so a worker can arm late, and with
+the guard off the hop executables are byte-identical to the unguarded
+ones (test-pinned).  Enable with ``PENCILARRAYS_TPU_GUARD=1`` (any
+other non-off value is itself the bundle directory) or
+programmatically with :func:`enable`.
+
+Environment knobs:
+
+================================  =========  ==========================
+``PENCILARRAYS_TPU_GUARD``        unset      off / ``1`` on / a path
+                                             (on + bundle dir)
+``PENCILARRAYS_TPU_GUARD_DIR``    pa_guard   crash-bundle directory
+``PENCILARRAYS_TPU_GUARD_TIMEOUT``  300      watchdog deadline (s);
+                                             ``0`` disables the
+                                             watchdog only
+``PENCILARRAYS_TPU_GUARD_RTOL``   auto       content-sum relative
+                                             tolerance override
+``PENCILARRAYS_TPU_GUARD_FINITE``  0         finiteness-tap sampling:
+                                             probe every Nth guarded
+                                             dispatch (``0`` off)
+================================  =========  ==========================
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+from .errors import GuardError, HangTimeoutError, IntegrityError  # noqa: F401
+
+__all__ = [
+    "ENV_VAR",
+    "DIR_VAR",
+    "TIMEOUT_VAR",
+    "RTOL_VAR",
+    "FINITE_VAR",
+    "GuardError",
+    "IntegrityError",
+    "HangTimeoutError",
+    "enabled",
+    "enable",
+    "disable",
+    "bundle_dir",
+    "hang_timeout",
+    "finite_every",
+    "finite_tick",
+    "watchdog",
+    "guarded_step",
+    "write_crash_bundle",
+    "note_plan",
+]
+
+ENV_VAR = "PENCILARRAYS_TPU_GUARD"
+DIR_VAR = "PENCILARRAYS_TPU_GUARD_DIR"
+TIMEOUT_VAR = "PENCILARRAYS_TPU_GUARD_TIMEOUT"
+RTOL_VAR = "PENCILARRAYS_TPU_GUARD_RTOL"
+FINITE_VAR = "PENCILARRAYS_TPU_GUARD_FINITE"
+DEFAULT_DIR = "pa_guard"
+DEFAULT_TIMEOUT = 300.0
+
+_OFF_VALUES = ("", "0", "off", "false")
+
+_lock = threading.Lock()
+_override: Optional[bool] = None      # programmatic enable()/disable()
+_override_dir: Optional[str] = None
+_env_cache: Optional[str] = None
+_env_on = False
+_finite_counter = 0
+
+
+def _env_enabled() -> bool:
+    """Re-read ``ENV_VAR`` on change (workers arm late, like faults)."""
+    global _env_cache, _env_on
+    env = os.environ.get(ENV_VAR, "")
+    if env != _env_cache:
+        _env_cache = env
+        _env_on = env not in _OFF_VALUES
+    return _env_on
+
+
+def enabled() -> bool:
+    """THE gate every guarded call site probes first.  One branch + one
+    cached env lookup on the disabled path — no probe ops are traced,
+    no watchdog is armed, nothing is allocated unless this is True."""
+    if _override is not None:
+        return _override
+    return _env_enabled()
+
+
+def enable(bundle_directory: Optional[str] = None) -> None:
+    """Programmatic enable (overrides the environment until
+    :func:`disable`); ``bundle_directory`` overrides the crash-bundle
+    location."""
+    global _override, _override_dir
+    with _lock:
+        _override = True
+        _override_dir = (os.fspath(bundle_directory)
+                         if bundle_directory else None)
+
+
+def disable() -> None:
+    """Programmatic disable: wins over the environment until the next
+    :func:`enable`."""
+    global _override, _override_dir
+    with _lock:
+        _override = False
+        _override_dir = None
+
+
+def _reset_for_tests() -> None:
+    """Full gate reset: drop overrides AND the env cache (tests toggle
+    the env between cases; production code never needs this)."""
+    global _override, _override_dir, _env_cache, _env_on, _finite_counter
+    with _lock:
+        _override = None
+        _override_dir = None
+        _env_cache = None
+        _env_on = False
+        _finite_counter = 0
+
+
+def bundle_dir() -> str:
+    """Resolved crash-bundle directory for the current configuration."""
+    if _override_dir:
+        return _override_dir
+    env = os.environ.get(ENV_VAR, "")
+    if env not in _OFF_VALUES + ("1", "on", "true"):
+        return env
+    return os.environ.get(DIR_VAR, DEFAULT_DIR)
+
+
+def hang_timeout() -> float:
+    """Watchdog deadline in seconds (``0`` disables the watchdog while
+    leaving the invariant probes armed)."""
+    try:
+        return float(os.environ.get(TIMEOUT_VAR, DEFAULT_TIMEOUT))
+    except ValueError:
+        return DEFAULT_TIMEOUT
+
+
+def finite_every() -> int:
+    """Finiteness-tap sampling period: probe every Nth guarded dispatch
+    (``0`` = tap off; the content-sum probe still catches NaN births on
+    pure-movement hops, since NaN poisons the post sum)."""
+    try:
+        return max(0, int(os.environ.get(FINITE_VAR, "0")))
+    except ValueError:
+        return 0
+
+
+def finite_tick() -> bool:
+    """Counter-based sampling decision for one guarded dispatch: True
+    on every Nth call when the tap is armed (deterministic, never
+    random — the faults discipline)."""
+    n = finite_every()
+    if n <= 0:
+        return False
+    global _finite_counter
+    with _lock:
+        _finite_counter += 1
+        return _finite_counter % n == 0
+
+
+@contextmanager
+def _forced(mode: str, directory: Optional[str] = None):
+    """Temporarily force the gate — ``"on"`` (bundles to ``directory``)
+    or ``"unset"`` (override cleared AND env removed: the true
+    shipped-default path) — restoring every piece of gate state after.
+    The guard overhead bench arm uses this (the ``obs.events._forced``
+    convention)."""
+    global _override, _override_dir
+    with _lock:
+        saved = (_override, _override_dir, os.environ.get(ENV_VAR))
+        if mode == "on":
+            _override = True
+            _override_dir = os.fspath(directory) if directory else None
+        elif mode == "unset":
+            _override = None
+            _override_dir = None
+            os.environ.pop(ENV_VAR, None)
+        else:
+            raise ValueError(f"unknown forced mode {mode!r}")
+    try:
+        yield
+    finally:
+        with _lock:
+            _override, _override_dir = saved[0], saved[1]
+            if saved[2] is None:
+                os.environ.pop(ENV_VAR, None)
+            else:
+                os.environ[ENV_VAR] = saved[2]
+
+
+def __getattr__(name):
+    # Heavy pieces load lazily so the gate itself stays import-light
+    # (transpositions imports this package at module import time).
+    if name == "guarded_step":
+        from .recover import guarded_step
+
+        return guarded_step
+    if name in ("write_crash_bundle", "note_plan"):
+        from . import bundle as _bundle
+
+        return getattr(_bundle, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# Bound EAGERLY and last: the submodule import sets a ``watchdog``
+# module attribute on this package, and this from-import then rebinds
+# the name to the context-manager class — lazy __getattr__ would lose
+# that race forever after the first submodule import.
+from .watchdog import watchdog  # noqa: E402,F401

@@ -1,0 +1,294 @@
+"""Exchange invariant probes — SDC detection for pure data movement.
+
+Transposes, reshard routes and checkpoint restores move bits; they never
+change them.  That makes a near-free on-device invariant possible: a
+content sum (widest-available float accumulation) plus an
+absolute-value sum (the tolerance scale) and an optional nonfinite
+count, computed over the operand **before** and **after** the hop
+*inside the same jitted program* — no extra dispatch, no host copy of
+the data, just two small replicated reductions XLA fuses into the
+exchange program.  The host compares the pair after dispatch:
+
+* exact dtypes (ints/bool): wrapping integer addition is commutative,
+  so pre == post **bit-for-bit**;
+* inexact dtypes: the exchange reorders the reduction, so the sums may
+  differ by accumulation rounding — the tolerance is
+  ``rtol * abs_sum`` with ``rtol`` derived from the accumulator epsilon
+  and the element count (override: ``PENCILARRAYS_TPU_GUARD_RTOL``);
+  a NaN/Inf *born* inside the hop poisons the post sum and is caught
+  even with the finiteness tap off, while NaNs already present in the
+  input match on both sides and pass;
+* the sampled finiteness tap additionally compares nonfinite counts,
+  catching compensating corruptions the sum is blind to.
+
+A mismatch journals ``guard.sdc``, writes a crash bundle and raises
+:class:`~pencilarrays_tpu.guard.errors.IntegrityError` — typed error,
+never garbage.  Deterministic drills: :func:`corrupt_block` is the
+counter-addressed bitflip/NaN poke the ``faults`` ``corrupt`` mode
+applies to a hop's output (``hop.exchange``) or a restored dataset
+(``ckpt.restore``).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from functools import lru_cache
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .errors import IntegrityError
+
+__all__ = [
+    "probe_stats",
+    "probes_match",
+    "check_hop_probes",
+    "corrupt_block",
+    "corrupt_eager",
+    "nonfinite_count",
+    "report_nonfinite_birth",
+    "check_finite_boundary",
+]
+
+
+def _acc_dtype():
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def probe_stats(x, finite: bool = False):
+    """Traced invariant probe of one array: a 4-vector
+    ``[sum_re, sum_im, abs_sum, nonfinite]`` in the widest available
+    float accumulator (f64 under x64, else f32).  For exact dtypes the
+    sums are wrapping-integer exact, cast to float for the uniform
+    shape; ``nonfinite`` is computed only when ``finite`` (a static
+    trace-time decision — the sampled tap)."""
+    import jax.numpy as jnp
+
+    acc = _acc_dtype()
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        re, im = jnp.real(x), jnp.imag(x)
+        s_re = jnp.sum(re, dtype=acc)
+        s_im = jnp.sum(im, dtype=acc)
+        s_abs = jnp.sum(jnp.abs(re), dtype=acc) + jnp.sum(jnp.abs(im),
+                                                          dtype=acc)
+        nf = (jnp.sum(~jnp.isfinite(re) | ~jnp.isfinite(im),
+                      dtype=acc) if finite else jnp.zeros((), acc))
+    elif jnp.issubdtype(x.dtype, jnp.inexact):
+        s_re = jnp.sum(x, dtype=acc)
+        s_im = jnp.zeros((), acc)
+        s_abs = jnp.sum(jnp.abs(x), dtype=acc)
+        nf = (jnp.sum(~jnp.isfinite(x), dtype=acc) if finite
+              else jnp.zeros((), acc))
+    else:
+        # exact dtypes: modular integer addition is order-independent,
+        # so the sum matches bit-for-bit; accumulate in the widest int
+        # then report as float (exactly representable under x64; under
+        # f32 the wrap below 2**24 is exact, beyond it the compare
+        # degrades to tolerance like inexact dtypes)
+        wide = jnp.int64 if _acc_dtype() == jnp.float64 else jnp.int32
+        xi = x.astype(wide) if x.dtype != jnp.bool_ else x.astype(jnp.int32)
+        s_re = jnp.sum(xi).astype(acc)
+        s_im = jnp.zeros((), acc)
+        s_abs = jnp.sum(jnp.abs(xi)).astype(acc)
+        nf = jnp.zeros((), acc)
+    return jnp.stack([s_re, s_im, s_abs, nf])
+
+
+def _default_rtol(count: int, dtype) -> float:
+    """Tolerance for the content-sum compare: zero for exact dtypes;
+    for inexact, the accumulator epsilon scaled by the depth of XLA's
+    (pairwise-ish) reduction tree plus safety margin."""
+    if not np.issubdtype(np.dtype(dtype), np.inexact):
+        return 0.0
+    env = os.environ.get("PENCILARRAYS_TPU_GUARD_RTOL", "")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    import jax
+
+    eps = (np.finfo(np.float64).eps if jax.config.jax_enable_x64
+           else np.finfo(np.float32).eps)
+    return eps * (8.0 + 4.0 * math.log2(max(2, count)))
+
+
+def _component_ok(a: float, b: float, tol_abs: float) -> bool:
+    if np.isnan(a) and np.isnan(b):
+        return True       # NaN flowed through unchanged: movement, not birth
+    if a == b:
+        return True       # covers matching infinities and the exact case
+    if not (np.isfinite(a) and np.isfinite(b)):
+        return False      # a nonfinite value was born (or lost) in the hop
+    return abs(a - b) <= tol_abs
+
+
+def probes_match(pre, post, count: int, dtype,
+                 *, finite: bool = False) -> Tuple[bool, str]:
+    """Host-side compare of a probe pair.  Returns ``(ok, kind)`` where
+    ``kind`` is ``"sum"`` or ``"nonfinite"`` for the failing check."""
+    pre = np.asarray(pre, dtype=np.float64)
+    post = np.asarray(post, dtype=np.float64)
+    tol_abs = _default_rtol(count, dtype) * (abs(pre[2]) + 1.0)
+    for i in (0, 1, 2):
+        if not _component_ok(float(pre[i]), float(post[i]), tol_abs):
+            return False, "sum"
+    if finite and int(pre[3]) != int(post[3]):
+        return False, "nonfinite"
+    return True, "ok"
+
+
+def check_hop_probes(hop: str, pre, post, count: int, dtype, *,
+                     finite: bool = False, ctx: Optional[dict] = None) -> None:
+    """Verify one guarded hop's probe pair; on mismatch journal
+    ``guard.sdc``, write a crash bundle and raise
+    :class:`IntegrityError`.  On success bumps
+    ``guard.checks{outcome="ok"}`` only (no journal traffic on the
+    clean path)."""
+    from .. import obs
+
+    ok, kind = probes_match(pre, post, count, dtype, finite=finite)
+    if ok:
+        if obs.enabled():
+            obs.counter("guard.checks", outcome="ok").inc()
+        return
+    predicted = [float(v) for v in np.asarray(pre)]
+    observed = [float(v) for v in np.asarray(post)]
+    if obs.enabled():
+        obs.counter("guard.checks", outcome=kind).inc()
+        obs.record_event("guard.sdc", hop=hop, kind=kind,
+                         predicted=predicted, observed=observed,
+                         count=count, dtype=np.dtype(dtype).name,
+                         **(ctx or {}))
+    from .bundle import write_crash_bundle
+
+    bundle = write_crash_bundle(
+        "sdc", hop,
+        error=f"{kind} invariant mismatch: {predicted} -> {observed}",
+        extra={"predicted": predicted, "observed": observed,
+               "kind": kind, **(ctx or {})})
+    raise IntegrityError(
+        f"silent data corruption detected on {hop}: {kind} invariant "
+        f"mismatch (predicted {predicted}, observed {observed}; crash "
+        f"bundle: {bundle or 'unavailable'})",
+        hop=hop, predicted=predicted, observed=observed, kind=kind,
+        bundle=bundle)
+
+
+# ---------------------------------------------------------------------------
+# deterministic SDC drills (the faults `corrupt` mode payload)
+# ---------------------------------------------------------------------------
+
+
+def corrupt_block(x, idx):
+    """Traced counter-addressed corruption of one element: flat index
+    ``idx % size`` becomes NaN for inexact dtypes (the classic SDC
+    signature) or gets its sign bit flipped for exact dtypes.  ``idx``
+    is a traced scalar, so one executable serves every hit of a
+    ``corrupt`` rule."""
+    import jax.numpy as jnp
+
+    flat = x.reshape(-1)
+    idx = jnp.asarray(idx, jnp.int32) % flat.shape[0]
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        bad = jnp.asarray(complex(float("nan"), 0.0), x.dtype)
+    elif jnp.issubdtype(x.dtype, jnp.inexact):
+        bad = jnp.asarray(float("nan"), x.dtype)
+    elif x.dtype == jnp.bool_:
+        bad = ~flat[idx]
+    else:
+        info = jnp.iinfo(x.dtype)
+        # the sign bit as a value REPRESENTABLE in the dtype: min for
+        # signed (0b100...0), 2**(bits-1) for unsigned
+        signbit = info.min if info.min < 0 else 1 << (info.bits - 1)
+        bad = flat[idx] ^ jnp.asarray(signbit, x.dtype)
+    return flat.at[idx].set(bad).reshape(x.shape)
+
+
+@lru_cache(maxsize=1)
+def _corrupt_jit():
+    import jax
+
+    return jax.jit(corrupt_block)
+
+
+def corrupt_eager(x, hit: int):
+    """Apply :func:`corrupt_block` to a concrete array (the unguarded /
+    restore drill path), addressed by the fault rule's hit counter."""
+    return _corrupt_jit()(x, max(0, int(hit)))
+
+
+# ---------------------------------------------------------------------------
+# finiteness boundary tap (the "NaN born mid-FFT" detector)
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=1)
+def _nonfinite_jit():
+    import jax
+    import jax.numpy as jnp
+
+    def count(x):
+        if jnp.issubdtype(x.dtype, jnp.complexfloating):
+            bad = ~jnp.isfinite(jnp.real(x)) | ~jnp.isfinite(jnp.imag(x))
+        elif jnp.issubdtype(x.dtype, jnp.inexact):
+            bad = ~jnp.isfinite(x)
+        else:
+            return jnp.zeros((), jnp.int32)
+        return jnp.sum(bad, dtype=jnp.int32)
+
+    return jax.jit(count)
+
+
+def nonfinite_count(x) -> int:
+    """Nonfinite elements of a concrete array (0 for exact dtypes)."""
+    return int(_nonfinite_jit()(x))
+
+
+def report_nonfinite_birth(label: str, nf_out: int,
+                           ctx: Optional[dict] = None) -> None:
+    """A section whose input was finite produced ``nf_out`` nonfinite
+    values: journal ``guard.sdc`` (``kind="nonfinite"``), write a crash
+    bundle and raise :class:`IntegrityError`.  No-op when ``nf_out`` is
+    0 (bumps the ok counter)."""
+    from .. import obs
+
+    if nf_out == 0:
+        if obs.enabled():
+            obs.counter("guard.checks", outcome="ok").inc()
+        return
+    if obs.enabled():
+        obs.counter("guard.checks", outcome="nonfinite").inc()
+        obs.record_event("guard.sdc", hop=label, kind="nonfinite",
+                         predicted=[0], observed=[nf_out], **(ctx or {}))
+    from .bundle import write_crash_bundle
+
+    bundle = write_crash_bundle(
+        "sdc", label,
+        error=f"{nf_out} nonfinite value(s) born inside {label}",
+        extra={"nonfinite": nf_out, **(ctx or {})})
+    raise IntegrityError(
+        f"{nf_out} nonfinite value(s) born inside {label} from finite "
+        f"input (crash bundle: {bundle or 'unavailable'})",
+        hop=label, predicted=[0], observed=[nf_out], kind="nonfinite",
+        bundle=bundle)
+
+
+def check_finite_boundary(label: str, x_in, x_out,
+                          ctx: Optional[dict] = None) -> None:
+    """Sampled transform-boundary tap: a nonfinite value present in the
+    output but not the input was *born* inside the section (an
+    overflow, a poisoned exchange, a bad kernel) — journal ``guard.sdc``
+    with ``kind="nonfinite"``, write a bundle and raise
+    :class:`IntegrityError`.  Inputs already carrying nonfinite values
+    pass through ungated (a diverging simulation is the caller's
+    business, not corruption).  Callers whose input buffer is donated
+    must take ``nonfinite_count(x_in)`` BEFORE dispatch and use
+    :func:`report_nonfinite_birth` directly."""
+    if nonfinite_count(x_in) > 0:
+        return
+    report_nonfinite_birth(label, nonfinite_count(x_out), ctx)

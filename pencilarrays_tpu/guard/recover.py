@@ -1,0 +1,124 @@
+"""Detect-and-recover execution — the guard's escalation ladder.
+
+A detected corruption (:class:`IntegrityError`) is *transient by
+construction*: the data that went INTO the hop was fine, so re-running
+the step usually succeeds, and when it does not, the last committed
+checkpoint (PR 2's ``CheckpointManager``) restores known-good state.
+:func:`guarded_step` encodes that ladder once:
+
+1. run the step (under the hang watchdog);
+2. on :class:`IntegrityError`, retry under the PR-2
+   :class:`~pencilarrays_tpu.resilience.retry.RetryPolicy` backoff
+   (same env knobs: ``PENCILARRAYS_TPU_RETRIES`` etc.);
+3. attempts exhausted → restore ``ckpt_mgr.latest_valid()`` through
+   the caller's ``restore`` callback and run the step once more;
+4. still failing (or no checkpoint to restore) → re-raise the typed
+   error.
+
+Every rung journals a ``guard.recover`` event (stages ``error`` /
+``retry`` / ``restore`` / ``recovered`` / ``failed``), so the flight
+recorder carries the full detect-retry-restore timeline a post-mortem
+needs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from .errors import IntegrityError
+
+__all__ = ["guarded_step"]
+
+
+def _journal(stage: str, label: str, **fields) -> None:
+    from .. import obs
+
+    if not obs.enabled():
+        return
+    obs.counter("guard.recoveries", stage=stage).inc()
+    obs.record_event("guard.recover", label=label, stage=stage, **fields)
+
+
+def guarded_step(fn: Callable, *, ckpt_mgr=None,
+                 restore: Optional[Callable] = None, retry=None,
+                 label: str = "step",
+                 watchdog_timeout: Optional[float] = None):
+    """Run one unit of work with detect-and-recover semantics.
+
+    Parameters
+    ----------
+    fn:
+        Zero-argument callable performing the step (typically a closure
+        over the caller's state).  Only :class:`IntegrityError` enters
+        the recovery ladder; every other exception propagates untouched.
+    ckpt_mgr:
+        A :class:`~pencilarrays_tpu.resilience.CheckpointManager`; with
+        ``restore`` it enables the escalation rung.
+    restore:
+        ``restore(checkpoint)`` callback reloading the caller's state
+        from an opened
+        :class:`~pencilarrays_tpu.resilience.checkpoint.Checkpoint`
+        (the step's inputs live with the caller, so only the caller can
+        put restored data back where ``fn`` reads it).
+    retry:
+        :class:`~pencilarrays_tpu.resilience.retry.RetryPolicy`
+        (default: env-tuned ``from_env()``).  ``max_attempts`` bounds
+        the pre-escalation retries; backoff/jitter/deadline apply as in
+        any other retried operation.
+    label:
+        Journal/watchdog label of this step.
+    watchdog_timeout:
+        Per-attempt hang deadline override (None: the guard env
+        default).
+
+    Returns ``fn()``'s value.  Raises the last :class:`IntegrityError`
+    when the full ladder fails, or
+    :class:`~pencilarrays_tpu.resilience.errors.CheckpointNotFoundError`
+    semantics are folded into the same re-raise (a missing valid
+    checkpoint cannot recover anything)."""
+    from ..resilience.retry import RetryPolicy
+    from .watchdog import watchdog
+
+    policy = retry or RetryPolicy.from_env()
+    start = time.monotonic()
+    last: Optional[IntegrityError] = None
+    attempts = max(1, policy.max_attempts)
+    for attempt in range(1, attempts + 1):
+        try:
+            with watchdog(label, watchdog_timeout, kind="step"):
+                out = fn()
+            if attempt > 1:
+                _journal("recovered", label, attempt=attempt, via="retry")
+            return out
+        except IntegrityError as e:
+            last = e
+            _journal("error", label, attempt=attempt, kind=e.kind,
+                     hop=e.hop, error=str(e))
+            if attempt >= attempts:
+                break
+            delay = policy.delay_for(attempt)
+            if time.monotonic() - start + delay > policy.deadline:
+                break   # deadline exhausted: escalate now, not later
+            _journal("retry", label, attempt=attempt, delay_s=delay)
+            time.sleep(delay)
+
+    if ckpt_mgr is None or restore is None:
+        _journal("failed", label, error=str(last), escalation="none")
+        raise last
+    step = ckpt_mgr.latest_valid()
+    if step is None:
+        _journal("failed", label, error=str(last),
+                 escalation="no-valid-checkpoint")
+        raise last
+    _journal("restore", label, step=step)
+    restore(ckpt_mgr.restore(step))
+    try:
+        with watchdog(label, watchdog_timeout, kind="step"):
+            out = fn()
+    except IntegrityError as e:
+        _journal("failed", label, step=step, error=str(e),
+                 escalation="restore")
+        raise
+    _journal("recovered", label, step=step, via="restore")
+    return out

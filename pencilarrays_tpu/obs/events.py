@@ -71,6 +71,7 @@ SCHEMA_VERSION = 1
 CRITICAL_EVENTS = frozenset({
     "run.start", "ckpt.save", "ckpt.commit", "ckpt.restore", "ckpt.verify",
     "fault", "retry", "dist.init",
+    "guard.sdc", "guard.hang", "guard.recover", "guard.bundle",
 })
 
 _lock = threading.Lock()

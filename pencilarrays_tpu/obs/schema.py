@@ -46,6 +46,11 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     "retry": ("label", "attempt", "max_attempts", "delay_s", "error"),
     "fault": ("point", "mode", "hit"),
     "dist.init": ("status",),
+    # runtime integrity guard (guard/)
+    "guard.sdc": ("hop", "kind", "predicted", "observed"),
+    "guard.hang": ("label", "timeout_s"),
+    "guard.recover": ("label", "stage"),
+    "guard.bundle": ("path", "reason"),
     # profiling / drift
     "profile": ("dir", "status"),
     "drift.sample": ("hop", "predicted_bytes", "measured_s", "source"),

@@ -696,11 +696,15 @@ class PencilFFTPlan:
             if kinds[d] == "rfft":
                 sh[d] = sh[d] // 2 + 1
 
-        from .. import obs
+        from .. import guard, obs
 
         if obs.enabled():
             obs.counter("fft.plans_built").inc()
             obs.record_event("plan.build", **self._obs_summary())
+        if guard.enabled():
+            # crash bundles carry the schedules of recently-built plans
+            # (which compiled programs were in flight when things hung)
+            guard.note_plan("fft_plan", self._obs_summary())
 
     def _fuse_pipeline_steps(self, steps: tuple, K: int) -> tuple:
         """Rewrite eligible hop+transform pairs into fused ``("ft", src,
@@ -965,6 +969,7 @@ class PencilFFTPlan:
                 f"input must live on plan.input_pencil "
                 f"({self.input_pencil!r}), got {u.pencil!r}"
             )
+        tap = self._guard_tap_pre(u)
         nd_extra = u.ndims_extra
         x = u
         owned = donate
@@ -997,7 +1002,39 @@ class PencilFFTPlan:
         if x.dtype != self.dtype_spectral:
             x = PencilArray(x.pencil, x.data.astype(self.dtype_spectral),
                             x.extra_dims)
+        self._guard_tap_post(tap, "fft.forward", x)
         return x
+
+    @staticmethod
+    def _guard_tap_pre(u: PencilArray) -> bool:
+        """Sampled finiteness boundary tap, input side (the "NaN born
+        mid-FFT" detector): returns True when this eager call was
+        sampled AND the input is wholly finite — the precondition the
+        output check needs.  The input count is taken BEFORE the chain
+        because ``donate=True`` invalidates the input buffer.  One
+        cached env probe when the guard is off."""
+        import jax.core
+
+        from .. import guard
+
+        if not guard.enabled() or isinstance(u.data, jax.core.Tracer) \
+                or not guard.finite_tick():
+            return False
+        from ..guard import integrity as gi
+
+        return gi.nonfinite_count(u.data) == 0
+
+    @staticmethod
+    def _guard_tap_post(tap: bool, label: str, x: PencilArray) -> None:
+        """Output side of the sampled tap: a nonfinite value born across
+        the transform chain raises a typed ``IntegrityError`` (journal
+        ``guard.sdc``, crash bundle) instead of flowing downstream."""
+        if not tap:
+            return
+        from ..guard import integrity as gi
+
+        gi.report_nonfinite_birth(label, gi.nonfinite_count(x.data),
+                                  ctx={"shape": list(x.pencil.size_global())})
 
     def backward(self, uh: PencilArray, *, donate: bool = False
                  ) -> PencilArray:
@@ -1008,6 +1045,7 @@ class PencilFFTPlan:
                 f"input must live on plan.output_pencil "
                 f"({self.output_pencil!r}), got {uh.pencil!r}"
             )
+        tap = self._guard_tap_pre(uh)
         nd_extra = uh.ndims_extra
         x = uh
         owned = donate
@@ -1040,6 +1078,7 @@ class PencilFFTPlan:
         if x.dtype != self.dtype_physical:
             x = PencilArray(x.pencil, x.data.astype(self.dtype_physical),
                             x.extra_dims)
+        self._guard_tap_post(tap, "fft.backward", x)
         return x
 
     def scale_factor(self) -> float:

@@ -31,6 +31,8 @@ from typing import Optional
 
 import jax
 
+from .. import guard
+from ..guard.errors import HangTimeoutError
 from ..resilience import faults
 from ..resilience.retry import RetryPolicy
 
@@ -91,8 +93,21 @@ def initialize(coordinator_address: Optional[str] = None,
         faults.fire("dist.initialize", coordinator=coordinator_address,
                     process_id=process_id)
         try:
-            jax.distributed.initialize(coordinator_address, num_processes,
-                                       process_id, **kw)
+            # each connect attempt runs under the guard's hang watchdog
+            # (no-op when PENCILARRAYS_TPU_GUARD is off): a wedged
+            # coordinator produces a crash bundle + typed
+            # HangTimeoutError instead of relying solely on jax's
+            # clamped internal timeout — and because HangTimeoutError
+            # is a TimeoutError, the retry policy backs off against it
+            # like any other transient rendezvous failure
+            with guard.watchdog("dist.initialize", kind="dist",
+                                coordinator=coordinator_address,
+                                process_id=process_id):
+                jax.distributed.initialize(coordinator_address,
+                                           num_processes, process_id, **kw)
+        except HangTimeoutError:
+            _reset_jax_partial_state()
+            raise
         except RuntimeError as e:
             # A failed connect leaves jax's global_state partially set
             # (client/service created before connect()), which would make
@@ -226,9 +241,13 @@ def local_devices():
 def sync_global_devices(name: str = "pa_barrier") -> None:
     """Cross-host barrier (``MPI.Barrier`` analog).  Consults the
     ``barrier`` fault point (before the single-process early-out, so
-    chaos tests can drill barrier failures on one process too)."""
+    chaos tests can drill barrier failures on one process too).  With
+    the integrity guard armed, the wait runs under the hang watchdog —
+    a peer that never arrives produces a crash bundle and a typed
+    ``HangTimeoutError`` instead of an unexplained stall."""
     faults.fire("barrier", name=name)
     if is_multiprocess():
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
+        with guard.watchdog(f"barrier:{name}", kind="barrier"):
+            multihost_utils.sync_global_devices(name)

@@ -59,8 +59,9 @@ from typing import Dict, Optional, Tuple
 
 import jax
 
-from .. import obs
+from .. import guard, obs
 from ..obs.drift import drift_tracker
+from ..resilience import faults
 from .arrays import PencilArray
 from .pencil import Pencil
 from .transpositions import (
@@ -365,11 +366,81 @@ def _compiled_route(pencils: Tuple[Pencil, ...],
     return jax.jit(chain, donate_argnums=(0,) if donate else ())
 
 
+@lru_cache(maxsize=256)
+def _compiled_guarded_route(pencils: Tuple[Pencil, ...],
+                            methods: Tuple[AbstractTransposeMethod, ...],
+                            extra_ndims: int, donate: bool = False,
+                            _pallas: bool = False, finite: bool = False,
+                            corrupt: bool = False):
+    """Guard-instrumented sibling of :func:`_compiled_route`: the SAME
+    fused chain with one invariant probe before the first hop and one
+    after EVERY hop, all inside the single jitted program — every hop
+    is pure data movement, so each post-probe must match the source
+    probe and the first mismatching index names the corrupted hop.
+    ``corrupt=True`` compiles the SDC drill variant (poke after the
+    first hop, counter-addressed traced index)."""
+    from ..guard import integrity as gi
+
+    hops = tuple((a, b, assert_compatible(a, b), m)
+                 for a, b, m in zip(pencils, pencils[1:], methods))
+
+    if corrupt:
+        def chain(data, poke_idx):
+            probes = [gi.probe_stats(data, finite)]
+            for k, (pin, pout, R, m) in enumerate(hops):
+                data = _apply_hop(data, pin, pout, R, m, extra_ndims)
+                if k == 0:
+                    data = gi.corrupt_block(data, poke_idx)
+                probes.append(gi.probe_stats(data, finite))
+            return data, probes
+    else:
+        def chain(data):
+            probes = [gi.probe_stats(data, finite)]
+            for pin, pout, R, m in hops:
+                data = _apply_hop(data, pin, pout, R, m, extra_ndims)
+                probes.append(gi.probe_stats(data, finite))
+            return data, probes
+
+    return jax.jit(chain, donate_argnums=(0,) if donate else ())
+
+
+def _execute_route_guarded(src: PencilArray, route: ReshardRoute,
+                           donate: bool, corrupt: bool) -> PencilArray:
+    """Guarded eager route dispatch: per-hop probes in the fused chain,
+    hang watchdog over the dispatch + probe fetch, typed
+    :class:`~pencilarrays_tpu.guard.IntegrityError` naming the first
+    corrupted hop."""
+    from ..guard import integrity as gi
+    from ..ops.pallas_kernels import pallas_enabled
+
+    finite = guard.finite_tick()
+    fn = _metered_cached(
+        _compiled_guarded_route, "route", route.pencils,
+        tuple(h.method for h in route.hops), src.ndims_extra, donate,
+        pallas_enabled(), finite, corrupt)
+    with guard.watchdog("route", kind="route", hops=len(route.hops)):
+        if corrupt:
+            out, probes = fn(
+                src.data, max(0, faults.hit_count("hop.exchange") - 1))
+        else:
+            out, probes = fn(src.data)
+        count = int(src.data.size)
+        for k, h in enumerate(route.hops):
+            gi.check_hop_probes(
+                f"route[{k}] {_hop_label(h.src, h.dest, h.method, src.dtype)}",
+                probes[0], probes[k + 1], count, src.dtype, finite=finite,
+                ctx={"hop_index": k, "hops": len(route.hops)})
+    return PencilArray(route.dest, out, src.extra_dims)
+
+
 def execute_route(src: PencilArray, route: ReshardRoute, *,
                   donate: bool = False) -> PencilArray:
     """Execute a planned route as its fused chain (one dispatch).
     ``donate=True`` donates the SOURCE buffer to the chain (``src``
-    becomes invalid); intermediates are compiler-owned either way."""
+    becomes invalid); intermediates are compiler-owned either way.
+    With the integrity guard armed (``PENCILARRAYS_TPU_GUARD``), eager
+    dispatches run the probe-instrumented chain instead — same data
+    movement, per-hop invariant checks, hang watchdog."""
     import jax.core
 
     from ..ops.pallas_kernels import pallas_enabled
@@ -379,12 +450,38 @@ def execute_route(src: PencilArray, route: ReshardRoute, *,
             f"array lives on {src.pencil!r}, route starts at {route.src!r}")
     if not route.hops:
         raise ValueError("route has no hops (planner fell back to Gspmd)")
-    donate = donate and not isinstance(src.data, jax.core.Tracer)
+    eager = not isinstance(src.data, jax.core.Tracer)
+    donate = donate and eager
+    # the SDC drill point fires for every eager routed dispatch, guard
+    # on or off — the hit counter must address the same dispatches
+    # either way ("the same spec replays the same failure")
+    act = None
+    if eager and faults.armed("hop.exchange"):
+        act = faults.fire("hop.exchange", kind="route",
+                          hops=len(route.hops))
+        if act == "torn":   # this site cannot tear: treat as kill
+            faults.kill_now()
+    if eager and guard.enabled():
+        guard.note_plan("reshard_route", {
+            "route": [list(h.dest.decomposition) for h in route.hops],
+            "methods": [_method_label(h.method) for h in route.hops],
+            "verdict": route.verdict,
+            "shape": list(route.src.size_global()),
+            "topo": list(route.src.topology.dims)})
+        return _execute_route_guarded(src, route, donate,
+                                      corrupt=act == "corrupt")
     fn = _metered_cached(
         _compiled_route, "route", route.pencils,
         tuple(h.method for h in route.hops), src.ndims_extra, donate,
         pallas_enabled())
-    return PencilArray(route.dest, fn(src.data), src.extra_dims)
+    out = fn(src.data)
+    if act == "corrupt":
+        # guard off: the poke flows through undetected (the silent
+        # garbage the guard exists to catch — pinned by tests)
+        from ..guard import integrity as gi
+
+        out = gi.corrupt_eager(out, faults.hit_count("hop.exchange") - 1)
+    return PencilArray(route.dest, out, src.extra_dims)
 
 
 # ---------------------------------------------------------------------------

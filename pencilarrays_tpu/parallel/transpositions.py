@@ -51,7 +51,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
-from .. import obs
+from .. import guard, obs
+from ..resilience import faults
 from ..utils.jaxcompat import shard_map
 from ..utils.timers import timeit
 from .arrays import PencilArray, _fwd_axes, _inv_axes
@@ -949,6 +950,26 @@ def _metered_cached(cache_fn, kind: str, *args):
     obs.counter(f"compile.cache_{label}", cache=kind).inc()
     return out
 
+def _hop_body(pin: Pencil, pout: Pencil, R: Optional[int],
+              extra_ndims: int, method: AbstractTransposeMethod):
+    """The traced data->data body of one hop — the ONE definition both
+    the plain and the guard-instrumented executables wrap, so enabling
+    the guard can never change the data movement itself."""
+    if R is None:
+        return lambda data: _transpose_local(data, pin, pout, extra_ndims)
+    if isinstance(method, AllToAll):
+        return lambda data: _transpose_all_to_all(data, pin, pout, R,
+                                                  extra_ndims)
+    if isinstance(method, Ring):
+        return lambda data: _transpose_ring(data, pin, pout, R, extra_ndims)
+    if isinstance(method, Pipelined):
+        return lambda data: _transpose_pipelined(data, pin, pout, R,
+                                                 extra_ndims, method)
+    if isinstance(method, Gspmd):
+        return lambda data: _reshard_gspmd(data, pin, pout, extra_ndims)
+    raise TypeError(f"unknown transpose method {method!r}")
+
+
 @lru_cache(maxsize=512)
 def _compiled_transpose(pin: Pencil, pout: Pencil, R: Optional[int],
                         extra_ndims: int,
@@ -966,20 +987,74 @@ def _compiled_transpose(pin: Pencil, pout: Pencil, R: Optional[int],
     its preallocated send/recv buffers across transposes
     (``Pencils.jl:151-192``), but for compiled executables.
     """
-    if R is None:
-        fn = lambda data: _transpose_local(data, pin, pout, extra_ndims)
-    elif isinstance(method, AllToAll):
-        fn = lambda data: _transpose_all_to_all(data, pin, pout, R, extra_ndims)
-    elif isinstance(method, Ring):
-        fn = lambda data: _transpose_ring(data, pin, pout, R, extra_ndims)
-    elif isinstance(method, Pipelined):
-        fn = lambda data: _transpose_pipelined(data, pin, pout, R,
-                                               extra_ndims, method)
-    elif isinstance(method, Gspmd):
-        fn = lambda data: _reshard_gspmd(data, pin, pout, extra_ndims)
-    else:
-        raise TypeError(f"unknown transpose method {method!r}")
+    fn = _hop_body(pin, pout, R, extra_ndims, method)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+@lru_cache(maxsize=512)
+def _compiled_guarded_transpose(pin: Pencil, pout: Pencil, R: Optional[int],
+                                extra_ndims: int,
+                                method: AbstractTransposeMethod,
+                                donate: bool = False, _pallas: bool = False,
+                                finite: bool = False, corrupt: bool = False):
+    """Probe-instrumented sibling of :func:`_compiled_transpose`: the
+    SAME hop body bracketed by the guard's invariant probes
+    (``guard/integrity.py``) **inside one jitted program** — no extra
+    dispatch, no host copy; the probes are two small reductions XLA
+    schedules around the exchange.  ``corrupt=True`` compiles the SDC
+    drill variant, which pokes the hop output (counter-addressed, the
+    index is a traced arg) between the exchange and the post probe —
+    exactly where a flipped wire bit would land."""
+    from ..guard import integrity as gi
+
+    core = _hop_body(pin, pout, R, extra_ndims, method)
+
+    if corrupt:
+        def fn(data, poke_idx):
+            pre = gi.probe_stats(data, finite)
+            out = gi.corrupt_block(core(data), poke_idx)
+            return out, pre, gi.probe_stats(out, finite)
+    else:
+        def fn(data):
+            pre = gi.probe_stats(data, finite)
+            out = core(data)
+            return out, pre, gi.probe_stats(out, finite)
+
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def _dispatch_guarded_hop(pin: Pencil, pout: Pencil, R: Optional[int],
+                          extra_ndims: int,
+                          method: AbstractTransposeMethod, data,
+                          donate: bool, dtype,
+                          corrupt_hit: Optional[int] = None,
+                          label: Optional[str] = None):
+    """Dispatch one eager hop through the guard: probe-instrumented
+    executable, hang watchdog around the dispatch + probe fetch, and
+    the host-side invariant check (raising
+    :class:`~pencilarrays_tpu.guard.IntegrityError` on mismatch —
+    typed error, never garbage)."""
+    from ..guard import integrity as gi
+    from ..ops.pallas_kernels import pallas_enabled
+
+    finite = guard.finite_tick()
+    fn = _metered_cached(_compiled_guarded_transpose, "hop", pin, pout, R,
+                         extra_ndims, method, donate, pallas_enabled(),
+                         finite, corrupt_hit is not None)
+    hop = label or _hop_label(pin, pout, method, dtype)
+    count = int(data.size)
+    with guard.watchdog(f"hop:{_method_label(method)}", kind="hop",
+                        hop=hop):
+        if corrupt_hit is not None:
+            out, pre, post = fn(data, max(0, corrupt_hit - 1))
+        else:
+            out, pre, post = fn(data)
+        # the probe fetch inside check_hop_probes blocks until the
+        # device program completes — a hung collective parks THERE,
+        # under the armed deadline
+        gi.check_hop_probes(hop, pre, post, count, dtype, finite=finite,
+                            ctx={"r": R, "method": _method_label(method)})
+    return out
 
 
 @lru_cache(maxsize=512)
@@ -1015,22 +1090,47 @@ def transpose(src: PencilArray, dest: Pencil, *,
     import jax.core
 
     with timeit(pin.timer, "transpose!"):
-        fn = _metered_cached(_compiled_transpose, "hop", pin, dest, R,
-                             src.ndims_extra, method, donate,
-                             pallas_enabled())
+        eager = not isinstance(src.data, jax.core.Tracer)
+        # the SDC drill point: eager dispatches only (a traced hop is
+        # one compile, not an exchange), gated on armed() so the
+        # no-faults hot path pays one cached env probe
+        act = None
+        if eager and faults.armed("hop.exchange"):
+            act = faults.fire("hop.exchange", r=R,
+                              method=_method_label(method))
+            if act == "torn":   # this site cannot tear: treat as kill
+                faults.kill_now()
         # the hop tap observes EAGER dispatches only: under an outer
         # jit this call runs at trace time (once per compile), where a
         # "duration" would be lowering time, not a dispatch — it must
         # neither flood the journal per compile nor poison the drift
         # fit (use obs.profile for device-side visibility of jitted
         # programs)
-        if obs.enabled() and not isinstance(src.data, jax.core.Tracer):
-            t0 = time.perf_counter()
+        t0 = time.perf_counter() if (obs.enabled() and eager) else None
+        if eager and guard.enabled():
+            # guarded path: probes ride the SAME program; a corrupt
+            # drill rides between exchange and post-probe
+            out = _dispatch_guarded_hop(
+                pin, dest, R, src.ndims_extra, method, src.data, donate,
+                src.dtype,
+                corrupt_hit=(faults.hit_count("hop.exchange")
+                             if act == "corrupt" else None))
+        else:
+            fn = _metered_cached(_compiled_transpose, "hop", pin, dest, R,
+                                 src.ndims_extra, method, donate,
+                                 pallas_enabled())
             out = fn(src.data)
+            if act == "corrupt":
+                # guard off: the poke flows through UNDETECTED — the
+                # silent garbage the guard exists to catch (chaos tests
+                # pin both behaviors)
+                from ..guard import integrity as gi
+
+                out = gi.corrupt_eager(
+                    out, faults.hit_count("hop.exchange") - 1)
+        if t0 is not None:
             _obs_record_hop(pin, dest, R, method, src.extra_dims,
                             src.dtype, time.perf_counter() - t0)
-        else:
-            out = fn(src.data)
     return PencilArray(dest, out, src.extra_dims)
 
 
@@ -1082,9 +1182,29 @@ def reshard(src: PencilArray, dest: Pencil, *,
             return execute_route(src, route, donate=don)
     elif obs.enabled() and eager:
         obs.counter("reshard.dispatches", path="gspmd").inc()
+    # the GSPMD fallback is pure data movement too: with the guard
+    # armed, eager dispatches run probe-instrumented (same invariant,
+    # same watchdog) — and the SDC drill point covers this path
+    act = None
+    if eager and faults.armed("hop.exchange"):
+        act = faults.fire("hop.exchange", kind="reshard-gspmd")
+        if act == "torn":
+            faults.kill_now()
+    if eager and guard.enabled():
+        out = _dispatch_guarded_hop(
+            pin, dest, "gspmd", src.ndims_extra, Gspmd(), src.data, don,
+            src.dtype,
+            corrupt_hit=(faults.hit_count("hop.exchange")
+                         if act == "corrupt" else None))
+        return PencilArray(dest, out, src.extra_dims)
     fn = _metered_cached(_compiled_reshard, "reshard", pin, dest,
                          src.ndims_extra, don)
-    return PencilArray(dest, fn(src.data), src.extra_dims)
+    out = fn(src.data)
+    if act == "corrupt":
+        from ..guard import integrity as gi
+
+        out = gi.corrupt_eager(out, faults.hit_count("hop.exchange") - 1)
+    return PencilArray(dest, out, src.extra_dims)
 
 
 class Transposition:

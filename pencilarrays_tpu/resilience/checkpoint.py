@@ -638,6 +638,24 @@ class Checkpoint:
             with open_file(self.manager.driver, data_path, read=True,
                            retry=self.manager.retry) as f:
                 out = f.read(name, pencil, extra_dims)
+            if faults.armed("ckpt.restore"):
+                # the post-read SDC drill: data verified on disk, then
+                # corrupted in flight — what the detect-and-recover
+                # ladder (guard.guarded_step) and downstream invariant
+                # probes exist to catch
+                act = faults.fire("ckpt.restore", step=self.step,
+                                  dataset=name)
+                if act == "torn":   # cannot tear a read: treat as kill
+                    faults.kill_now()
+                if act == "corrupt":
+                    from ..guard import integrity as _gi
+
+                    out = type(out)(
+                        out.pencil,
+                        _gi.corrupt_eager(
+                            out.data,
+                            faults.hit_count("ckpt.restore") - 1),
+                        out.extra_dims)
         if t0 is not None:
             dt = time.perf_counter() - t0
             obs.counter("ckpt.restores").inc()

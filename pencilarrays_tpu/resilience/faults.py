@@ -16,9 +16,14 @@ Registered points (see ``docs/Resilience.md``):
                           driver-level write)
 ``ckpt.commit``           the checkpoint manager about to commit (rename +
                           COMMIT marker)
+``ckpt.restore``          a dataset just restored from a checkpoint
+                          (``corrupt`` pokes the restored array)
 ``dist.initialize``       the coordinator connection inside
                           ``distributed.initialize``
 ``barrier``               ``sync_global_devices`` (ctx carries the name)
+``hop.exchange``          an eager transpose / routed-reshard dispatch
+                          (``corrupt`` pokes the hop's output — the SDC
+                          drill the ``guard`` probes must catch)
 ========================  ====================================================
 
 Rules are **counter-based, never random** — the same spec replays the
@@ -28,9 +33,14 @@ same failure.  Spec grammar (comma/semicolon-separated)::
 
 * ``mode`` — ``error`` (raise :class:`InjectedFault`), ``kill``
   (``SIGKILL`` this process: the un-catchable crash), ``torn``
-  (cooperative: the call site writes a partial block, then dies).
+  (cooperative: the call site writes a partial block, then dies),
+  ``corrupt`` (cooperative: the call site applies the deterministic
+  counter-addressed bitflip/NaN poke of
+  ``guard.integrity.corrupt_block`` — silent data corruption on
+  demand, so chaos tests can assert typed-error-or-bit-identical,
+  never garbage).
 * ``*times`` — trigger on that many consecutive hits (default: ``error``
-  forever, ``kill``/``torn`` once).
+  and ``corrupt`` forever, ``kill``/``torn`` once).
 * ``@nth`` — first trigger on the *nth* hit of the point (1-based,
   default 1): ``io.write_block:torn@3`` tears the third block.
 
@@ -62,6 +72,7 @@ __all__ = [
     "active",
     "armed",
     "fire",
+    "hit_count",
     "block_write_hook",
     "kill_now",
     "ENV_VAR",
@@ -74,11 +85,13 @@ POINTS = frozenset({
     "io.write_block",
     "io.flush_meta",
     "ckpt.commit",
+    "ckpt.restore",
     "dist.initialize",
     "barrier",
+    "hop.exchange",
 })
 
-MODES = frozenset({"error", "kill", "torn"})
+MODES = frozenset({"error", "kill", "torn", "corrupt"})
 
 
 @dataclass(frozen=True)
@@ -158,6 +171,12 @@ def reset_counters() -> None:
     _hits.clear()
 
 
+def hit_count(point: str) -> int:
+    """Hits recorded so far at ``point`` (the counter ``corrupt`` call
+    sites use to address the deterministic poke)."""
+    return _hits.get(point, 0)
+
+
 @contextmanager
 def active(spec):
     """Scope rules to a ``with`` block (the test-friendly entry point)."""
@@ -219,10 +238,11 @@ def block_write_hook(i, start, block, block_observer, put, *,
 def fire(point: str, **ctx) -> Optional[str]:
     """Consult the injection point.  Returns ``None`` (the overwhelmingly
     common no-fault case), raises :class:`InjectedFault` (``error``),
-    never returns (``kill``), or returns ``"torn"`` — a cooperative mode
-    the call site honors by writing a partial block and then calling
-    :func:`kill_now`.  Sites that cannot tear treat ``"torn"`` as
-    ``kill``."""
+    never returns (``kill``), or returns a cooperative mode string the
+    call site honors: ``"torn"`` (write a partial block, then call
+    :func:`kill_now`; sites that cannot tear treat it as ``kill``) or
+    ``"corrupt"`` (apply the deterministic counter-addressed poke —
+    ``guard.integrity.corrupt_block`` — to the point's payload)."""
     rules = _current_rules()
     if not rules:
         return None
@@ -237,8 +257,8 @@ def fire(point: str, **ctx) -> Optional[str]:
         _obs_firing(point, r.mode, hit, ctx)
         if r.mode == "kill":
             kill_now()
-        if r.mode == "torn":
-            return "torn"
+        if r.mode in ("torn", "corrupt"):
+            return r.mode
         where = f" [{ctx}]" if ctx else ""
         raise InjectedFault(
             f"injected fault at {point} (hit {hit}){where}",

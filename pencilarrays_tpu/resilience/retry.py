@@ -101,6 +101,13 @@ class RetryPolicy:
             return isinstance(e, self.retry_on)
         return is_transient(e)
 
+    def delay_for(self, attempt: int) -> float:
+        """Jittered backoff delay before the retry following ``attempt``
+        (1-based) — THE one definition of the backoff curve, shared
+        with external retry loops (e.g. ``guard.guarded_step``)."""
+        delay = min(self.base_delay * 2 ** (attempt - 1), self.max_delay)
+        return delay * (1 + self.jitter * (2 * random.random() - 1))
+
     def call(self, fn: Callable, *args, label: str = "operation",
              timer=None, **kw):
         """Run ``fn(*args, **kw)`` under this policy.  Non-retryable
@@ -119,9 +126,7 @@ class RetryPolicy:
             except BaseException as e:
                 if not self._retryable(e) or attempt >= self.max_attempts:
                     raise
-                delay = min(self.base_delay * 2 ** (attempt - 1),
-                            self.max_delay)
-                delay *= 1 + self.jitter * (2 * random.random() - 1)
+                delay = self.delay_for(attempt)
                 elapsed = time.monotonic() - start
                 if elapsed + delay > self.deadline:
                     raise RetryDeadlineExceeded(

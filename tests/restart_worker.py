@@ -23,7 +23,13 @@ Phases (launched by ``test_multiprocess.py``):
   itself mid-write (the launcher asserts the signal death);
 * ``recover``: assert ``latest_valid()`` skips the torn step-2 temp
   wreckage, restores step 1, and the recovered global array is
-  bit-identical to the deterministic ground truth.
+  bit-identical to the deterministic ground truth.  The single-process
+  variant additionally runs the guard's detect-and-recover ladder
+  (``guard.guarded_step`` + a deterministic ``hop.exchange:corrupt``
+  drill): corrupted exchanges are detected as typed ``IntegrityError``,
+  retries exhaust, the last committed checkpoint restores the state and
+  the re-run step is bit-identical — journaled as ``guard.recover``
+  events the launcher asserts.
 
 Every phase checks gathered global arrays bit-for-bit against the
 ground truth regenerated from the shared seed.
@@ -124,6 +130,34 @@ def main():
         back = mgr.restore().read("u", pen)
         assert np.array_equal(pa.gather(back), truth), \
             "recovered checkpoint is not bit-identical to ground truth"
+        if nprocs == 1:
+            # the detect-and-recover ladder, end to end: in-memory state
+            # diverged (as after a crash), the first two step attempts
+            # hit injected exchange corruption (typed IntegrityError,
+            # never garbage), escalation restores the committed step 1
+            # and the re-run step is bit-identical — the full
+            # guard.recover timeline lands in the same obs journal the
+            # launcher lints
+            from pencilarrays_tpu import guard
+            from pencilarrays_tpu.resilience import RetryPolicy, faults
+
+            guard.enable(os.path.join(tmpdir, "bundles"))
+            pen2 = pa.Pencil(topo, shape, (0,))
+            state = {"u": pa.PencilArray.from_global(pen, truth + 1000.0)}
+
+            def step():
+                return pa.transpose(state["u"], pen2)
+
+            def restore_cb(ckpt):
+                state["u"] = ckpt.read("u", pen)
+
+            with faults.active("hop.exchange:corrupt*2"):
+                out = guard.guarded_step(
+                    step, ckpt_mgr=mgr, restore=restore_cb,
+                    retry=RetryPolicy(max_attempts=2, base_delay=0.01),
+                    label="restart-recover")
+            assert np.array_equal(pa.gather(out), truth), \
+                "guarded_step recovery is not bit-identical"
         if nprocs > 1:
             pa.distributed.sync_global_devices("recover_done")
     else:

@@ -1,0 +1,609 @@
+"""Runtime integrity guard: SDC probes, watchdog, detect-and-recover.
+
+The contracts under test (ISSUE 5 acceptance):
+
+* with ``PENCILARRAYS_TPU_GUARD`` unset, hop/reshard dispatch routes
+  through the UNMODIFIED pre-guard executables and the hop jaxpr
+  carries no probe ops (byte-identical disabled path, test-pinned);
+* with it on, the invariant probes ride the SAME jitted program as the
+  exchange (jaxpr-pinned: probe reductions and the collective appear in
+  one jaxpr; exactly one executable call per hop) and the hop output is
+  bit-identical to the unguarded path;
+* a fault-injected corrupted exchange (``hop.exchange:corrupt``) raises
+  typed ``IntegrityError`` + journals ``guard.sdc`` + writes a readable
+  crash bundle — across AllToAll / Ring / Pipelined and routed
+  reshards — while the SAME drill with the guard off flows through as
+  silent garbage (the failure mode the guard exists for);
+* the watchdog fires on an artificially-held lock: crash bundle written
+  by the monitor thread, typed ``HangTimeoutError`` raised;
+* ``guarded_step`` retries on ``IntegrityError`` and escalates to a
+  ``CheckpointManager.latest_valid()`` restore, recovering
+  bit-identically, with the full timeline journaled.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu import guard, obs
+from pencilarrays_tpu.guard import HangTimeoutError, IntegrityError
+from pencilarrays_tpu.guard import integrity as gi
+from pencilarrays_tpu.obs import events as obs_events
+from pencilarrays_tpu.obs import metrics as obs_metrics
+from pencilarrays_tpu.parallel import transpositions as tr
+from pencilarrays_tpu.resilience import CheckpointManager, RetryPolicy, faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard(monkeypatch):
+    """Every test starts with guard + obs disabled and faults cleared."""
+    for var in (guard.ENV_VAR, guard.DIR_VAR, guard.TIMEOUT_VAR,
+                guard.RTOL_VAR, guard.FINITE_VAR, obs.ENV_VAR,
+                faults.ENV_VAR):
+        monkeypatch.delenv(var, raising=False)
+    guard._reset_for_tests()
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    yield
+    guard._reset_for_tests()
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+
+
+def _load_json(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _read_text(path):
+    with open(path) as f:
+        return f.read()
+
+
+def _mk(shape=(11, 9, 13), dims=(2, 4), seed=0):
+    topo = pa.Topology(dims)
+    pen_x = pa.Pencil(topo, shape, (1, 2))
+    pen_y = pa.Pencil(topo, shape, (0, 2))
+    truth = np.random.default_rng(seed).standard_normal(shape)
+    return pen_x, pen_y, truth, pa.PencilArray.from_global(pen_x, truth)
+
+
+# ---------------------------------------------------------------------------
+# disabled path: byte-identical executables, no probe ops
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_path_uses_unguarded_executable(monkeypatch):
+    """Guard off: transpose() must route through the untouched
+    ``_compiled_transpose`` (the pre-guard executable) and never build a
+    guarded one."""
+    assert not guard.enabled()
+    pen_x, pen_y, truth, u = _mk()
+    calls = []
+    orig = tr._dispatch_guarded_hop
+    monkeypatch.setattr(tr, "_dispatch_guarded_hop",
+                        lambda *a, **k: calls.append(1) or orig(*a, **k))
+    out = pa.transpose(u, pen_y)
+    assert calls == []
+    assert np.array_equal(pa.gather(out), truth)
+
+
+def test_disabled_hop_jaxpr_has_no_probe_ops():
+    """The jaxpr of the guard-off hop is the raw hop body — no reduce
+    ops beyond what the exchange itself needs — while the guarded
+    program contains the probe reductions IN THE SAME jaxpr as the
+    collective (no extra dispatch)."""
+    import jax
+
+    pen_x, pen_y, _, u = _mk(shape=(8, 8, 8))
+    R = tr.assert_compatible(pen_x, pen_y)
+    plain = tr._hop_body(pen_x, pen_y, R, 0, tr.AllToAll())
+    jp_plain = str(jax.make_jaxpr(plain)(u.data))
+    assert "all_to_all" in jp_plain
+    # the plain hop body is pure movement: no probe-style reductions
+    assert "reduce_sum" not in jp_plain
+
+    from pencilarrays_tpu.guard import integrity as _gi
+
+    def guarded(data):
+        pre = _gi.probe_stats(data)
+        out = plain(data)
+        return out, pre, _gi.probe_stats(out)
+
+    jp_guarded = str(jax.make_jaxpr(guarded)(u.data))
+    assert "all_to_all" in jp_guarded      # same program...
+    assert "reduce_sum" in jp_guarded      # ...with the probes riding it
+
+
+def test_gate_re_read_on_change(monkeypatch, tmp_path):
+    """Workers arm the guard after import (the faults.py contract)."""
+    assert not guard.enabled()
+    monkeypatch.setenv(guard.ENV_VAR, str(tmp_path / "b"))
+    assert guard.enabled()
+    assert guard.bundle_dir() == str(tmp_path / "b")
+    monkeypatch.setenv(guard.ENV_VAR, "0")
+    assert not guard.enabled()
+
+
+# ---------------------------------------------------------------------------
+# guarded path: bit-identity, single program
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("method", [tr.AllToAll(), tr.Ring(),
+                                    tr.Pipelined(chunks=2)])
+def test_guarded_hop_bit_identical(method, tmp_path):
+    pen_x, pen_y, truth, u = _mk()
+    base = np.asarray(pa.gather(pa.transpose(u, pen_y, method=method)))
+    guard.enable(str(tmp_path / "bundles"))
+    out = pa.transpose(u, pen_y, method=method)
+    assert np.array_equal(np.asarray(pa.gather(out)), base)
+    assert np.array_equal(base, truth)
+
+
+def test_guarded_hop_single_dispatch(monkeypatch, tmp_path):
+    """Probes ride the hop's own program: exactly one guarded
+    executable call per transpose, zero plain-executable calls."""
+    pen_x, pen_y, truth, u = _mk()
+    guard.enable(str(tmp_path / "bundles"))
+    guarded_calls, plain_calls = [], []
+    orig_g = tr._compiled_guarded_transpose
+    orig_p = tr._compiled_transpose
+
+    def spy_g(*a, **k):
+        fn = orig_g(*a, **k)
+        return lambda *d: guarded_calls.append(1) or fn(*d)
+
+    def spy_p(*a, **k):
+        fn = orig_p(*a, **k)
+        return lambda *d: plain_calls.append(1) or fn(*d)
+
+    monkeypatch.setattr(tr, "_compiled_guarded_transpose", spy_g)
+    monkeypatch.setattr(tr, "_compiled_transpose", spy_p)
+    pa.transpose(u, pen_y)
+    assert guarded_calls == [1]
+    assert plain_calls == []
+
+
+def test_guarded_exact_dtype_bit_for_bit(tmp_path):
+    """Integer hops compare EXACTLY (wrapping sums are
+    order-independent), so the guard tolerates zero deviation."""
+    pen_x, pen_y, _, _ = _mk()
+    rng = np.random.default_rng(3)
+    vals = rng.integers(-2 ** 30, 2 ** 30, size=(11, 9, 13),
+                        dtype=np.int32)
+    u = pa.PencilArray.from_global(pen_x, vals)
+    guard.enable(str(tmp_path / "bundles"))
+    out = pa.transpose(u, pen_y)
+    assert np.array_equal(np.asarray(pa.gather(out)), vals)
+
+
+def test_guarded_passes_nan_through(tmp_path):
+    """NaN already in the INPUT is data, not corruption: the probe pair
+    matches (NaN on both sides) and the hop completes."""
+    pen_x, pen_y, truth, _ = _mk()
+    vals = truth.copy()
+    vals[0, 0, 0] = np.nan
+    u = pa.PencilArray.from_global(pen_x, vals)
+    guard.enable(str(tmp_path / "bundles"))
+    out = np.asarray(pa.gather(pa.transpose(u, pen_y)))
+    assert np.isnan(out[0, 0, 0]) and np.array_equal(
+        out[1:], vals[1:], equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# SDC drills: corrupt injection -> typed error (guarded) / garbage (not)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("method", [tr.AllToAll(), tr.Ring(),
+                                    tr.Pipelined(chunks=2)])
+def test_corrupt_exchange_raises_typed_error(method, tmp_path):
+    pen_x, pen_y, truth, u = _mk()
+    guard.enable(str(tmp_path / "bundles"))
+    with faults.active("hop.exchange:corrupt"):
+        with pytest.raises(IntegrityError) as ei:
+            pa.transpose(u, pen_y, method=method)
+    e = ei.value
+    assert e.kind == "sum" and e.hop and e.predicted and e.observed
+    # the crash bundle is readable: MANIFEST.json marks completeness
+    assert e.bundle and os.path.isdir(e.bundle)
+    mf = _load_json(os.path.join(e.bundle, "MANIFEST.json"))
+    assert mf["reason"] == "sdc"
+    assert os.path.exists(os.path.join(e.bundle, "stacks.txt"))
+    _load_json(os.path.join(e.bundle, "metrics.json"))
+
+
+@pytest.mark.chaos
+def test_corrupt_exchange_unguarded_is_silent_garbage(tmp_path):
+    """The motivation, pinned: the SAME drill with the guard off flows
+    through undetected — wrong data, no error."""
+    pen_x, pen_y, truth, u = _mk()
+    assert not guard.enabled()
+    with faults.active("hop.exchange:corrupt"):
+        out = np.asarray(pa.gather(pa.transpose(u, pen_y)))
+    assert not np.array_equal(out, truth)
+    assert np.isnan(out).any()
+
+
+@pytest.mark.chaos
+def test_corrupt_counter_addressing(tmp_path):
+    """``@nth`` addresses the nth DISPATCH: hop 1 clean, hop 2
+    corrupted — deterministic replay, the faults.py contract."""
+    pen_x, pen_y, truth, u = _mk()
+    guard.enable(str(tmp_path / "bundles"))
+    with faults.active("hop.exchange:corrupt@2"):
+        out1 = pa.transpose(u, pen_y)           # hit 1: clean
+        assert np.array_equal(np.asarray(pa.gather(out1)), truth)
+        with pytest.raises(IntegrityError):
+            pa.transpose(u, pen_y)              # hit 2: corrupted
+
+
+@pytest.mark.chaos
+def test_corrupt_routed_reshard_raises_typed_error(tmp_path):
+    """Multi-slot reshard (the routed chain, or its GSPMD fallback) is
+    probed per hop: injected corruption surfaces as IntegrityError
+    naming the poisoned hop, clean runs stay bit-identical."""
+    topo = pa.Topology((2, 4))
+    shape = (12, 16, 8)
+    src = pa.Pencil(topo, shape, (1, 2))
+    dst = pa.Pencil(topo, shape, (2, 0))
+    truth = np.random.default_rng(5).standard_normal(shape)
+    u = pa.PencilArray.from_global(src, truth)
+    base = np.asarray(pa.gather(pa.reshard(u, dst)))
+    assert np.array_equal(base, truth)
+    guard.enable(str(tmp_path / "bundles"))
+    out = pa.reshard(u, dst)
+    assert np.array_equal(np.asarray(pa.gather(out)), truth)
+    with faults.active("hop.exchange:corrupt"):
+        with pytest.raises(IntegrityError) as ei:
+            pa.reshard(u, dst)
+    assert ei.value.kind == "sum"
+
+
+@pytest.mark.chaos
+def test_corrupt_local_permute_hop_raises_typed_error(tmp_path):
+    """A local (R=None) hop — same decomposition, different memory
+    order — is pure movement too: with the guard on, the corrupt drill
+    must be a typed error there as well, never garbage."""
+    topo = pa.Topology((2, 4))
+    shape = (11, 9, 13)
+    pen_a = pa.Pencil(topo, shape, (1, 2))
+    pen_b = pa.Pencil(topo, shape, (1, 2),
+                      permutation=pa.Permutation(2, 0, 1))
+    truth = np.random.default_rng(9).standard_normal(shape)
+    u = pa.PencilArray.from_global(pen_a, truth)
+    guard.enable(str(tmp_path / "bundles"))
+    out = pa.transpose(u, pen_b)    # clean local permute passes
+    assert np.array_equal(np.asarray(pa.gather(out)), truth)
+    with faults.active("hop.exchange:corrupt"):
+        with pytest.raises(IntegrityError):
+            pa.transpose(u, pen_b)
+
+
+@pytest.mark.chaos
+def test_corrupt_reshard_fires_same_counter_guard_on_or_off(tmp_path):
+    """The hop.exchange hit counter must address the same routed
+    dispatches whether the guard is armed or not (deterministic
+    replay): guard off -> silent garbage on the SAME dispatch the
+    guarded run detects."""
+    topo = pa.Topology((2, 4))
+    shape = (12, 16, 8)
+    src = pa.Pencil(topo, shape, (1, 2))
+    dst = pa.Pencil(topo, shape, (2, 0))
+    truth = np.random.default_rng(5).standard_normal(shape)
+    u = pa.PencilArray.from_global(src, truth)
+    assert not guard.enabled()
+    with faults.active("hop.exchange:corrupt@1"):
+        bad = np.asarray(pa.gather(pa.reshard(u, dst)))
+    assert not np.array_equal(bad, truth) and np.isnan(bad).any()
+
+
+def test_corrupt_block_deterministic():
+    """The poke itself: counter-addressed, NaN for floats, sign-bit
+    flip for ints, same index -> same result."""
+    import jax.numpy as jnp
+
+    x = jnp.arange(24, dtype=jnp.float32).reshape(4, 6)
+    a = np.asarray(gi.corrupt_eager(x, 7))
+    b = np.asarray(gi.corrupt_eager(x, 7))
+    assert np.array_equal(a, b, equal_nan=True)
+    assert np.isnan(a.ravel()[7]) and np.isfinite(np.delete(a.ravel(), 7)).all()
+    xi = jnp.arange(24, dtype=jnp.int32).reshape(4, 6)
+    ai = np.asarray(gi.corrupt_eager(xi, 3))
+    assert ai.ravel()[3] != 3 and (np.delete(ai.ravel(), 3)
+                                   == np.delete(np.arange(24), 3)).all()
+
+
+def test_corrupt_mode_parse():
+    (r,) = faults.parse("hop.exchange:corrupt@2")
+    assert r.mode == "corrupt" and r.first == 2 and r.times is None
+    (r2,) = faults.parse("ckpt.restore:corrupt*3")
+    assert r2.times == 3
+    with pytest.raises(ValueError):
+        faults.parse("hop.exchange:explode")
+
+
+@pytest.mark.chaos
+def test_ckpt_restore_corrupt_drill(tmp_path):
+    """The ``ckpt.restore`` corrupt point pokes the restored dataset
+    deterministically (post-verification in-flight corruption): the
+    restored array differs from the committed truth at exactly the
+    addressed element."""
+    pen = pa.Pencil(pa.Topology((8,)), (11, 9, 13), (1,))
+    truth = np.random.default_rng(7).standard_normal((11, 9, 13))
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    mgr.save(1, {"u": pa.PencilArray.from_global(pen, truth)})
+    clean = np.asarray(pa.gather(mgr.restore().read("u", pen)))
+    assert np.array_equal(clean, truth)
+    with faults.active("ckpt.restore:corrupt"):
+        poked = np.asarray(pa.gather(mgr.restore().read("u", pen)))
+    assert not np.array_equal(poked, truth)
+    assert np.isnan(poked).sum() == 1
+
+
+# ---------------------------------------------------------------------------
+# finiteness tap
+# ---------------------------------------------------------------------------
+
+
+def test_finite_tap_catches_nonfinite_birth(monkeypatch, tmp_path):
+    """The "NaN born mid-FFT" detector: finite input, nonfinite output
+    of a transform boundary -> typed IntegrityError (here driven by an
+    honest f32 overflow: the DC term of an FFT of huge values)."""
+    import jax.numpy as jnp
+
+    import jax
+
+    monkeypatch.setenv(guard.FINITE_VAR, "1")   # sample every dispatch
+    topo = pa.Topology((1,), devices=jax.devices()[:1])
+    plan = pa.PencilFFTPlan(topo, (16, 16, 16), real=True,
+                            dtype=jnp.float32)
+    u = plan.allocate_input()
+    big = pa.PencilArray(u.pencil,
+                         jnp.full(u.data.shape, 1e37, jnp.float32))
+    guard.enable(str(tmp_path / "bundles"))
+    with pytest.raises(IntegrityError) as ei:
+        plan.forward(big)
+    assert ei.value.kind == "nonfinite"
+    # finite input passes untouched
+    ok = pa.PencilArray(u.pencil, jnp.ones(u.data.shape, jnp.float32))
+    plan.forward(ok)
+
+
+def test_finite_tap_sampling_counter(monkeypatch):
+    monkeypatch.setenv(guard.FINITE_VAR, "3")
+    ticks = [guard.finite_tick() for _ in range(6)]
+    assert ticks == [False, False, True, False, False, True]
+    monkeypatch.delenv(guard.FINITE_VAR)
+    assert guard.finite_tick() is False
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_watchdog_fires_on_held_lock(tmp_path):
+    """The deliberately-wedged 'collective': a lock that is never
+    released.  The monitor fires at the deadline, writes a complete
+    bundle WHILE the section is still stuck, then unblocks us with the
+    typed error carrying the bundle path."""
+    guard.enable(str(tmp_path / "bundles"))
+    held = threading.Lock()
+    held.acquire()
+    with pytest.raises(HangTimeoutError) as ei:
+        with guard.watchdog("test-hold", timeout=0.4, kind="test"):
+            held.acquire()
+    e = ei.value
+    assert e.label == "test-hold" and e.timeout_s == pytest.approx(0.4)
+    assert e.bundle and os.path.isdir(e.bundle)
+    mf = _load_json(os.path.join(e.bundle, "MANIFEST.json"))
+    assert mf["reason"] == "hang" and mf["label"] == "test-hold"
+    assert mf["artifacts"]["stacks"] == "ok"
+    stacks = _read_text(os.path.join(e.bundle, "stacks.txt"))
+    assert "test_watchdog_fires_on_held_lock" in stacks
+    _load_json(os.path.join(e.bundle, "metrics.json"))
+    from pencilarrays_tpu.guard.watchdog import active_count
+
+    assert active_count() == 0
+
+
+def test_watchdog_noop_when_disabled():
+    assert not guard.enabled()
+    held = threading.Lock()
+    with guard.watchdog("never-armed", timeout=0.05):
+        import time
+
+        time.sleep(0.15)   # would have fired if armed
+    from pencilarrays_tpu.guard.watchdog import active_count
+
+    assert active_count() == 0
+
+
+def test_watchdog_completes_under_deadline(tmp_path):
+    guard.enable(str(tmp_path / "bundles"))
+    with guard.watchdog("fast", timeout=30.0):
+        x = sum(range(100))
+    assert x == 4950
+    assert not os.path.exists(str(tmp_path / "bundles"))
+
+
+@pytest.mark.chaos
+def test_watchdog_wraps_distributed_initialize(tmp_path, monkeypatch):
+    """A wedged coordinator: connect blocks past the deadline -> crash
+    bundle + typed HangTimeoutError (surfaced through the retry policy
+    as the attempt's failure)."""
+    import time
+
+    import jax
+
+    from pencilarrays_tpu.parallel import distributed
+    from pencilarrays_tpu.resilience.errors import RetryDeadlineExceeded
+
+    assert not distributed.is_initialized()
+    guard.enable(str(tmp_path / "bundles"))
+    monkeypatch.setenv(guard.TIMEOUT_VAR, "0.4")
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda *a, **k: time.sleep(30))
+    with pytest.raises((HangTimeoutError, RetryDeadlineExceeded)):
+        distributed.initialize(
+            "127.0.0.1:1", 2, 0,
+            retry=RetryPolicy(max_attempts=1, deadline=5.0))
+    assert not distributed.is_initialized()
+    bundles = os.listdir(str(tmp_path / "bundles"))
+    assert len(bundles) == 1
+
+
+# ---------------------------------------------------------------------------
+# guarded_step: detect-and-recover
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_guarded_step_retries_then_succeeds(tmp_path):
+    """Transient corruption: retry alone recovers (no checkpoint
+    needed), result bit-identical."""
+    pen_x, pen_y, truth, u = _mk()
+    guard.enable(str(tmp_path / "bundles"))
+    with faults.active("hop.exchange:corrupt*1"):
+        out = guard.guarded_step(
+            lambda: pa.transpose(u, pen_y),
+            retry=RetryPolicy(max_attempts=3, base_delay=0.01),
+            label="retry-drill")
+    assert np.array_equal(np.asarray(pa.gather(out)), truth)
+
+
+@pytest.mark.chaos
+def test_guarded_step_escalates_to_checkpoint_restore(tmp_path):
+    """Attempts exhausted -> restore from the last committed checkpoint
+    -> bit-identical result; the journal carries the full
+    error/retry/restore/recovered timeline (schema-clean)."""
+    obs.enable(str(tmp_path / "obs"))
+    guard.enable(str(tmp_path / "bundles"))
+    pen_x, pen_y, truth, u = _mk()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    state = {"u": u}
+    mgr.save(1, {"u": u})
+    # simulate post-crash state divergence: in-memory state is wrong,
+    # only the checkpoint holds the truth
+    state["u"] = pa.PencilArray.from_global(
+        pen_x, truth + 1000.0)
+
+    def step():
+        return pa.transpose(state["u"], pen_y)
+
+    def restore(ckpt):
+        state["u"] = ckpt.read("u", pen_x)
+
+    # attempts 1-2 hit corruption; escalation restores; attempt 3 clean
+    with faults.active("hop.exchange:corrupt*2"):
+        out = guard.guarded_step(
+            step, ckpt_mgr=mgr, restore=restore,
+            retry=RetryPolicy(max_attempts=2, base_delay=0.01),
+            label="escalate-drill")
+    assert np.array_equal(np.asarray(pa.gather(out)), truth)
+    events = obs.read_journal(str(tmp_path / "obs"))
+    assert obs.lint_journal(events) == []
+    stages = [e["stage"] for e in events if e["ev"] == "guard.recover"]
+    assert stages[0] == "error"
+    assert "restore" in stages and stages[-1] == "recovered"
+    assert {e["ev"] for e in events} >= {"guard.sdc", "guard.recover",
+                                         "ckpt.restore"}
+
+
+@pytest.mark.chaos
+def test_guarded_step_reraises_without_checkpoint(tmp_path):
+    pen_x, pen_y, truth, u = _mk()
+    guard.enable(str(tmp_path / "bundles"))
+    with faults.active("hop.exchange:corrupt"):
+        with pytest.raises(IntegrityError):
+            guard.guarded_step(
+                lambda: pa.transpose(u, pen_y),
+                retry=RetryPolicy(max_attempts=2, base_delay=0.01),
+                label="no-ckpt-drill")
+
+
+def test_guarded_step_passthrough_other_errors(tmp_path):
+    guard.enable(str(tmp_path / "bundles"))
+    with pytest.raises(ZeroDivisionError):
+        guard.guarded_step(lambda: 1 // 0,
+                           retry=RetryPolicy(max_attempts=3))
+
+
+# ---------------------------------------------------------------------------
+# journaling / metrics
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_guard_events_schema_and_counters(tmp_path):
+    obs.enable(str(tmp_path / "obs"))
+    guard.enable(str(tmp_path / "bundles"))
+    pen_x, pen_y, truth, u = _mk()
+    pa.transpose(u, pen_y)                       # ok check
+    with faults.active("hop.exchange:corrupt"):
+        with pytest.raises(IntegrityError):
+            pa.transpose(u, pen_y)               # sdc + bundle
+    events = obs.read_journal(str(tmp_path / "obs"))
+    assert obs.lint_journal(events) == []
+    kinds = {e["ev"] for e in events}
+    assert {"guard.sdc", "guard.bundle"} <= kinds
+    snap = obs.snapshot()
+    checks = {k: v for k, v in snap["counters"].items()
+              if k.startswith("guard.checks")}
+    assert checks.get("guard.checks{outcome=ok}", 0) >= 1
+    assert checks.get("guard.checks{outcome=sum}", 0) >= 1
+
+
+def test_probe_tolerance_semantics():
+    """Unit coverage of the host-side compare: exact dtypes exact,
+    float pairs within tolerance pass, NaN birth fails, matching NaNs
+    pass."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(1000),
+                    jnp.float64)
+    p = gi.probe_stats(x)
+    ok, kind = gi.probes_match(p, p, 1000, np.float64)
+    assert ok
+    # a perturbed sum within rounding tolerance still passes
+    q = np.asarray(p).copy()
+    q[0] += abs(q[2]) * 1e-14
+    assert gi.probes_match(p, q, 1000, np.float64)[0]
+    # beyond tolerance fails
+    q2 = np.asarray(p).copy()
+    q2[0] += abs(q2[2]) * 1e-3
+    assert not gi.probes_match(p, q2, 1000, np.float64)[0]
+    # NaN birth fails; NaN on both sides passes
+    qn = np.asarray(p).copy()
+    qn[0] = np.nan
+    assert not gi.probes_match(p, qn, 1000, np.float64)[0]
+    assert gi.probes_match(qn, qn, 1000, np.float64)[0]
+    # exact dtype: any deviation fails
+    pi = gi.probe_stats(jnp.arange(10, dtype=jnp.int32))
+    qi = np.asarray(pi).copy()
+    qi[0] += 1.0
+    assert not gi.probes_match(pi, qi, 10, np.int32)[0]
+
+
+def test_bundle_contains_plan_fingerprints(tmp_path):
+    """Plans built while the guard is armed ride every later bundle."""
+    import jax.numpy as jnp
+
+    guard.enable(str(tmp_path / "bundles"))
+    topo = pa.Topology((2, 4))
+    pa.PencilFFTPlan(topo, (8, 8, 8), dtype=jnp.complex64)
+    path = guard.write_crash_bundle("test", "unit")
+    plans = _load_json(os.path.join(path, "plans.json"))
+    assert any(p["kind"] == "fft_plan" for p in plans)
+    mf = _load_json(os.path.join(path, "MANIFEST.json"))
+    assert mf["reason"] == "test"

@@ -145,13 +145,17 @@ def _run_kill_sequence(tmp_path, nprocs_ckpt, nprocs_kill, nprocs_recover):
     _assert_kill_timeline(os.path.join(str(tmp_path), "obs"), after_kill=True)
     _run_phase(worker, tmp_path, nprocs_recover, "recover")
     _assert_kill_timeline(os.path.join(str(tmp_path), "obs"),
-                          after_kill=False)
+                          after_kill=False,
+                          guard_recover=(nprocs_recover == 1))
 
 
-def _assert_kill_timeline(obs_dir, after_kill):
+def _assert_kill_timeline(obs_dir, after_kill, guard_recover=False):
     """The journal is the post-mortem: step 1 committed, step 2 began
     and hit the injected torn fault, step 2 NEVER committed — and after
-    recovery, step 1 was restored.  Every record passes the schema
+    recovery, step 1 was restored.  The single-process recover variant
+    additionally ran the guard's detect-and-recover ladder, so its
+    timeline must carry the guard.sdc detections and a guard.recover
+    sequence ending in ``recovered``.  Every record passes the schema
     lint."""
     import sys
 
@@ -169,16 +173,29 @@ def _assert_kill_timeline(obs_dir, after_kill):
     done = {e["step"] for e in events
             if e["ev"] == "ckpt.save" and e["status"] == "committed"}
     assert done == {1}, done
-    # the dying processes journaled the torn firing before SIGKILL
-    faults_fired = [e for e in events if e["ev"] == "fault"]
-    assert faults_fired and all(
-        e["point"] == "io.write_block" and e["mode"] == "torn"
-        for e in faults_fired), faults_fired
+    # the dying processes journaled the torn firing before SIGKILL; the
+    # guarded recover drill adds its own (deliberate) corrupt firings
+    torn = [e for e in events if e["ev"] == "fault" and e["mode"] == "torn"]
+    assert torn and all(e["point"] == "io.write_block" for e in torn), torn
+    other = [e for e in events
+             if e["ev"] == "fault" and e["mode"] != "torn"]
+    assert all(e["point"] == "hop.exchange" and e["mode"] == "corrupt"
+               for e in other), other
     restores = [e for e in events if e["ev"] == "ckpt.restore"]
+    recover_stages = [e["stage"] for e in events
+                      if e["ev"] == "guard.recover"]
     if after_kill:
         assert restores == []
+        assert recover_stages == []
     else:
         assert {e["step"] for e in restores} == {1}
+        if guard_recover:
+            # the detect-and-recover ladder left its full story: typed
+            # detections, the escalation restore, then success
+            assert [e for e in events if e["ev"] == "guard.sdc"]
+            assert "error" in recover_stages
+            assert "restore" in recover_stages
+            assert recover_stages[-1] == "recovered", recover_stages
 
 
 @pytest.mark.chaos
