@@ -1,0 +1,275 @@
+"""Coordinated mesh-wide recovery — consensus, health leases, epochs.
+
+PR 5's guard gave each process a detect-and-recover ladder; this
+package makes the ladder **mesh-safe**.  On a multi-process pencil mesh
+every recovery decision must be *agreed*, because the step being
+guarded is collective: a rank that restores while its peers retry (or
+raises while its peers block in an all-to-all) turns one detected
+fault into a pod-wide deadlock.  Four cooperating pieces (see
+``docs/Cluster.md``):
+
+* :mod:`~pencilarrays_tpu.cluster.kv` — the wire: the jax distributed
+  KV store on a real pod, or a shared directory (``FileKV``) for local
+  multi-process drills and tests;
+* :mod:`~pencilarrays_tpu.cluster.consensus` — the status allgather +
+  deterministic verdict merge behind the distributed ``guarded_step``
+  (one agreed action: all-retry / all-restore / all-re-raise), and the
+  agreed-checkpoint election behind
+  ``CheckpointManager.common_latest_valid()``;
+* :mod:`~pencilarrays_tpu.cluster.health` — per-rank heartbeat leases:
+  a SIGKILLed or wedged peer is detected by lease expiry and surfaced
+  as a typed :class:`PeerFailureError` (with a crash bundle) instead
+  of an indefinite collective stall;
+* :mod:`~pencilarrays_tpu.cluster.epoch` — the monotonic recovery
+  epoch stamped into journals, bundles and checkpoint manifests so
+  post-mortems align timelines across ranks.
+
+Everything is **off by default** (the faults/obs/guard discipline: one
+cached env probe on the disabled path, env re-read on change so a
+worker can arm late), and with ``process_count() == 1`` and no explicit
+world the layer degrades to the existing local ladder — single-process
+behavior is bit-for-bit unchanged (test-pinned).
+
+Environment knobs:
+
+====================================  ========  ==========================
+``PENCILARRAYS_TPU_CLUSTER``          unset     off / ``1`` (jax KV
+                                                store) / a shared
+                                                directory (``FileKV``)
+``PENCILARRAYS_TPU_CLUSTER_RANK``     jax       this process's mesh rank
+                                                (overrides
+                                                ``process_index``; the
+                                                FileKV drill identity)
+``PENCILARRAYS_TPU_CLUSTER_WORLD``    jax       mesh size (overrides
+                                                ``process_count``)
+``PENCILARRAYS_TPU_CLUSTER_LEASE_TTL``    15    lease staleness bound (s)
+``PENCILARRAYS_TPU_CLUSTER_LEASE_INTERVAL``  ttl/3  heartbeat period (s)
+``PENCILARRAYS_TPU_CLUSTER_JOIN_GRACE``   max(2*ttl, 20)  never-joined
+                                                window (s)
+``PENCILARRAYS_TPU_CLUSTER_VERDICT_TIMEOUT`` 120  consensus-round wait (s)
+====================================  ========  ==========================
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from .errors import (  # noqa: F401
+    ClusterAbortError,
+    ClusterError,
+    ConsensusTimeoutError,
+    PeerFailureError,
+)
+
+__all__ = [
+    "ENV_VAR",
+    "RANK_VAR",
+    "WORLD_VAR",
+    "LEASE_TTL_VAR",
+    "LEASE_INTERVAL_VAR",
+    "JOIN_GRACE_VAR",
+    "VERDICT_TIMEOUT_VAR",
+    "ClusterError",
+    "PeerFailureError",
+    "ClusterAbortError",
+    "ConsensusTimeoutError",
+    "enabled",
+    "enable",
+    "disable",
+    "rank",
+    "world_size",
+    "coordinator",
+    "current_epoch",
+]
+
+ENV_VAR = "PENCILARRAYS_TPU_CLUSTER"
+RANK_VAR = "PENCILARRAYS_TPU_CLUSTER_RANK"
+WORLD_VAR = "PENCILARRAYS_TPU_CLUSTER_WORLD"
+LEASE_TTL_VAR = "PENCILARRAYS_TPU_CLUSTER_LEASE_TTL"
+LEASE_INTERVAL_VAR = "PENCILARRAYS_TPU_CLUSTER_LEASE_INTERVAL"
+JOIN_GRACE_VAR = "PENCILARRAYS_TPU_CLUSTER_JOIN_GRACE"
+VERDICT_TIMEOUT_VAR = "PENCILARRAYS_TPU_CLUSTER_VERDICT_TIMEOUT"
+
+DEFAULT_LEASE_TTL = 15.0
+DEFAULT_VERDICT_TIMEOUT = 120.0
+
+_OFF_VALUES = ("", "0", "off", "false")
+
+_lock = threading.Lock()
+_override: Optional[object] = None   # programmatic Coordinator (or False)
+_coord = None                        # env-built Coordinator singleton
+_coord_key = None                    # (env value, rank, world) it was built for
+
+
+def _env_value() -> str:
+    return os.environ.get(ENV_VAR, "")
+
+
+def enabled() -> bool:
+    """THE gate: one env probe on the disabled path (no coordinator is
+    built, no thread started, nothing allocated unless this is True).
+    Off tokens match case-insensitively (``OFF`` is off, not a FileKV
+    directory named ``OFF``)."""
+    if _override is not None:
+        return _override is not False
+    return _env_value().strip().lower() not in _OFF_VALUES
+
+
+def rank() -> int:
+    """This process's mesh rank: the ``PENCILARRAYS_TPU_CLUSTER_RANK``
+    override (the FileKV drill identity), else the coordinator-assigned
+    jax process id (read without building the XLA backend — the obs
+    convention), else 0.  THE one identity-resolution rule — the
+    ``%rank`` fault selector and obs journal attribution delegate
+    here."""
+    env = os.environ.get(RANK_VAR)
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return _jax_identity()[0]
+
+
+def world_size() -> int:
+    """Mesh size under the same resolution order as :func:`rank`."""
+    env = os.environ.get(WORLD_VAR)
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return _jax_identity()[1]
+
+
+def _jax_identity():
+    try:
+        import jax
+
+        state = getattr(jax.distributed, "global_state", None)
+        pid = getattr(state, "process_id", None)
+        num = getattr(state, "num_processes", None)
+        return (int(pid) if pid is not None else 0,
+                int(num) if num is not None else 1)
+    except Exception:
+        return 0, 1
+
+
+def lease_ttl() -> float:
+    try:
+        return float(os.environ.get(LEASE_TTL_VAR, DEFAULT_LEASE_TTL))
+    except ValueError:
+        return DEFAULT_LEASE_TTL
+
+
+def lease_interval() -> Optional[float]:
+    try:
+        v = os.environ.get(LEASE_INTERVAL_VAR)
+        return float(v) if v else None
+    except ValueError:
+        return None
+
+
+def join_grace() -> Optional[float]:
+    """Override for the never-joined window (``None``: the lease
+    board's ``max(2*ttl, 20s)`` default) — raise it on pods whose
+    containers start far apart, without inflating ``ttl`` (which would
+    also slow real-death detection)."""
+    try:
+        v = os.environ.get(JOIN_GRACE_VAR)
+        return float(v) if v else None
+    except ValueError:
+        return None
+
+
+def verdict_timeout() -> float:
+    try:
+        return float(os.environ.get(VERDICT_TIMEOUT_VAR,
+                                    DEFAULT_VERDICT_TIMEOUT))
+    except ValueError:
+        return DEFAULT_VERDICT_TIMEOUT
+
+
+def coordinator():
+    """The process's active :class:`~pencilarrays_tpu.cluster.consensus.
+    Coordinator`, or ``None`` when the layer is off *or* the mesh is a
+    single process (the degrade-to-local contract).  Built lazily on
+    first use (starting the heartbeat), rebuilt if the gate value or
+    identity changes (workers arm late, like faults/obs), and cheap on
+    the disabled path — one env probe, no locking."""
+    global _coord, _coord_key
+    if _override is not None:
+        return _override or None     # False -> disabled -> None
+    env = _env_value()
+    if env.strip().lower() in _OFF_VALUES:
+        return None
+    r, w = rank(), world_size()
+    if w <= 1:
+        return None                  # degrade to the local ladder
+    key = (env, r, w)
+    with _lock:
+        if _coord is not None and _coord_key == key:
+            return _coord
+        if _coord is not None:
+            _coord.shutdown()
+        from .consensus import Coordinator
+        from .kv import resolve_kv
+
+        _coord = Coordinator(resolve_kv(env), r, w,
+                             lease_ttl=lease_ttl(),
+                             lease_interval=lease_interval(),
+                             join_grace=join_grace(),
+                             verdict_timeout=verdict_timeout())
+        _coord_key = key
+        return _coord
+
+
+def enable(coordinator_obj) -> None:
+    """Programmatic arm: install an explicit ``Coordinator`` (tests
+    build thread-local ones over a shared ``FileKV``); wins over the
+    environment until :func:`disable`.  Any env-built coordinator is
+    shut down first — its heartbeat must not keep renewing a lease in
+    a namespace nobody coordinates over anymore."""
+    global _override, _coord, _coord_key
+    with _lock:
+        if _coord is not None and _coord is not coordinator_obj:
+            _coord.shutdown()
+            _coord = None
+            _coord_key = None
+        _override = coordinator_obj
+
+
+def disable() -> None:
+    """Programmatic disarm: wins over the environment until the next
+    :func:`enable` (the running heartbeat of an env-built coordinator,
+    if any, is stopped)."""
+    global _override, _coord, _coord_key
+    with _lock:
+        _override = False
+        if _coord is not None:
+            _coord.shutdown()
+        _coord = None
+        _coord_key = None
+
+
+def _reset_for_tests() -> None:
+    """Full gate reset (tests toggle env/overrides between cases)."""
+    global _override, _coord, _coord_key
+    with _lock:
+        _override = None
+        if _coord is not None:
+            _coord.shutdown()
+        _coord = None
+        _coord_key = None
+    from . import epoch as _epoch
+
+    _epoch._reset_for_tests()
+
+
+def current_epoch() -> int:
+    """The recovery epoch (see :mod:`~pencilarrays_tpu.cluster.epoch`)."""
+    from . import epoch as _epoch
+
+    return _epoch.current()

@@ -1,0 +1,69 @@
+"""Recovery epochs — the cross-rank timeline alignment marker.
+
+Every *agreed* recovery action (mesh retry, coordinated restore,
+consensus abort) advances a monotonic **epoch** counter, identically on
+every rank (the advance is driven by the consensus verdict, which is
+deterministic over the exchanged statuses — no extra communication).
+The epoch is stamped into:
+
+* the obs journal (a fsync-critical ``guard.epoch`` record at each
+  advance, plus an ``epoch`` field on verdict/recover records);
+* crash-bundle manifests (``guard/bundle.py``);
+* checkpoint manifests (``resilience/checkpoint.py``);
+
+so a post-mortem can line up N ranks' journals — "which restore does
+this bundle belong to?" — without trusting wall clocks across hosts.
+
+Epoch 0 is the job's initial, never-recovered state; single-process
+runs (or runs with the cluster layer off) simply stay at whatever epoch
+they are at, and every stamp reads the current value through one cheap
+module-level int.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["current", "advance", "set_current"]
+
+_lock = threading.Lock()
+_epoch = 0
+
+
+def current() -> int:
+    """The recovery epoch this process is in (0 = never recovered)."""
+    return _epoch
+
+
+def set_current(value: int, reason: str, **fields) -> int:
+    """Raise the epoch to ``value`` (monotonic: a smaller value is a
+    no-op — late verdicts must never rewind the timeline).  On an
+    actual increase, journals a fsync-critical ``guard.epoch`` record
+    carrying ``reason`` (the agreed action) and mirrors the value into
+    the ``cluster.epoch`` gauge.  The *value* itself comes from the
+    consensus verdict (max of the mesh's reported epochs, +1 on a
+    non-``ok`` action) — a pure function of the exchanged statuses, so
+    every rank lands on the same number without extra communication."""
+    global _epoch
+    with _lock:
+        if value <= _epoch:
+            return _epoch
+        _epoch = value
+    from .. import obs
+
+    if obs.enabled():
+        obs.gauge("cluster.epoch").set(value)
+        obs.record_event("guard.epoch", epoch=value, reason=reason, **fields)
+    return value
+
+
+def advance(reason: str, **fields) -> int:
+    """Enter the next recovery epoch (the local-ladder convenience
+    around :func:`set_current`)."""
+    return set_current(current() + 1, reason, **fields)
+
+
+def _reset_for_tests() -> None:
+    global _epoch
+    with _lock:
+        _epoch = 0

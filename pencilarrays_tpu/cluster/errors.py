@@ -1,0 +1,67 @@
+"""Typed failure taxonomy of the mesh coordination layer.
+
+Every failure the coordination layer can surface derives from
+:class:`ClusterError`, mirroring the ``ResilienceError`` /
+``GuardError`` umbrellas — so mesh drills can assert "typed cluster
+error, never a silent hang" with one ``except`` clause.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+__all__ = [
+    "ClusterError",
+    "PeerFailureError",
+    "ClusterAbortError",
+    "ConsensusTimeoutError",
+]
+
+
+class ClusterError(Exception):
+    """Base of every error raised by ``pencilarrays_tpu.cluster``."""
+
+
+class PeerFailureError(ClusterError):
+    """A peer rank's health lease expired (SIGKILLed, wedged, or
+    partitioned) or it never joined the mesh within the grace window.
+    Surviving ranks raise this *instead of hanging in the next
+    collective* until a watchdog fires.  ``rank`` names the dead peer,
+    ``age_s`` is how stale its lease was at detection, ``bundle`` is
+    the crash-bundle directory written for the post-mortem."""
+
+    def __init__(self, message: str, *, rank: Optional[int] = None,
+                 age_s: Optional[float] = None, bundle: Optional[str] = None):
+        super().__init__(message)
+        self.rank = rank
+        self.age_s = age_s
+        self.bundle = bundle
+
+
+class ClusterAbortError(ClusterError):
+    """The mesh agreed to abort: another rank hit an unrecoverable
+    failure (its error string is in ``errors``), and this rank — which
+    may itself be healthy — re-raises *by consensus* so every rank
+    exits the step together instead of deadlocking in a half-abandoned
+    collective.  ``ranks`` lists the ranks that reported failure."""
+
+    def __init__(self, message: str, *,
+                 ranks: Sequence[int] = (),
+                 errors: Optional[Dict[int, str]] = None):
+        super().__init__(message)
+        self.ranks = tuple(ranks)
+        self.errors = dict(errors or {})
+
+
+class ConsensusTimeoutError(ClusterError, TimeoutError):
+    """A KV consensus round did not complete within the verdict
+    timeout and no peer lease had expired to explain it (a live-but-
+    diverged peer, or a too-small ``PENCILARRAYS_TPU_CLUSTER_VERDICT_TIMEOUT``).
+    Subclasses ``TimeoutError`` so retry policies classify it as
+    transient."""
+
+    def __init__(self, message: str, *, key: Optional[str] = None,
+                 timeout_s: Optional[float] = None):
+        super().__init__(message)
+        self.key = key
+        self.timeout_s = timeout_s

@@ -145,7 +145,9 @@ def disable() -> None:
 
 def _reset_for_tests() -> None:
     """Full gate reset: drop overrides AND the env cache (tests toggle
-    the env between cases; production code never needs this)."""
+    the env between cases; production code never needs this).  Also
+    resets the crash-bundle cap, so a test file's many drilled
+    detections cannot starve a later test of its bundle."""
     global _override, _override_dir, _env_cache, _env_on, _finite_counter
     with _lock:
         _override = None
@@ -153,6 +155,9 @@ def _reset_for_tests() -> None:
         _env_cache = None
         _env_on = False
         _finite_counter = 0
+    from . import bundle as _bundle
+
+    _bundle._reset_for_tests()
 
 
 def bundle_dir() -> str:

@@ -55,6 +55,14 @@ _PLANS: deque = deque(maxlen=32)
 _PLAN_KEYS: set = set()
 
 
+def _reset_for_tests() -> None:
+    """Reset the per-process bundle cap (tests drill many detections in
+    one process; production never needs this)."""
+    global _written
+    with _lock:
+        _written = 0
+
+
 def note_plan(kind: str, fingerprint: dict) -> None:
     """Register a plan fingerprint for future bundles (deduplicated per
     process on the fingerprint's schedule hash)."""
@@ -176,12 +184,21 @@ def write_crash_bundle(reason: str, label: str, *,
     _try("plans", _plans)
     _try("journal", _journal)
 
+    try:
+        from ..cluster import epoch as _epoch
+
+        epoch = _epoch.current()
+    except Exception:   # pragma: no cover - the stamp is best-effort
+        epoch = None
     manifest = {
         "format": "pencilarrays-tpu-crash-bundle",
         "version": 1,
         "reason": reason,
         "label": label,
         "error": error,
+        # recovery-epoch stamp: aligns this bundle with the mesh's
+        # verdict/journal timelines (docs/Cluster.md)
+        "epoch": epoch,
         "pid": os.getpid(),
         "t_wall": time.time(),
         "argv": list(sys.argv[:6]),

@@ -19,6 +19,22 @@ Every rung journals a ``guard.recover`` event (stages ``error`` /
 ``retry`` / ``restore`` / ``recovered`` / ``failed``), so the flight
 recorder carries the full detect-retry-restore timeline a post-mortem
 needs.
+
+**Mesh mode** (PR 6): when the cluster coordination layer is armed
+(``PENCILARRAYS_TPU_CLUSTER``, or an explicit ``coordinator=``) and the
+mesh has more than one process, the ladder becomes *collective*: no
+rank acts on a local verdict alone.  At every step boundary all ranks
+exchange a status blob (a cheap KV allgather — never a bare one-sided
+raise) and the deterministic merge in
+:mod:`~pencilarrays_tpu.cluster.consensus` picks ONE action for the
+whole mesh — all-retry, all-restore (of the SAME agreed step, elected
+by ``CheckpointManager.common_latest_valid``) or all-re-raise.  A
+``HangTimeoutError`` enters the same ladder in mesh mode (a hang on one
+rank is a mesh event), peers are lease-checked before each attempt
+(:class:`~pencilarrays_tpu.cluster.PeerFailureError` instead of a
+stall), and every agreed non-``ok`` verdict advances the shared
+recovery epoch.  With the layer off or ``world == 1`` the local ladder
+below runs bit-for-bit unchanged.
 """
 
 from __future__ import annotations
@@ -26,7 +42,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Optional
 
-from .errors import IntegrityError
+from .errors import HangTimeoutError, IntegrityError
 
 __all__ = ["guarded_step"]
 
@@ -43,7 +59,8 @@ def _journal(stage: str, label: str, **fields) -> None:
 def guarded_step(fn: Callable, *, ckpt_mgr=None,
                  restore: Optional[Callable] = None, retry=None,
                  label: str = "step",
-                 watchdog_timeout: Optional[float] = None):
+                 watchdog_timeout: Optional[float] = None,
+                 coordinator=None):
     """Run one unit of work with detect-and-recover semantics.
 
     Parameters
@@ -51,7 +68,10 @@ def guarded_step(fn: Callable, *, ckpt_mgr=None,
     fn:
         Zero-argument callable performing the step (typically a closure
         over the caller's state).  Only :class:`IntegrityError` enters
-        the recovery ladder; every other exception propagates untouched.
+        the recovery ladder (plus :class:`HangTimeoutError` in mesh
+        mode); every other exception propagates untouched.  ``fn`` must
+        be re-runnable: retries (and, on a mesh, *agreed* retries that
+        rerun even ranks whose local copy succeeded) call it again.
     ckpt_mgr:
         A :class:`~pencilarrays_tpu.resilience.CheckpointManager`; with
         ``restore`` it enables the escalation rung.
@@ -71,6 +91,11 @@ def guarded_step(fn: Callable, *, ckpt_mgr=None,
     watchdog_timeout:
         Per-attempt hang deadline override (None: the guard env
         default).
+    coordinator:
+        Explicit :class:`~pencilarrays_tpu.cluster.consensus.
+        Coordinator` (default: the process-global
+        ``cluster.coordinator()``, which is ``None`` — local ladder —
+        unless the cluster layer is armed on a multi-process mesh).
 
     Returns ``fn()``'s value.  Raises the last :class:`IntegrityError`
     when the full ladder fails, or
@@ -78,9 +103,25 @@ def guarded_step(fn: Callable, *, ckpt_mgr=None,
     semantics are folded into the same re-raise (a missing valid
     checkpoint cannot recover anything)."""
     from ..resilience.retry import RetryPolicy
-    from .watchdog import watchdog
 
     policy = retry or RetryPolicy.from_env()
+    if coordinator is None:
+        from .. import cluster
+
+        coordinator = cluster.coordinator()
+    if coordinator is not None:
+        return _mesh_guarded_step(coordinator, fn, ckpt_mgr, restore,
+                                  policy, label, watchdog_timeout)
+    return _local_guarded_step(fn, ckpt_mgr, restore, policy, label,
+                               watchdog_timeout)
+
+
+def _local_guarded_step(fn, ckpt_mgr, restore, policy, label,
+                        watchdog_timeout):
+    """The single-process ladder — unchanged from PR 5 (bit-for-bit:
+    the mesh layer degrades to exactly this when ``world == 1``)."""
+    from .watchdog import watchdog
+
     start = time.monotonic()
     last: Optional[IntegrityError] = None
     attempts = max(1, policy.max_attempts)
@@ -122,3 +163,111 @@ def guarded_step(fn: Callable, *, ckpt_mgr=None,
         raise
     _journal("recovered", label, step=step, via="restore")
     return out
+
+
+def _mesh_guarded_step(coord, fn, ckpt_mgr, restore, policy, label,
+                      watchdog_timeout):
+    """The collective ladder: every attempt ends in a status allgather
+    and ONE agreed action executed by every rank (module docstring).
+    Mirrors the local ladder's shape — ``max_attempts`` retries, one
+    restore escalation, then raise — but each rung is mesh-wide."""
+    from ..cluster import ClusterAbortError, epoch as _epoch
+    from .watchdog import watchdog
+
+    start = time.monotonic()
+    attempts = max(1, policy.max_attempts)
+    attempt = 0
+    restored_step: Optional[int] = None
+    last: Optional[Exception] = None
+    while True:
+        attempt += 1
+        coord.check_peers()     # a dead peer fails typed, up front
+        err: Optional[Exception] = None
+        out = None
+        try:
+            with watchdog(label, watchdog_timeout, kind="step"):
+                out = fn()
+        except (IntegrityError, HangTimeoutError) as e:
+            err = last = e
+            _journal("error", label, attempt=attempt, rank=coord.rank,
+                     epoch=_epoch.current(),
+                     kind=getattr(e, "kind", "hang"),
+                     hop=getattr(e, "hop", None), error=str(e))
+        except BaseException as e:
+            # NOT part of the recovery ladder (app bug, OOM, interrupt):
+            # the contract is passthrough — but never a SILENT one-sided
+            # exit.  Publish a fatal status for this round (no waiting),
+            # so peers get an agreed `raise` instead of burning the
+            # verdict timeout, and the round counters stay aligned for
+            # whatever the caller does next.
+            coord.post_abort(label, f"{type(e).__name__}: {e}")
+            _journal("failed", label, attempt=attempt, rank=coord.rank,
+                     epoch=_epoch.current(), error=str(e),
+                     escalation="passthrough")
+            raise
+        # the step boundary: publish local status, read the mesh's, and
+        # let the deterministic merge pick the ONE action every rank
+        # takes — the all-retry budget and deadline accounting are part
+        # of the exchanged status, so the verdict never depends on
+        # another rank's clock
+        delay = (policy.delay_for(attempt) if attempt < attempts else None)
+        can_retry = (restored_step is None and delay is not None
+                     and time.monotonic() - start + delay <= policy.deadline)
+        verdict = coord.agree(label, {
+            "status": ("ok" if err is None else
+                       "hang" if isinstance(err, HangTimeoutError)
+                       else "integrity"),
+            "error": f"{type(err).__name__}: {err}" if err else None,
+            "can_retry": bool(can_retry),
+            "can_restore": (restored_step is None and ckpt_mgr is not None
+                            and restore is not None),
+        })
+        action = verdict["action"]
+        if action == "ok":
+            if attempt > 1 or restored_step is not None:
+                _journal("recovered", label, attempt=attempt,
+                         rank=coord.rank, epoch=verdict["epoch"],
+                         via="retry" if restored_step is None else "restore",
+                         step=restored_step)
+            return out
+        if action == "retry":
+            _journal("retry", label, attempt=attempt, rank=coord.rank,
+                     epoch=verdict["epoch"], delay_s=delay)
+            time.sleep(delay)   # can_retry was AND-merged: delay is set
+            continue
+        if action == "restore":
+            # the coordinated restore runs under the same watchdog
+            # discipline as the step: a rank wedged in election I/O or
+            # the checkpoint read leaves a bundle and a typed
+            # HangTimeoutError, never an unattributed stall (its
+            # heartbeat would otherwise keep the lease fresh forever)
+            with watchdog(f"{label}:restore", watchdog_timeout,
+                          kind="restore"):
+                step = ckpt_mgr.common_latest_valid(coordinator=coord)
+                if step is None:
+                    _journal("failed", label, rank=coord.rank,
+                             epoch=verdict["epoch"], error=str(last),
+                             escalation="no-common-checkpoint")
+                    raise last if last is not None else ClusterAbortError(
+                        f"{label}: mesh agreed to restore but no "
+                        f"checkpoint step is valid on every rank",
+                        ranks=verdict["ranks"],
+                        errors=verdict.get("errors"))
+                _journal("restore", label, step=step, rank=coord.rank,
+                         epoch=verdict["epoch"])
+                restore(ckpt_mgr.restore(step))
+            restored_step = step
+            continue
+        # action == "raise": the mesh exits the step TOGETHER — failing
+        # ranks with their own typed error, healthy ranks with a typed
+        # abort naming the peers (never a bare hang in a collective)
+        _journal("failed", label, rank=coord.rank, epoch=verdict["epoch"],
+                 error=str(last) if last is not None else None,
+                 escalation="mesh", ranks=verdict["ranks"])
+        if last is not None:
+            raise last
+        raise ClusterAbortError(
+            f"{label}: mesh consensus aborted the step — rank(s) "
+            f"{verdict['ranks']} failed unrecoverably "
+            f"({verdict.get('errors')})",
+            ranks=verdict["ranks"], errors=verdict.get("errors"))

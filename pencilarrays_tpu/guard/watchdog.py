@@ -100,13 +100,17 @@ def _monitor_loop() -> None:
 
 def _fire(e: _Entry) -> None:
     """Expiry path, on the monitor thread: journal, write the bundle
-    while the guarded section is still stuck, then interrupt main."""
+    while the guarded section is still stuck, then interrupt main.
+    Both records carry the recovery epoch, so a hang that fires during
+    a mesh recovery generation can be aligned with the peers' verdict
+    timelines (``docs/Cluster.md``)."""
+    from ..cluster import epoch as _epoch
     from ..obs import counter, enabled as obs_enabled, record_event
 
     if obs_enabled():
         counter("guard.hangs").inc()
         record_event("guard.hang", label=e.label, timeout_s=e.timeout,
-                     **e.ctx)
+                     epoch=_epoch.current(), **e.ctx)
     try:
         from .bundle import write_crash_bundle
 

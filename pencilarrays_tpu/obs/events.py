@@ -72,6 +72,14 @@ CRITICAL_EVENTS = frozenset({
     "run.start", "ckpt.save", "ckpt.commit", "ckpt.restore", "ckpt.verify",
     "fault", "retry", "dist.init",
     "guard.sdc", "guard.hang", "guard.recover", "guard.bundle",
+    # mesh recovery coordination: each of these gates (or attributes) a
+    # recovery decision, and the writer may be about to die — the
+    # verdict/lease/epoch timeline is exactly what the post-mortem
+    # aligns ranks by (lease events are journaled only on state
+    # CHANGES — acquire/expiry — never per renewal, and routine `ok`
+    # verdicts opt OUT per record via record_event's _fsync override,
+    # so criticality never rides the healthy per-step path)
+    "guard.epoch", "cluster.lease", "cluster.verdict",
 })
 
 _lock = threading.Lock()
@@ -168,15 +176,16 @@ def _process_index() -> int:
     local XLA backend as a side effect, and an event recorded before
     ``jax.distributed.initialize`` (e.g. ``dist.init connecting``) would
     then make the real initialize raise 'must be called before any JAX
-    computations'.  The coordinator-assigned index is read from jax's
-    distributed global state instead — absent (single-process or
-    pre-init) means 0, and the journal filename re-resolves on change."""
+    computations'.  The cluster layer's rank override wins first — a
+    FileKV drill runs N mesh ranks that are all jax process 0, and
+    their journals must neither collide nor mis-attribute — then the
+    coordinator-assigned index from jax's distributed global state;
+    absent both (single-process or pre-init) means 0, and the journal
+    filename re-resolves on change."""
     try:
-        import jax
+        from ..cluster import rank
 
-        state = getattr(jax.distributed, "global_state", None)
-        pid = getattr(state, "process_id", None)
-        return int(pid) if pid is not None else 0
+        return rank()
     except Exception:
         return 0
 
@@ -299,8 +308,8 @@ def _fsync_policy() -> str:
     return os.environ.get(FSYNC_VAR, "critical")
 
 
-def _write_locked(ev: str, fields: dict,
-                  proc: Optional[int] = None) -> None:
+def _write_locked(ev: str, fields: dict, proc: Optional[int] = None,
+                  fsync: Optional[bool] = None) -> None:
     global _seq
     _seq += 1
     rec = {"v": SCHEMA_VERSION, "ev": ev, "run": run_id(),
@@ -313,17 +322,24 @@ def _write_locked(ev: str, fields: dict,
     _file.write(json.dumps(rec, separators=(",", ":")) + "\n")
     _file.flush()
     policy = _fsync_policy()
-    if policy == "always" or (policy == "critical" and ev in CRITICAL_EVENTS):
+    critical = ev in CRITICAL_EVENTS if fsync is None else fsync
+    if policy == "always" or (policy == "critical" and critical):
         try:
             os.fsync(_file.fileno())
         except OSError:
             pass
 
 
-def record_event(ev: str, **fields) -> bool:
+def record_event(ev: str, _fsync: Optional[bool] = None, **fields) -> bool:
     """Append one record to the journal.  Returns False (doing NOTHING,
     allocating nothing beyond the kwargs dict) when observability is
-    disabled — the contract that keeps instrumented hot paths free."""
+    disabled — the contract that keeps instrumented hot paths free.
+
+    ``_fsync`` overrides the event type's CRITICAL_EVENTS membership
+    for THIS record (under the default ``critical`` policy) — for event
+    types whose criticality depends on the payload, e.g. a
+    ``cluster.verdict`` gates recovery only when its action is not
+    ``ok``, and a routine ok verdict fires once per step boundary."""
     if not enabled():
         return False
     try:
@@ -333,7 +349,7 @@ def record_event(ev: str, **fields) -> bool:
                 return False  # lost a race with disable(): a stale
                 # thread must not resurrect the journal while off
             _open_locked(proc)
-            _write_locked(ev, fields, proc=proc)
+            _write_locked(ev, fields, proc=proc, fsync=_fsync)
         return True
     except OSError:
         return False  # a full/readonly disk must never take down the job

@@ -51,6 +51,10 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     "guard.hang": ("label", "timeout_s"),
     "guard.recover": ("label", "stage"),
     "guard.bundle": ("path", "reason"),
+    "guard.epoch": ("epoch", "reason"),
+    # mesh coordination layer (cluster/)
+    "cluster.lease": ("rank", "status"),
+    "cluster.verdict": ("label", "action", "epoch"),
     # profiling / drift
     "profile": ("dir", "status"),
     "drift.sample": ("hop", "predicted_bytes", "measured_s", "source"),

@@ -40,6 +40,7 @@ __all__ = [
     "initialize",
     "ensure_initialized",
     "is_initialized",
+    "kv_client",
     "process_index",
     "process_count",
     "is_multiprocess",
@@ -219,6 +220,17 @@ def ensure_initialized(coordinator_address: Optional[str] = None,
 
 def is_initialized() -> bool:
     return _initialized or _jax_already_initialized()
+
+
+def kv_client():
+    """The job's distributed key-value store client (the coordinator
+    service every ``jax.distributed`` job runs) — ``None`` before
+    :func:`initialize` or in single-process runs.  The cluster
+    coordination layer (``pencilarrays_tpu.cluster``) builds its
+    consensus/lease wire on this; reading it never initializes
+    anything (the obs ``_process_index`` convention)."""
+    state = getattr(jax.distributed, "global_state", None)
+    return getattr(state, "client", None)
 
 
 def process_index() -> int:

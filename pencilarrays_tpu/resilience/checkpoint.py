@@ -245,10 +245,16 @@ class CheckpointManager:
                     for n, blocks in merged.items():
                         entries[n]["blocks"] = sorted(
                             blocks, key=lambda b: tuple(b["start"]))
+                from ..cluster import epoch as _epoch
+
                 manifest = {
                     "format": "pencilarrays-tpu-checkpoint",
                     "version": MANIFEST_VERSION,
                     "step": step,
+                    # recovery-epoch stamp: lets a post-mortem align this
+                    # checkpoint with the journals/bundles of the recovery
+                    # generation that produced it (docs/Cluster.md)
+                    "epoch": _epoch.current(),
                     "driver": type(self.driver).__name__,
                     "data_file": self._data_name,
                     "algo": ALGO if self.checksums else None,
@@ -550,7 +556,12 @@ class CheckpointManager:
         uncommitted, torn or checksum-failing checkpoints are skipped
         with a logged warning.  ``None`` when nothing valid exists.
         Also recovers a committed step parked in the ``-replaced``
-        namespace by a re-save that crashed before its new COMMIT."""
+        namespace by a re-save that crashed before its new COMMIT.
+
+        This is a *per-process* answer — on a multi-process mesh where
+        each host verifies its own storage, use
+        :meth:`common_latest_valid` so every rank restores the SAME
+        step."""
         self._recover_replaced()
         for step in sorted(self._scan(), reverse=True):
             if not self.is_committed(step):
@@ -565,18 +576,89 @@ class CheckpointManager:
             return step
         return None
 
+    def valid_steps(self) -> List[int]:
+        """EVERY committed step that passes verification, ascending —
+        the full restorable set this process can vouch for (the input
+        to the mesh-wide checkpoint election)."""
+        self._recover_replaced()
+        out = []
+        for step in sorted(self._scan()):
+            if not self.is_committed(step):
+                continue
+            try:
+                self.verify(step)
+            except ResilienceError as e:
+                logger.warning("checkpoint step %d skipped: %s", step, e)
+                continue
+            out.append(step)
+        return out
+
+    def common_latest_valid(self, *, coordinator=None) -> Optional[int]:
+        """Newest step that is :meth:`latest_valid`-grade on **every**
+        rank of the mesh — the agreed-checkpoint election.
+
+        The divergent-restore hazard this removes: a torn write on one
+        rank silently shifts that rank's ``latest_valid()`` to an older
+        step, and per-rank restores then reload DIFFERENT steps — a
+        mesh-wide state divergence no probe downstream can attribute.
+        Here every rank publishes its full valid-step set over the
+        cluster KV (one allgather round), the intersection is computed
+        identically everywhere, and its maximum is the one step the
+        whole mesh restores.  ``None`` when no step is valid on every
+        rank.
+
+        Cost: the election fully verifies every retained checkpoint on
+        every rank (bounded by ``keep``) — deliberately ONE consensus
+        round on a cold recovery path, instead of a cheaper
+        newest-first protocol that would need a verify/exchange round
+        per rejected candidate.  Set ``checksums=False`` (structural
+        verification) if election latency on very large retained sets
+        ever matters.
+
+        With no coordinator (layer off, or a single-process mesh) this
+        degrades to :meth:`latest_valid` exactly."""
+        if coordinator is None:
+            from .. import cluster
+
+            coordinator = cluster.coordinator()
+        if coordinator is None:
+            return self.latest_valid()
+        local = self.valid_steps()
+        common = coordinator.agree_steps("ckpt-valid", local)
+        agreed = max(common) if common else None
+        from .. import obs
+        from ..cluster import epoch as _epoch
+
+        if obs.enabled():
+            obs.record_event(
+                "cluster.verdict", label="ckpt-elect", action="elect",
+                epoch=_epoch.current(), step=agreed,
+                local_steps=local, common_steps=common)
+        if agreed is None:
+            logger.warning(
+                "no checkpoint step is valid on every rank (local valid "
+                "steps here: %s)", local)
+        elif local and agreed != local[-1]:
+            logger.warning(
+                "mesh-agreed checkpoint step %d is older than this "
+                "rank's newest valid step %d (a peer's newer step is "
+                "torn or missing)", agreed, local[-1])
+        return agreed
+
     # -- restore -----------------------------------------------------------
     def restore(self, step: Optional[int] = None,
                 *, verify: Optional[bool] = None) -> "Checkpoint":
-        """Open checkpoint ``step`` (default: :meth:`latest_valid`) for
-        reading.  ``verify`` (default: the manager's ``checksums``
-        setting) validates the requested datasets against the manifest
-        before any bytes are trusted.  When the step comes from
-        :meth:`latest_valid` it was fully verified moments ago, so the
-        per-read verification defaults OFF for that path (pass
+        """Open checkpoint ``step`` (default: :meth:`latest_valid` —
+        or, with the cluster layer armed on a multi-process mesh,
+        :meth:`common_latest_valid`, so every rank opens the SAME
+        agreed step) for reading.  ``verify`` (default: the manager's
+        ``checksums`` setting) validates the requested datasets against
+        the manifest before any bytes are trusted.  When the step comes
+        from :meth:`latest_valid` it was fully verified moments ago, so
+        the per-read verification defaults OFF for that path (pass
         ``verify=True`` to force it anyway)."""
         if step is None:
-            step = self.latest_valid()
+            step = self.common_latest_valid()
             if step is None:
                 raise CheckpointNotFoundError(
                     f"no valid committed checkpoint under "

@@ -29,7 +29,7 @@ Registered points (see ``docs/Resilience.md``):
 Rules are **counter-based, never random** — the same spec replays the
 same failure.  Spec grammar (comma/semicolon-separated)::
 
-    point:mode[*times][@nth]
+    point:mode[%rank<k>][*times][@nth]
 
 * ``mode`` — ``error`` (raise :class:`InjectedFault`), ``kill``
   (``SIGKILL`` this process: the un-catchable crash), ``torn``
@@ -39,6 +39,13 @@ same failure.  Spec grammar (comma/semicolon-separated)::
   ``guard.integrity.corrupt_block`` — silent data corruption on
   demand, so chaos tests can assert typed-error-or-bit-identical,
   never garbage).
+* ``%rank<k>`` — rank-addressed injection: the rule triggers only in
+  the process whose mesh rank is ``k`` (``PENCILARRAYS_TPU_CLUSTER_RANK``,
+  else the jax-assigned process id, else 0 — the cluster layer's
+  identity resolution), so ONE spec shared by every worker's
+  environment can kill/corrupt/hang a *specific* rank:
+  ``hop.exchange:corrupt%rank1@2`` poisons rank 1's second hop and
+  nobody else's.  ``@nth`` counts that rank's own local hits.
 * ``*times`` — trigger on that many consecutive hits (default: ``error``
   and ``corrupt`` forever, ``kill``/``torn`` once).
 * ``@nth`` — first trigger on the *nth* hit of the point (1-based,
@@ -55,6 +62,7 @@ changes, so a worker can arm itself after import).  Example::
 from __future__ import annotations
 
 import os
+import re
 import signal
 from contextlib import contextmanager
 from dataclasses import dataclass
@@ -97,9 +105,10 @@ MODES = frozenset({"error", "kill", "torn", "corrupt"})
 @dataclass(frozen=True)
 class Rule:
     point: str
-    mode: str                  # "error" | "kill" | "torn"
+    mode: str                  # "error" | "kill" | "torn" | "corrupt"
     times: Optional[int]       # consecutive triggering hits (None = forever)
     first: int = 1             # 1-based hit index of the first trigger
+    rank: Optional[int] = None   # %rank<k> selector (None = every rank)
 
     def triggers(self, hit: int) -> bool:
         if hit < self.first:
@@ -135,13 +144,22 @@ def parse(spec: str) -> List[Rule]:
             times = int(n)
         else:
             mode, times = rhs, None
+        rank: Optional[int] = None
+        if "%" in mode:
+            mode, sel = mode.split("%", 1)
+            m = re.match(r"^rank(\d+)$", sel.strip())
+            if not m:
+                raise ValueError(
+                    f"fault rule {raw!r}: selector {sel!r} is not "
+                    f"'rank<k>' (e.g. hop.exchange:corrupt%rank1@2)")
+            rank = int(m.group(1))
         mode = mode.strip()
         if mode not in MODES:
             raise ValueError(
                 f"fault rule {raw!r}: mode {mode!r} not in {sorted(MODES)}")
         if times is None and mode in ("kill", "torn"):
             times = 1  # a crash repeats at most per-process anyway
-        rules.append(Rule(point, mode, times, first))
+        rules.append(Rule(point, mode, times, first, rank))
     return rules
 
 
@@ -204,8 +222,22 @@ def _current_rules() -> Sequence[Rule]:
 def armed(point: str) -> bool:
     """Cheap probe: does any current rule target ``point``?  Hot paths
     use this to keep their no-faults fast path untouched (e.g. the
-    binary writer's in-thread block copies)."""
+    binary writer's in-thread block copies).  Deliberately ignores the
+    ``%rank`` selector (resolving identity is not probe-cheap): a rule
+    addressed to another rank makes this rank take the instrumented
+    path, where :func:`fire` then correctly does nothing."""
     return any(r.point == point for r in _current_rules())
+
+
+def _self_rank() -> int:
+    """This process's mesh rank for ``%rank<k>`` matching — delegated
+    to the cluster layer's ONE identity-resolution rule (env override
+    first, so FileKV drill workers are addressable before any jax
+    state exists).  Resolved lazily: only rules that carry a rank
+    selector ever pay for it."""
+    from ..cluster import rank
+
+    return rank()
 
 
 def kill_now() -> None:
@@ -254,6 +286,8 @@ def fire(point: str, **ctx) -> Optional[str]:
     for r in matching:
         if not r.triggers(hit):
             continue
+        if r.rank is not None and r.rank != _self_rank():
+            continue   # addressed to another rank; counters still tick
         _obs_firing(point, r.mode, hit, ctx)
         if r.mode == "kill":
             kill_now()

@@ -1,0 +1,157 @@
+"""Worker for the coordinated mesh-recovery drills.
+
+Unlike ``restart_worker.py`` (which exercises the ``jax.distributed``
+data path), these workers drill the **cluster coordination layer** over
+its filesystem KV backend: N plain OS processes, each a self-contained
+single-process jax (1 local device, no cross-process collectives — so
+the drill runs on any backend), joined ONLY through a shared
+``FileKV`` directory.  That isolates exactly what PR 6 adds: status
+consensus, checkpoint election, health leases and epochs — the
+machinery that must behave identically over the jax distributed KV
+store on a real pod.
+
+Phases (launched by ``test_multiprocess.py``; each phase gets a fresh
+KV namespace — a KV root is one job incarnation):
+
+* ``sdc`` — every rank commits checkpoint steps 1 (ground truth) and 2
+  (diverged), then rank 0's step-2 data file is torn (bitflip).  All
+  ranks run a distributed ``guarded_step`` whose exchange is corrupted
+  on rank 1 only (``hop.exchange:corrupt%rank1*2`` — the SAME spec in
+  every worker's env; the ``%rank`` selector does the addressing).
+  The mesh must agree: retry (rank 1 corrupt again) → coordinated
+  restore of step **1** — the newest step valid on EVERY rank, even
+  though rank 1's own ``latest_valid()`` is 2 — → rerun, bit-identical
+  to ground truth, no deadlock.
+* ``kill`` — every rank commits step 1, then runs a guarded step in
+  which rank ``<world-2>`` is SIGKILLed by ``hop.exchange:kill%rank<v>``
+  mid-step.  Survivors must exit with a typed ``PeerFailureError``
+  naming the dead rank (crash bundle written) within the lease
+  deadline — NOT hang until the watchdog/verdict timeout.
+* ``restore`` — fresh processes (all ranks, including the previous
+  victim's slot) elect ``common_latest_valid()`` and restore it: the
+  coordinated-restore rerun must be bit-identical to ground truth.
+
+Usage::
+
+    python cluster_worker.py <kvroot> <world> <rank> <tmpdir> <phase>
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    kvroot, world, rank, tmpdir, phase = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+        sys.argv[5])
+    # one local device per worker: the drill exercises coordination,
+    # not collectives — each rank's compute is self-contained
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1")
+    # arm the cluster layer BEFORE importing anything heavy: identity
+    # and gate are env-read (the late-arming contract), and the obs
+    # journal attributes records to this mesh rank
+    os.environ["PENCILARRAYS_TPU_CLUSTER"] = os.path.join(kvroot, phase)
+    os.environ["PENCILARRAYS_TPU_CLUSTER_RANK"] = str(rank)
+    os.environ["PENCILARRAYS_TPU_CLUSTER_WORLD"] = str(world)
+    os.environ.setdefault("PENCILARRAYS_TPU_CLUSTER_LEASE_TTL", "2.0")
+    os.environ.setdefault("PENCILARRAYS_TPU_CLUSTER_VERDICT_TIMEOUT", "60")
+    os.environ["PENCILARRAYS_TPU_OBS"] = os.path.join(tmpdir, "obs")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu import guard
+    from pencilarrays_tpu.cluster import PeerFailureError
+    from pencilarrays_tpu.resilience import CheckpointManager, RetryPolicy
+
+    guard.enable(os.path.join(tmpdir, "bundles", f"r{rank}"))
+    shape = (11, 9, 13)
+    truth = np.random.default_rng(11).standard_normal(shape)
+    topo = pa.Topology((1,))
+    pen = pa.Pencil(topo, shape, (1,))
+    pen2 = pa.Pencil(topo, shape, (0,))
+    ckdir = os.path.join(tmpdir, f"ck-{'kill' if phase == 'restore' else phase}.r{rank}")
+    mgr = CheckpointManager(ckdir, keep=4)
+    victim = max(0, world - 2)  # the rank the kill drill SIGKILLs
+
+    if phase == "sdc":
+        mgr.save(1, {"u": pa.PencilArray.from_global(pen, truth)})
+        mgr.save(2, {"u": pa.PencilArray.from_global(pen, truth + 5.0)})
+        if rank == 0:
+            # tear rank 0's NEWEST step: the divergent-restore hazard —
+            # rank 1's latest_valid() is still 2, the mesh must agree on 1
+            path = os.path.join(ckdir, "step-00000002", "data.bin")
+            with open(path, "r+b") as f:
+                f.seek(64)
+                b = f.read(1)
+                f.seek(64)
+                f.write(bytes([b[0] ^ 0xFF]))
+        # the SAME fault spec in every worker: %rank1 does the addressing
+        os.environ["PENCILARRAYS_TPU_FAULTS"] = \
+            "hop.exchange:corrupt%rank1*2"
+        state = {"u": pa.PencilArray.from_global(pen, truth + 1000.0)}
+
+        def step():
+            return pa.transpose(state["u"], pen2)
+
+        def restore_cb(ckpt):
+            state["u"] = ckpt.read("u", pen)
+
+        out = guard.guarded_step(
+            step, ckpt_mgr=mgr, restore=restore_cb,
+            retry=RetryPolicy(max_attempts=2, base_delay=0.01),
+            label="cluster-sdc")
+        assert np.array_equal(pa.gather(out), truth), \
+            "coordinated recovery is not bit-identical to ground truth"
+    elif phase == "kill":
+        mgr.save(1, {"u": pa.PencilArray.from_global(pen, truth)})
+        os.environ["PENCILARRAYS_TPU_FAULTS"] = \
+            f"hop.exchange:kill%rank{victim}@1"
+        state = {"u": pa.PencilArray.from_global(pen, truth)}
+
+        def step():
+            return pa.transpose(state["u"], pen2)
+
+        t0 = time.monotonic()
+        try:
+            guard.guarded_step(step, ckpt_mgr=mgr,
+                               restore=lambda c: None,
+                               retry=RetryPolicy(max_attempts=2,
+                                                 base_delay=0.01),
+                               label="cluster-kill")
+        except PeerFailureError as e:
+            detect_s = time.monotonic() - t0
+            assert e.rank == victim, f"wrong peer named: {e.rank}"
+            assert e.bundle and os.path.isdir(e.bundle), \
+                f"no crash bundle on PeerFailureError: {e.bundle!r}"
+            with open(os.path.join(e.bundle, "MANIFEST.json")) as f:
+                man = json.load(f)
+            assert man["reason"] == "peer-failure", man["reason"]
+            print(f"CLUSTER_OK phase=kill rank={rank} "
+                  f"peerfail={e.rank} detect_s={detect_s:.2f}")
+            return
+        raise SystemExit(
+            f"rank {rank}: expected SIGKILL (rank {victim}) or "
+            f"PeerFailureError (survivors) — got a clean step")
+    elif phase == "restore":
+        # fresh incarnation after the kill: EVERY rank (including the
+        # victim's replacement) elects the common step and restores it
+        step = mgr.common_latest_valid()
+        assert step == 1, f"expected agreed step 1, got {step}"
+        back = mgr.restore(step).read("u", pen)
+        assert np.array_equal(pa.gather(back), truth), \
+            "coordinated restore is not bit-identical to ground truth"
+    else:
+        raise SystemExit(f"unknown phase {phase!r}")
+    print(f"CLUSTER_OK phase={phase} rank={rank}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,726 @@
+"""Mesh coordination layer: consensus, leases, epochs, election.
+
+The contracts under test (ISSUE 6 acceptance):
+
+* **one agreed action** — at a step boundary every rank's status enters
+  one deterministic merge; the mesh atomically picks ok / all-retry /
+  all-restore / all-re-raise, with identical verdicts and epochs on
+  every rank (two in-process ranks over a shared ``FileKV`` drill it
+  without subprocesses);
+* **agreed-checkpoint election** — ``common_latest_valid()`` returns
+  the newest step valid on EVERY rank: the divergent-restore hazard
+  (one rank's newest step torn → per-rank ``latest_valid()`` disagree)
+  is regression-pinned;
+* **peer health leases** — a peer that stops heartbeating (or never
+  joins) surfaces as a typed ``PeerFailureError`` naming the rank, with
+  a crash bundle — never an indefinite wait;
+* **rank-addressed faults** — ``point:mode%rank<k>`` triggers only in
+  the named rank's process;
+* **degrade-to-local** — with the layer off (or ``world == 1``) the
+  guarded_step path never builds a coordinator and single-process
+  behavior is untouched.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu import cluster, guard, obs
+from pencilarrays_tpu.cluster import (ClusterAbortError,
+                                      ConsensusTimeoutError,
+                                      PeerFailureError, epoch)
+from pencilarrays_tpu.cluster.consensus import Coordinator, merge_statuses
+from pencilarrays_tpu.cluster.health import LeaseBoard
+from pencilarrays_tpu.cluster.kv import FileKV
+from pencilarrays_tpu.guard import IntegrityError
+from pencilarrays_tpu.obs import events as obs_events
+from pencilarrays_tpu.obs import metrics as obs_metrics
+from pencilarrays_tpu.resilience import CheckpointManager, RetryPolicy, faults
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Every test starts with cluster/guard/obs disabled, faults
+    cleared, epoch 0."""
+    for var in (cluster.ENV_VAR, cluster.RANK_VAR, cluster.WORLD_VAR,
+                cluster.LEASE_TTL_VAR, cluster.VERDICT_TIMEOUT_VAR,
+                guard.ENV_VAR, obs.ENV_VAR, faults.ENV_VAR):
+        monkeypatch.delenv(var, raising=False)
+    cluster._reset_for_tests()
+    guard._reset_for_tests()
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    yield
+    cluster._reset_for_tests()
+    guard._reset_for_tests()
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+
+
+def _pair(tmp_path, *, ttl=10.0, timeout=30.0, sub="kv"):
+    kv = FileKV(os.path.join(str(tmp_path), sub))
+    return (Coordinator(kv, 0, 2, lease_ttl=ttl, verdict_timeout=timeout),
+            Coordinator(kv, 1, 2, lease_ttl=ttl, verdict_timeout=timeout))
+
+
+def _run_ranks(*thunks):
+    """Run one callable per rank on its own thread (the in-process
+    two-rank mesh); re-raises the first failure, returns rank->result."""
+    results, errors = {}, {}
+
+    def wrap(r, fn):
+        try:
+            results[r] = fn()
+        except BaseException as e:   # noqa: BLE001 - re-raised below
+            errors[r] = e
+
+    threads = [threading.Thread(target=wrap, args=(r, fn))
+               for r, fn in enumerate(thunks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    if errors:
+        raise errors[min(errors)]
+    return results
+
+
+# ---------------------------------------------------------------------------
+# KV backend
+# ---------------------------------------------------------------------------
+
+def test_filekv_roundtrip(tmp_path):
+    kv = FileKV(str(tmp_path))
+    assert kv.try_get("a/b/r0") is None
+    kv.set("a/b/r0", "hello")
+    assert kv.try_get("a/b/r0") == "hello"
+    assert kv.get("a/b/r0", 1.0) == "hello"
+    kv.set("a/b/r0", "v2")          # overwrite is atomic publish
+    assert kv.try_get("a/b/r0") == "v2"
+    kv.delete("a/b/r0")
+    assert kv.try_get("a/b/r0") is None
+    kv.delete("a/b/r0")             # idempotent
+
+
+def test_filekv_get_timeout_is_typed(tmp_path):
+    kv = FileKV(str(tmp_path))
+    t0 = time.monotonic()
+    with pytest.raises(ConsensusTimeoutError) as ei:
+        kv.get("never/r9", 0.3)
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.key == "never/r9"
+
+
+def test_filekv_rejects_traversal_keys(tmp_path):
+    kv = FileKV(str(tmp_path))
+    with pytest.raises(ValueError):
+        kv.set("../escape", "x")
+    with pytest.raises(ValueError):
+        kv.set("a/&bad", "x")
+
+
+def test_filekv_get_on_wait_can_interrupt(tmp_path):
+    kv = FileKV(str(tmp_path))
+
+    def boom():
+        raise PeerFailureError("peer gone", rank=1)
+
+    with pytest.raises(PeerFailureError):
+        kv.get("never/r1", 10.0, on_wait=boom)
+
+
+# ---------------------------------------------------------------------------
+# verdict merge (pure)
+# ---------------------------------------------------------------------------
+
+def test_merge_all_ok():
+    v = merge_statuses([{"status": "ok"}, {"status": "ok"}])
+    assert v["action"] == "ok" and v["ranks"] == []
+
+
+def test_merge_retry_needs_everyones_budget():
+    ok = {"status": "ok", "can_retry": True, "can_restore": True}
+    bad = {"status": "integrity", "can_retry": True, "can_restore": True,
+           "error": "sdc"}
+    v = merge_statuses([ok, bad])
+    assert v["action"] == "retry" and v["ranks"] == [1]
+    # ONE exhausted rank forbids the all-retry (a half-mesh rerun would
+    # deadlock): escalate to restore
+    v = merge_statuses([dict(ok, can_retry=False), bad])
+    assert v["action"] == "restore"
+
+
+def test_merge_raise_when_nothing_left():
+    v = merge_statuses([
+        {"status": "hang", "can_retry": False, "can_restore": False,
+         "error": "stuck"},
+        {"status": "ok", "can_retry": False, "can_restore": True}])
+    assert v["action"] == "raise"
+    assert v["ranks"] == [0] and v["errors"][0] == "stuck"
+
+
+# ---------------------------------------------------------------------------
+# consensus rounds + epochs (two in-process ranks)
+# ---------------------------------------------------------------------------
+
+def test_two_rank_verdict_identical_and_epoch_advances(tmp_path):
+    obs.enable(str(tmp_path / "obs"))
+    c0, c1 = _pair(tmp_path)
+    try:
+        ok = {"status": "ok", "can_retry": True, "can_restore": True}
+        bad = dict(ok, status="integrity", error="sdc")
+        res = _run_ranks(lambda: c0.agree("step", ok),
+                         lambda: c1.agree("step", bad))
+        assert res[0] == res[1]
+        assert res[0]["action"] == "retry" and res[0]["epoch"] == 1
+        # a clean round does NOT advance the epoch
+        res = _run_ranks(lambda: c0.agree("step", ok),
+                         lambda: c1.agree("step", ok))
+        assert res[0]["action"] == "ok" and res[0]["epoch"] == 1
+        assert epoch.current() == 1
+        events = obs.read_journal(str(tmp_path / "obs"))
+        assert obs.lint_journal(events) == []
+        advances = [e for e in events if e["ev"] == "guard.epoch"]
+        assert [e["epoch"] for e in advances] == [1]
+        verdicts = [e for e in events if e["ev"] == "cluster.verdict"]
+        assert {e["action"] for e in verdicts} == {"retry", "ok"}
+        snap = obs.snapshot()
+        assert any(k.startswith("cluster.verdicts{")
+                   for k in snap["counters"]), snap["counters"]
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+        obs.disable()
+
+
+def test_round_keys_garbage_collected(tmp_path):
+    """The KV store must stay bounded on the armed path: round keys
+    are GC'd with a one-round lag (≤ 2 live round keys per rank), not
+    accumulated one per step boundary forever."""
+    import glob
+
+    c0, c1 = _pair(tmp_path)
+    ok = {"status": "ok", "can_retry": True, "can_restore": False}
+    try:
+        for _ in range(5):
+            _run_ranks(lambda: c0.agree("step", ok),
+                       lambda: c1.agree("step", ok))
+        round_files = glob.glob(
+            os.path.join(str(tmp_path), "kv", "pa", "round", "**", "r*"),
+            recursive=True)
+        assert len(round_files) <= 4, sorted(round_files)
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+
+
+def test_gate_tokens_case_insensitive(tmp_path, monkeypatch):
+    """``OFF`` must be off and ``True`` must mean the jax KV backend —
+    never a relative FileKV directory literally named ``True``."""
+    from pencilarrays_tpu.cluster.kv import FileKV as _FileKV, resolve_kv
+
+    for off in ("OFF", "Off", "FALSE", "0"):
+        monkeypatch.setenv(cluster.ENV_VAR, off)
+        assert not cluster.enabled(), off
+        assert cluster.coordinator() is None
+    for on in ("True", "ON", "1"):
+        # the jax-KV backend (no client in this process -> a clear
+        # RuntimeError, NOT a silent FileKV('True'))
+        with pytest.raises(RuntimeError, match="no jax distributed KV"):
+            resolve_kv(on)
+    assert isinstance(resolve_kv(str(tmp_path / "kv")), _FileKV)
+
+
+def test_agree_steps_intersection(tmp_path):
+    c0, c1 = _pair(tmp_path)
+    try:
+        res = _run_ranks(lambda: c0.agree_steps("ck", [1, 2, 3]),
+                         lambda: c1.agree_steps("ck", [1, 3, 4]))
+        assert res[0] == res[1] == [1, 3]
+        res = _run_ranks(lambda: c0.agree_steps("ck", [7]),
+                         lambda: c1.agree_steps("ck", []))
+        assert res[0] == res[1] == []
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# peer health leases
+# ---------------------------------------------------------------------------
+
+def test_lease_expiry_raises_typed_peer_failure(tmp_path):
+    guard.enable(str(tmp_path / "bundles"))
+    kv = FileKV(str(tmp_path / "kv"))
+    a = LeaseBoard(kv, 0, 2, ttl=0.3)
+    b = LeaseBoard(kv, 1, 2, ttl=0.3)
+    a.start()
+    b.start()
+    a.check_peers()                  # both alive
+    b.stop()                         # rank 1 "dies": renewals stop
+    time.sleep(0.9)
+    with pytest.raises(PeerFailureError) as ei:
+        a.check_peers()
+    e = ei.value
+    assert e.rank == 1 and e.age_s > 0.3
+    assert e.bundle and os.path.isdir(e.bundle)
+    with open(os.path.join(e.bundle, "MANIFEST.json")) as f:
+        man = json.load(f)
+    assert man["reason"] == "peer-failure" and man["peer_rank"] == 1
+    a.stop()
+
+
+def test_never_joined_peer_fails_after_grace(tmp_path):
+    guard.enable(str(tmp_path / "bundles"))
+    kv = FileKV(str(tmp_path / "kv"))
+    a = LeaseBoard(kv, 0, 2, ttl=0.2)
+    a.join_grace = 0.4               # drills shrink the boot window
+    a.start()
+    a.check_peers()                  # inside the grace: no verdict yet
+    time.sleep(0.5)
+    with pytest.raises(PeerFailureError) as ei:
+        a.check_peers()
+    assert ei.value.rank == 1 and ei.value.age_s is None
+    a.stop()
+
+
+def test_transient_lease_read_failure_is_not_death(tmp_path):
+    """A single unreadable lease read (KV weather, or an old-jaxlib
+    delete+set renewal caught mid-flight) must NOT fabricate a peer
+    death: staleness is judged against the last KNOWN renewal."""
+    kv = FileKV(str(tmp_path / "kv"))
+    a = LeaseBoard(kv, 0, 2, ttl=5.0)
+    b = LeaseBoard(kv, 1, 2, ttl=5.0)
+    a.join_grace = 0.0               # past the grace window
+    a.start()
+    b.start()
+    a.check_peers()                  # b's lease read + remembered
+    kv.delete("pa/lease/r1")         # one renewal caught mid-flight
+    a.check_peers()                  # remembered timestamp: still alive
+    b.renew()                        # the renewal lands
+    a.check_peers()
+    a.stop()
+    b.stop()
+
+
+def test_join_grace_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv(cluster.ENV_VAR, str(tmp_path / "kv"))
+    monkeypatch.setenv(cluster.RANK_VAR, "0")
+    monkeypatch.setenv(cluster.WORLD_VAR, "2")
+    monkeypatch.setenv(cluster.JOIN_GRACE_VAR, "123.5")
+    c = cluster.coordinator()
+    assert c.leases.join_grace == 123.5
+    cluster._reset_for_tests()
+
+
+def test_lease_renewal_keeps_peer_alive(tmp_path):
+    kv = FileKV(str(tmp_path / "kv"))
+    a = LeaseBoard(kv, 0, 2, ttl=0.5, interval=0.1)
+    b = LeaseBoard(kv, 1, 2, ttl=0.5, interval=0.1)
+    a.start()
+    b.start()
+    for _ in range(4):               # > ttl of wall time, renewals riding
+        time.sleep(0.2)
+        a.check_peers()
+        b.check_peers()
+    a.stop()
+    b.stop()
+
+
+# ---------------------------------------------------------------------------
+# agreed-checkpoint election: the divergent-restore regression
+# ---------------------------------------------------------------------------
+
+def _mk_state(truth):
+    import jax
+
+    topo = pa.Topology((1,), devices=jax.devices()[:1])
+    pen = pa.Pencil(topo, truth.shape, (1,))
+    return pen, pa.PencilArray.from_global(pen, truth)
+
+
+def _tear(ckdir, step):
+    """Flip one byte of a committed step's data file: the checkpoint
+    still parses but its checksum verification must fail."""
+    path = os.path.join(ckdir, f"step-{step:08d}", "data.bin")
+    with open(path, "r+b") as f:
+        f.seek(64)
+        b = f.read(1)
+        f.seek(64)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_common_latest_valid_agrees_on_oldest_common_step(tmp_path):
+    """THE divergent-restore hazard, pinned: rank 0's newest step is
+    torn, so its latest_valid() is 1 while rank 1's is 2 — a per-rank
+    restore would load DIFFERENT steps.  common_latest_valid() must
+    return 1 on BOTH ranks, and both restores must be bit-identical."""
+    truth = np.random.default_rng(3).standard_normal((11, 9, 13))
+    pen, u1 = _mk_state(truth)
+    _, u2 = _mk_state(truth + 5.0)
+    mgrs = {}
+    for r in range(2):
+        mgrs[r] = CheckpointManager(str(tmp_path / f"ck{r}"), keep=4)
+        mgrs[r].save(1, {"u": u1})
+        mgrs[r].save(2, {"u": u2})
+    _tear(str(tmp_path / "ck0"), 2)
+    # the hazard exists: local answers diverge
+    assert mgrs[0].latest_valid() == 1
+    assert mgrs[1].latest_valid() == 2
+    c0, c1 = _pair(tmp_path)
+    try:
+        res = _run_ranks(
+            lambda: mgrs[0].common_latest_valid(coordinator=c0),
+            lambda: mgrs[1].common_latest_valid(coordinator=c1))
+        assert res[0] == res[1] == 1
+        backs = _run_ranks(
+            lambda: pa.gather(mgrs[0].restore(1).read("u", pen)),
+            lambda: pa.gather(mgrs[1].restore(1).read("u", pen)))
+        assert np.array_equal(backs[0], truth)
+        assert np.array_equal(backs[0], backs[1])
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+
+
+def test_common_latest_valid_none_when_no_common_step(tmp_path):
+    truth = np.random.default_rng(4).standard_normal((8, 6, 4))
+    _, u = _mk_state(truth)
+    m0 = CheckpointManager(str(tmp_path / "ck0"), keep=4)
+    m1 = CheckpointManager(str(tmp_path / "ck1"), keep=4)
+    m0.save(1, {"u": u})
+    m1.save(2, {"u": u})
+    c0, c1 = _pair(tmp_path)
+    try:
+        res = _run_ranks(lambda: m0.common_latest_valid(coordinator=c0),
+                         lambda: m1.common_latest_valid(coordinator=c1))
+        assert res[0] is None and res[1] is None
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+
+
+def test_common_latest_valid_degrades_to_latest_valid(tmp_path):
+    """No coordinator (layer off / world 1): exactly latest_valid()."""
+    truth = np.random.default_rng(5).standard_normal((8, 6, 4))
+    _, u = _mk_state(truth)
+    m = CheckpointManager(str(tmp_path / "ck"), keep=4)
+    m.save(3, {"u": u})
+    assert m.common_latest_valid() == m.latest_valid() == 3
+    assert m.valid_steps() == [3]
+
+
+# ---------------------------------------------------------------------------
+# distributed guarded_step (two in-process ranks)
+# ---------------------------------------------------------------------------
+
+def test_mesh_guarded_step_agreed_retry(tmp_path):
+    """One rank's transient failure: the mesh agrees retry, EVERY rank
+    reruns (the healthy one too — a half-mesh rerun would deadlock its
+    collectives), both recover."""
+    obs.enable(str(tmp_path / "obs"))
+    c0, c1 = _pair(tmp_path)
+    calls = {0: 0, 1: 0}
+
+    def make(r, coord):
+        def run():
+            def step():
+                calls[r] += 1
+                if r == 1 and calls[r] == 1:
+                    raise IntegrityError("sdc", hop="t", kind="sum")
+                return r * 10 + calls[r]
+            return guard.guarded_step(
+                step, retry=RetryPolicy(max_attempts=3, base_delay=0.01),
+                label="mesh-retry", coordinator=coord)
+        return run
+
+    try:
+        res = _run_ranks(make(0, c0), make(1, c1))
+        assert calls == {0: 2, 1: 2}       # BOTH ranks reran
+        assert res == {0: 2, 1: 12}
+        events = obs.read_journal(str(tmp_path / "obs"))
+        assert obs.lint_journal(events) == []
+        actions = [e["action"] for e in events
+                   if e["ev"] == "cluster.verdict"]
+        assert sorted(actions) == ["ok", "ok", "retry", "retry"]
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+        obs.disable()
+
+
+def test_mesh_guarded_step_agreed_raise_is_typed_everywhere(tmp_path):
+    """Unrecoverable failure on one rank: the failing rank re-raises
+    its own typed error, the HEALTHY rank raises ClusterAbortError
+    naming it — nobody hangs, nobody acts alone."""
+    c0, c1 = _pair(tmp_path)
+
+    def rank0():
+        with pytest.raises(ClusterAbortError) as ei:
+            guard.guarded_step(lambda: 0,
+                               retry=RetryPolicy(max_attempts=1),
+                               label="mesh-raise", coordinator=c0)
+        assert ei.value.ranks == (1,)
+        assert "IntegrityError" in ei.value.errors[1]
+        return True
+
+    def rank1():
+        def step():
+            raise IntegrityError("sdc", hop="t", kind="sum")
+        with pytest.raises(IntegrityError):
+            guard.guarded_step(step, retry=RetryPolicy(max_attempts=1),
+                               label="mesh-raise", coordinator=c1)
+        return True
+
+    try:
+        res = _run_ranks(rank0, rank1)
+        assert res == {0: True, 1: True}
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+
+
+def test_mesh_guarded_step_restores_agreed_step(tmp_path):
+    """Retry budget exhausted: the mesh restores the SAME elected step
+    on both ranks and reruns bit-identically (rank 0's newest step is
+    torn, so the agreed step is the older common one)."""
+    truth = np.random.default_rng(7).standard_normal((11, 9, 13))
+    pen, u1 = _mk_state(truth)
+    pen2 = pa.Pencil(pen.topology, truth.shape, (0,))
+    c0, c1 = _pair(tmp_path)
+    mgrs, states = {}, {}
+    for r in range(2):
+        mgrs[r] = CheckpointManager(str(tmp_path / f"ck{r}"), keep=4)
+        mgrs[r].save(1, {"u": u1})
+        mgrs[r].save(2, {"u": _mk_state(truth + 5.0)[1]})
+        states[r] = {"u": _mk_state(truth + 1000.0)[1]}   # diverged
+    _tear(str(tmp_path / "ck0"), 2)
+    calls = {0: 0, 1: 0}
+
+    def make(r, coord):
+        def run():
+            def step():
+                calls[r] += 1
+                if r == 1 and calls[r] <= 2:
+                    raise IntegrityError("sdc", hop="t", kind="sum")
+                return pa.transpose(states[r]["u"], pen2)
+
+            def restore_cb(ckpt):
+                states[r]["u"] = ckpt.read("u", pen)
+
+            return guard.guarded_step(
+                step, ckpt_mgr=mgrs[r], restore=restore_cb,
+                retry=RetryPolicy(max_attempts=2, base_delay=0.01),
+                label="mesh-restore", coordinator=coord)
+        return run
+
+    try:
+        res = _run_ranks(make(0, c0), make(1, c1))
+        assert np.array_equal(pa.gather(res[0]), truth)
+        assert np.array_equal(pa.gather(res[1]), truth)
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+
+
+def test_mesh_guarded_step_non_ladder_error_unblocks_peers(tmp_path):
+    """A non-ladder exception (app bug) still propagates untouched on
+    the failing rank — but never as a SILENT one-sided exit: the rank
+    posts a fatal status for the round, so the healthy peer gets a
+    prompt typed ClusterAbortError (not a verdict-timeout burn), and
+    the round counters stay aligned for the next step."""
+    c0, c1 = _pair(tmp_path, timeout=60.0)
+
+    def rank0():
+        t0 = time.monotonic()
+        with pytest.raises(ClusterAbortError) as ei:
+            guard.guarded_step(lambda: 0, label="app-bug",
+                               retry=RetryPolicy(max_attempts=1),
+                               coordinator=c0)
+        assert ei.value.ranks == (1,)
+        assert "ValueError" in ei.value.errors[1]
+        assert time.monotonic() - t0 < 30.0   # not a timeout burn
+        # rounds still aligned: the NEXT step reaches consensus
+        return guard.guarded_step(lambda: "next", label="app-bug",
+                                  retry=RetryPolicy(max_attempts=1),
+                                  coordinator=c0)
+
+    def rank1():
+        def step():
+            raise ValueError("app bug, not SDC")
+        with pytest.raises(ValueError):
+            guard.guarded_step(step, label="app-bug",
+                               retry=RetryPolicy(max_attempts=1),
+                               coordinator=c1)
+        return guard.guarded_step(lambda: "next", label="app-bug",
+                                  retry=RetryPolicy(max_attempts=1),
+                                  coordinator=c1)
+
+    try:
+        res = _run_ranks(rank0, rank1)
+        assert res == {0: "next", 1: "next"}
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+
+
+def test_mesh_guarded_step_peer_death_mid_step(tmp_path):
+    """A rank that dies inside the step (its thread just stops
+    heartbeating and never reaches the verdict exchange): the survivor
+    gets a typed PeerFailureError from the lease check during its
+    consensus wait — not a hang until the verdict timeout."""
+    guard.enable(str(tmp_path / "bundles"))
+    kv = FileKV(str(tmp_path / "kv"))
+    c0 = Coordinator(kv, 0, 2, lease_ttl=0.4, verdict_timeout=60.0)
+    c1 = Coordinator(kv, 1, 2, lease_ttl=0.4, verdict_timeout=60.0)
+
+    def rank0():
+        t0 = time.monotonic()
+        with pytest.raises(PeerFailureError) as ei:
+            guard.guarded_step(lambda: 0, label="mesh-death",
+                               retry=RetryPolicy(max_attempts=1),
+                               coordinator=c0)
+        assert ei.value.rank == 1
+        assert time.monotonic() - t0 < 30.0   # lease-fast, not timeout
+        return True
+
+    def rank1():
+        c1.shutdown()                 # "dies": lease renewals stop
+        return True
+
+    try:
+        res = _run_ranks(rank0, rank1)
+        assert res == {0: True, 1: True}
+    finally:
+        c0.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# gate / identity / degrade-to-local
+# ---------------------------------------------------------------------------
+
+def test_gate_disabled_by_default_and_cheap():
+    assert not cluster.enabled()
+    assert cluster.coordinator() is None
+
+
+def test_gate_world_one_degrades_to_local(tmp_path, monkeypatch):
+    """Env armed but a single-process mesh: coordinator() is None (the
+    local ladder runs untouched)."""
+    monkeypatch.setenv(cluster.ENV_VAR, str(tmp_path / "kv"))
+    assert cluster.enabled()
+    assert cluster.world_size() == 1
+    assert cluster.coordinator() is None
+
+
+def test_gate_identity_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(cluster.RANK_VAR, "3")
+    monkeypatch.setenv(cluster.WORLD_VAR, "5")
+    assert cluster.rank() == 3
+    assert cluster.world_size() == 5
+
+
+def test_guarded_step_local_path_never_builds_coordinator(monkeypatch):
+    """Degrade contract (acceptance c): with the layer off, guarded_step
+    must not even construct a Coordinator — the PR-5 local ladder runs
+    as-is."""
+    from pencilarrays_tpu.cluster import consensus as consensus_mod
+
+    def boom(*a, **k):
+        raise AssertionError("Coordinator built on the disabled path")
+
+    monkeypatch.setattr(consensus_mod, "Coordinator", boom)
+    assert guard.guarded_step(lambda: 42) == 42
+
+
+def test_env_built_coordinator_and_reset(tmp_path, monkeypatch):
+    monkeypatch.setenv(cluster.ENV_VAR, str(tmp_path / "kv"))
+    monkeypatch.setenv(cluster.RANK_VAR, "0")
+    monkeypatch.setenv(cluster.WORLD_VAR, "2")
+    c = cluster.coordinator()
+    assert c is not None and c.rank == 0 and c.world == 2
+    assert cluster.coordinator() is c          # cached
+    cluster.disable()
+    assert cluster.coordinator() is None       # programmatic off wins
+    cluster._reset_for_tests()
+    assert cluster.coordinator() is not None   # env applies again
+
+
+# ---------------------------------------------------------------------------
+# rank-addressed fault injection (%rank<k>)
+# ---------------------------------------------------------------------------
+
+def test_faults_rank_selector_parse():
+    (r,) = faults.parse("hop.exchange:corrupt%rank1@2")
+    assert (r.point, r.mode, r.rank, r.first, r.times) == \
+        ("hop.exchange", "corrupt", 1, 2, None)
+    (r,) = faults.parse("hop.exchange:kill%rank2")
+    assert (r.mode, r.rank, r.times) == ("kill", 2, 1)
+    (r,) = faults.parse("io.write_block:torn%rank0*3@2")
+    assert (r.mode, r.rank, r.times, r.first) == ("torn", 0, 3, 2)
+    with pytest.raises(ValueError, match="rank<k>"):
+        faults.parse("hop.exchange:corrupt%node1")
+    with pytest.raises(ValueError):
+        faults.parse("hop.exchange:corrupt%rank")
+
+
+def test_faults_rank_selector_addresses_one_rank(monkeypatch):
+    monkeypatch.setenv(cluster.RANK_VAR, "0")
+    with faults.active("barrier:error%rank1"):
+        assert faults.fire("barrier") is None      # not us: no trigger
+        assert faults.hit_count("barrier") == 1    # counters still tick
+    monkeypatch.setenv(cluster.RANK_VAR, "1")
+    from pencilarrays_tpu.resilience.errors import InjectedFault
+
+    with faults.active("barrier:error%rank1"):
+        with pytest.raises(InjectedFault):
+            faults.fire("barrier")
+
+
+def test_faults_unselected_rules_unchanged():
+    (r,) = faults.parse("io.open:error*2@3")
+    assert r.rank is None
+
+
+# ---------------------------------------------------------------------------
+# recovery epochs: stamps in manifests, bundles, journal
+# ---------------------------------------------------------------------------
+
+def test_epoch_monotonic_and_journaled(tmp_path):
+    obs.enable(str(tmp_path / "obs"))
+    assert epoch.current() == 0
+    assert epoch.advance("test") == 1
+    assert epoch.set_current(5, "jump") == 5
+    assert epoch.set_current(3, "rewind-ignored") == 5   # monotonic
+    events = obs.read_journal(str(tmp_path / "obs"))
+    assert obs.lint_journal(events) == []
+    assert [e["epoch"] for e in events if e["ev"] == "guard.epoch"] == [1, 5]
+    obs.disable()
+
+
+def test_epoch_stamped_into_checkpoint_manifest(tmp_path):
+    truth = np.random.default_rng(8).standard_normal((8, 6, 4))
+    _, u = _mk_state(truth)
+    m = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    m.save(1, {"u": u})
+    epoch.advance("test-advance")
+    m.save(2, {"u": u})
+    with open(str(tmp_path / "ck" / "step-00000001" / "MANIFEST.json")) as f:
+        assert json.load(f)["epoch"] == 0
+    with open(str(tmp_path / "ck" / "step-00000002" / "MANIFEST.json")) as f:
+        assert json.load(f)["epoch"] == 1
+
+
+def test_epoch_stamped_into_crash_bundle(tmp_path):
+    guard.enable(str(tmp_path / "bundles"))
+    epoch.set_current(7, "test")
+    path = guard.write_crash_bundle("test", "epoch-stamp")
+    with open(os.path.join(path, "MANIFEST.json")) as f:
+        assert json.load(f)["epoch"] == 7

@@ -24,6 +24,7 @@ The contracts under test (ISSUE 5 acceptance):
 import json
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -537,6 +538,114 @@ def test_guarded_step_passthrough_other_errors(tmp_path):
     with pytest.raises(ZeroDivisionError):
         guard.guarded_step(lambda: 1 // 0,
                            retry=RetryPolicy(max_attempts=3))
+
+
+# ---------------------------------------------------------------------------
+# guarded_step: the deadline edge (recover.py's escalate-now branch)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_guarded_step_deadline_escalates_immediately(tmp_path):
+    """When the NEXT backoff delay would overshoot ``policy.deadline``
+    the ladder must escalate to the checkpoint restore NOW — not sleep
+    through a delay it already knows is over budget.  Pinned: no
+    ``retry`` stage is journaled, no backoff sleep happens (wall-clock
+    bound far below the 10 s delay), and the restore still recovers."""
+    obs.enable(str(tmp_path / "obs"))
+    guard.enable(str(tmp_path / "bundles"))
+    pen_x, pen_y, truth, u = _mk()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    state = {"u": u}
+    mgr.save(1, {"u": u})
+    state["u"] = pa.PencilArray.from_global(pen_x, truth + 1000.0)
+    calls = {"n": 0}
+
+    def step():
+        calls["n"] += 1
+        return pa.transpose(state["u"], pen_y)
+
+    def restore(ckpt):
+        state["u"] = ckpt.read("u", pen_x)
+
+    t0 = time.monotonic()
+    # 5 attempts of budget, but the first backoff (10 s) cannot fit the
+    # 0.05 s deadline: exactly ONE failing attempt, then escalate (the
+    # post-restore rerun is hit 2, past the rule's one firing)
+    with faults.active("hop.exchange:corrupt*1"):
+        out = guard.guarded_step(
+            step, ckpt_mgr=mgr, restore=restore,
+            retry=RetryPolicy(max_attempts=5, base_delay=10.0,
+                              max_delay=10.0, deadline=0.05),
+            label="deadline-drill")
+    assert time.monotonic() - t0 < 8.0, "the ladder slept through a " \
+        "backoff it knew exceeded the deadline"
+    assert calls["n"] == 2          # one failed attempt + the post-restore run
+    assert np.array_equal(np.asarray(pa.gather(out)), truth)
+    events = obs.read_journal(str(tmp_path / "obs"))
+    assert obs.lint_journal(events) == []
+    stages = [e["stage"] for e in events if e["ev"] == "guard.recover"]
+    assert stages == ["error", "restore", "recovered"], stages
+
+
+@pytest.mark.chaos
+def test_guarded_step_deadline_reraise_without_checkpoint(tmp_path):
+    """Same edge with no escalation rung: re-raise immediately instead
+    of sleeping out attempts the deadline cannot fund."""
+    guard.enable(str(tmp_path / "bundles"))
+    pen_x, pen_y, truth, u = _mk()
+    t0 = time.monotonic()
+    with faults.active("hop.exchange:corrupt*5"):
+        with pytest.raises(IntegrityError):
+            guard.guarded_step(
+                lambda: pa.transpose(u, pen_y),
+                retry=RetryPolicy(max_attempts=5, base_delay=10.0,
+                                  max_delay=10.0, deadline=0.05),
+                label="deadline-reraise")
+    assert time.monotonic() - t0 < 8.0
+
+
+def test_guarded_step_deadline_accounts_for_jitter(tmp_path, monkeypatch):
+    """The escalate-now decision uses the ACTUAL jittered delay, so a
+    jitter draw that overshoots the deadline escalates while a draw
+    that fits retries — delay_for's jitter stays inside the deadline
+    accounting, never silently beyond it."""
+    import random as _random
+
+    guard.enable(str(tmp_path / "bundles"))
+    pen_x, pen_y, truth, u = _mk()
+    # base 1.0s, jitter 0.25 -> delay in [0.75, 1.25]; deadline 1.2
+    policy = RetryPolicy(max_attempts=2, base_delay=1.0, max_delay=1.0,
+                         deadline=1.2, jitter=0.25)
+    # max-jitter draw (random()=1 -> factor 1.25): 1.25 > 1.2 deadline,
+    # must escalate without sleeping
+    monkeypatch.setattr(_random, "random", lambda: 1.0)
+    t0 = time.monotonic()
+    with faults.active("hop.exchange:corrupt*1"):
+        with pytest.raises(IntegrityError):
+            guard.guarded_step(lambda: pa.transpose(u, pen_y),
+                               retry=policy, label="jitter-over")
+    assert time.monotonic() - t0 < 0.7
+    faults.reset_counters()
+    # min-jitter draw (random()=0 -> factor 0.75): 0.75 <= 1.2, the
+    # retry happens and recovers
+    monkeypatch.setattr(_random, "random", lambda: 0.0)
+    with faults.active("hop.exchange:corrupt*1"):
+        out = guard.guarded_step(lambda: pa.transpose(u, pen_y),
+                                 retry=policy, label="jitter-under")
+    assert np.array_equal(np.asarray(pa.gather(out)), truth)
+
+
+def test_delay_for_jitter_bounds():
+    """delay_for stays inside [nominal*(1-jitter), nominal*(1+jitter)]
+    with the exponential curve capped at max_delay — THE bound the
+    deadline accounting above relies on."""
+    policy = RetryPolicy(base_delay=0.1, max_delay=1.0, jitter=0.25)
+    for attempt in range(1, 9):
+        nominal = min(0.1 * 2 ** (attempt - 1), 1.0)
+        for _ in range(50):
+            d = policy.delay_for(attempt)
+            assert nominal * 0.75 - 1e-12 <= d <= nominal * 1.25 + 1e-12
 
 
 # ---------------------------------------------------------------------------

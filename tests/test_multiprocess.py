@@ -6,6 +6,8 @@ mesh, including the sequence-parallel attention collectives crossing the
 process boundary."""
 
 import os
+import re
+import signal
 import socket
 import subprocess
 import sys
@@ -214,3 +216,158 @@ def test_kill_mid_checkpoint_write_multiprocess(tmp_path):
     (each tears its second block); recovery under a DIFFERENT process
     count (2) restores the last committed checkpoint bit-for-bit."""
     _run_kill_sequence(tmp_path, 4, 4, 2)
+
+
+# ---------------------------------------------------------------------------
+# cluster coordination drills (PR 6): consensus / leases / epochs over
+# the FileKV backend — N plain OS processes, no jax.distributed needed
+# ---------------------------------------------------------------------------
+
+def _launch_cluster_phase(tmp_path, world, phase, expect_kill_rank=None):
+    """Run one ``cluster_worker.py`` phase across ``world`` plain OS
+    processes sharing a FileKV namespace.  ``expect_kill_rank`` names
+    the one rank that must die by SIGKILL (the fault-injection victim);
+    every other rank must exit 0 with the phase sentinel."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "cluster_worker.py")
+    kvroot = os.path.join(str(tmp_path), "kv")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(here)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, kvroot, str(world), str(rank),
+             str(tmp_path), phase],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for rank in range(world)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=480)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        drained = list(outs)
+        for p in procs[len(outs):]:
+            try:
+                out, _ = p.communicate(timeout=10)
+            except Exception:
+                out = ""
+            drained.append(out or "")
+        pytest.fail(f"cluster {phase} workers timed out (a coordination "
+                    f"deadlock — exactly what the layer must prevent); "
+                    f"captured output:\n" + "\n---\n".join(drained))
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        if rank == expect_kill_rank:
+            assert p.returncode == -signal.SIGKILL, (
+                f"victim rank {rank} expected SIGKILL, got "
+                f"{p.returncode}:\n{out[-3000:]}")
+            continue
+        assert p.returncode == 0, (
+            f"cluster {phase} rank {rank} failed:\n{out[-3000:]}")
+        assert f"CLUSTER_OK phase={phase} rank={rank}" in out, out[-2000:]
+    return outs
+
+
+def _cluster_events(tmp_path):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from pencilarrays_tpu.obs import lint_journal, read_journal
+
+    events = read_journal(os.path.join(str(tmp_path), "obs"))
+    assert lint_journal(events) == [], lint_journal(events)[:5]
+    return events
+
+
+def _assert_cluster_sdc_timeline(tmp_path, world):
+    """Acceptance (a): EVERY rank journaled the SAME verdict sequence
+    and epochs — agreed retry, then agreed restore of the SAME step 1
+    (rank 0's newest step is torn, so the mesh must not follow rank 1's
+    local ``latest_valid() == 2``) — and the recover ladder ended in
+    ``recovered`` everywhere."""
+    events = _cluster_events(tmp_path)
+    per_rank_actions, per_rank_epochs = {}, {}
+    for r in range(world):
+        verdicts = [e for e in events if e["ev"] == "cluster.verdict"
+                    and e["proc"] == r]
+        per_rank_actions[r] = [e["action"] for e in verdicts]
+        per_rank_epochs[r] = [e["epoch"] for e in verdicts]
+        restores = {e["step"] for e in events
+                    if e["ev"] == "ckpt.restore" and e["proc"] == r}
+        assert restores == {1}, (r, restores)
+        elect = [e for e in events if e["ev"] == "cluster.verdict"
+                 and e["proc"] == r and e["action"] == "elect"]
+        assert [e["step"] for e in elect] == [1], (r, elect)
+        stages = [e["stage"] for e in events
+                  if e["ev"] == "guard.recover" and e["proc"] == r]
+        assert stages[-1] == "recovered", (r, stages)
+    # the SAME verdicts and the SAME epochs on every rank — the
+    # one-agreed-action contract
+    assert per_rank_actions[0] == ["retry", "restore", "elect", "ok"], \
+        per_rank_actions
+    assert all(per_rank_actions[r] == per_rank_actions[0]
+               for r in range(world)), per_rank_actions
+    assert all(per_rank_epochs[r] == per_rank_epochs[0]
+               for r in range(world)), per_rank_epochs
+    # rank 1's poisoned exchanges were journaled as faults + detections
+    sdc = [e for e in events if e["ev"] == "guard.sdc"]
+    assert sdc and all(e["proc"] == 1 for e in sdc), sdc
+
+
+def _assert_cluster_kill_timeline(tmp_path, world, victim):
+    """Acceptance (b): the victim's kill firing was journaled from
+    inside the dying process; every survivor journaled the lease expiry
+    naming the victim and wrote a peer-failure crash bundle."""
+    events = _cluster_events(tmp_path)
+    kills = [e for e in events if e["ev"] == "fault" and e["mode"] == "kill"]
+    assert kills and all(e["proc"] == victim and e["point"] == "hop.exchange"
+                         for e in kills), kills
+    for r in range(world):
+        if r == victim:
+            continue
+        expired = [e for e in events if e["ev"] == "cluster.lease"
+                   and e["proc"] == r and e["status"] == "expired"]
+        assert expired and all(e["rank"] == victim for e in expired), \
+            (r, expired)
+        bundles = [e for e in events if e["ev"] == "guard.bundle"
+                   and e["proc"] == r and e["reason"] == "peer-failure"]
+        assert bundles, r
+
+
+def _run_cluster_sequence(tmp_path, world):
+    victim = max(0, world - 2)
+    _launch_cluster_phase(tmp_path, world, "sdc")
+    _assert_cluster_sdc_timeline(tmp_path, world)
+    outs = _launch_cluster_phase(tmp_path, world, "kill",
+                                 expect_kill_rank=victim)
+    # survivors detected the death by LEASE EXPIRY (ttl 2 s), far below
+    # the 60 s verdict timeout and the 300 s watchdog — the whole point
+    for out in outs:
+        m = re.search(r"detect_s=([0-9.]+)", out)
+        if m:
+            assert float(m.group(1)) < 20.0, out
+    _assert_cluster_kill_timeline(tmp_path, world, victim)
+    _launch_cluster_phase(tmp_path, world, "restore")
+
+
+@pytest.mark.chaos
+def test_cluster_coordinated_recovery(tmp_path):
+    """2-rank FileKV drill of the full coordination ladder: one rank's
+    injected SDC → mesh-agreed retry → mesh-agreed restore of the SAME
+    elected step (the other rank's newest step is torn) → bit-identical
+    rerun; one rank SIGKILLed mid-step → the survivor exits with typed
+    ``PeerFailureError`` + crash bundle within the lease deadline; a
+    fresh incarnation's coordinated restore is bit-identical."""
+    _run_cluster_sequence(tmp_path, 2)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_cluster_coordinated_recovery_4proc(tmp_path):
+    """The 4-rank variant of the drill (the ISSUE's acceptance shape:
+    rank 2 is the SIGKILL victim, three survivors must all detect it)."""
+    _run_cluster_sequence(tmp_path, 4)
