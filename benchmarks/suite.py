@@ -155,6 +155,73 @@ def _measure_obs_overhead(topo, devs, n=64, dispatches=200, repeats=5):
     }
 
 
+def _measure_mesh_aggregation(publishes=50, folds=20):
+    """The ``--obs`` aggregation-cadence arm (PR 7): per-tick cost of
+    the mesh observability loop — snapshot publish (one KV set), rank-0
+    fold (collect + merge + artifact writes + straggler scan) and the
+    rank-labeled Prometheus render — over a FileKV on local disk, plus
+    what that costs as a FRACTION of a default 10 s cadence.  The
+    disabled-path story is unchanged by construction: the aggregator
+    only exists when obs AND cluster are armed (Coordinator-built), so
+    the shipped default adds nothing — the headline
+    ``disabled_overhead_within_noise`` above is re-captured WITH this
+    arm in the artifact to prove it."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from pencilarrays_tpu import obs
+    from pencilarrays_tpu.cluster.kv import FileKV
+    from pencilarrays_tpu.obs.aggregate import (DEFAULT_CADENCE_S,
+                                                MeshAggregator,
+                                                mesh_prometheus)
+    from pencilarrays_tpu.obs.events import _forced
+
+    root = tempfile.mkdtemp(prefix="pa_obs_agg_bench_")
+    try:
+        with _forced("on", os.path.join(root, "obs")):
+            # a representative registry: a few dozen live series
+            for i in range(16):
+                obs.counter("bench.agg_counter", i=str(i)).inc(i)
+                obs.histogram("bench.agg_hist", i=str(i)).observe(0.001 * i)
+            kv = FileKV(os.path.join(root, "kv"))
+            a0 = MeshAggregator(kv, 0, 2, cadence=60,
+                                out_dir=os.path.join(root, "obs"))
+            a1 = MeshAggregator(kv, 1, 2, cadence=60,
+                                out_dir=os.path.join(root, "obs"))
+            a1.publish_once()
+            t0 = _time.perf_counter()
+            for _ in range(publishes):
+                a0.publish_once()
+            publish_s = (_time.perf_counter() - t0) / publishes
+            t0 = _time.perf_counter()
+            for _ in range(folds):
+                a0.fold_once()
+            fold_s = (_time.perf_counter() - t0) / folds
+            snaps, _ = a0.collect()
+            t0 = _time.perf_counter()
+            for _ in range(folds):
+                mesh_prometheus(snaps)
+            prom_s = (_time.perf_counter() - t0) / folds
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    cadence = DEFAULT_CADENCE_S
+    return {
+        "what": "per-tick seconds of the mesh aggregation loop "
+                "(FileKV on local disk, 2-rank fold, ~48-series "
+                "registry)",
+        "publish_s": publish_s,
+        "fold_s": fold_s,
+        "mesh_prometheus_s": prom_s,
+        "default_cadence_s": cadence,
+        # the amortized claim: one publish (every rank) + one fold
+        # (rank 0) per cadence tick, as a fraction of the tick
+        "duty_cycle_rank0": (publish_s + fold_s) / cadence,
+        "duty_cycle_peer": publish_s / cadence,
+        "aggregation_off_when_obs_off": True,   # Coordinator-gated
+    }
+
+
 def _measure_guard_overhead(topo, devs, n=64, dispatches=200, repeats=5):
     """The ``--guard`` arm: per-dispatch wall time of an eager transpose
     with the integrity guard DISABLED (the shipped default, whose only
@@ -508,6 +575,10 @@ def main():
     # noise of the pre-obs baseline (the addition is ONE gate probe).
     if args.obs or args.obs_only:
         results["obs_overhead"] = _measure_obs_overhead(topo, devs)
+        # the PR 7 mesh-aggregation cadence arm rides the same artifact:
+        # per-tick publish/fold/prometheus cost + duty cycle, captured
+        # alongside the (re-measured) disabled-path headline above
+        results["obs_aggregation"] = _measure_mesh_aggregation()
         if args.obs_only:
             with open(args.out, "w") as f:
                 json.dump(results, f, indent=1)

@@ -95,6 +95,18 @@ def main():
         for line in f.readlines()[-3:]:
             print(f"  {line.rstrip()[:100]}")
 
+    # -- the post-mortem CLI over the same artifacts (PR 7) ----------------
+    # `pa-obs` (python -m pencilarrays_tpu.obs) merges rank journals,
+    # lints them, renders the per-(step, epoch) timeline and exports a
+    # Perfetto trace — here driven in-process:
+    from pencilarrays_tpu.obs.__main__ import main as pa_obs
+
+    print("\n$ pa-obs timeline <journal dir>")
+    pa_obs(["timeline", obs.journal_dir()])
+    trace = os.path.join(workdir, "trace.json")
+    print("\n$ pa-obs trace <journal dir>")
+    pa_obs(["trace", obs.journal_dir(), "-o", trace])
+
 
 if __name__ == "__main__":
     main()

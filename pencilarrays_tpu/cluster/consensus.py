@@ -94,6 +94,19 @@ class Coordinator:
         self._round = 0
         self._prev_key: Optional[str] = None
         self.leases.start()
+        # mesh observability plane (PR 7): with obs armed, every rank
+        # publishes its metrics snapshot on a cadence and rank 0 folds
+        # the mesh view + runs straggler detection; the same loop runs
+        # the clock-offset exchange the timeline merger corrects skew
+        # with.  PENCILARRAYS_TPU_OBS_AGG_S=0 disables.
+        self.aggregator = None
+        from .. import obs
+        from ..obs.aggregate import MeshAggregator, agg_cadence
+
+        if obs.enabled() and agg_cadence() > 0:
+            self.aggregator = MeshAggregator(kv, self.rank, self.world,
+                                             namespace=namespace)
+            self.aggregator.start()
 
     # -- health ------------------------------------------------------------
     def check_peers(self) -> None:
@@ -211,8 +224,11 @@ class Coordinator:
         return sorted(common)
 
     def shutdown(self) -> None:
-        """Stop the heartbeat (the lease then expires after ttl)."""
+        """Stop the heartbeat (the lease then expires after ttl) and
+        the metrics aggregation loop, if one runs."""
         self.leases.stop()
+        if self.aggregator is not None:
+            self.aggregator.stop()
 
 
 def _keyify(label: str) -> str:

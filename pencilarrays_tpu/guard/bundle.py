@@ -205,6 +205,13 @@ def write_crash_bundle(reason: str, label: str, *,
         "env": _env_snapshot(),
         "versions": _versions(),
         "artifacts": artifacts,
+        # post-mortem entry point: the bundled journal copy is a
+        # self-contained obs directory — one command reconstructs the
+        # merged cross-rank timeline from exactly what this bundle saw
+        "timeline_cmd": (
+            "python -m pencilarrays_tpu.obs timeline "
+            + os.path.join(path, "journal")
+            if artifacts.get("journal") == "ok" else None),
         **(extra or {}),
     }
     try:

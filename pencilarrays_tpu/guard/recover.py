@@ -102,8 +102,16 @@ def guarded_step(fn: Callable, *, ckpt_mgr=None,
     :class:`~pencilarrays_tpu.resilience.errors.CheckpointNotFoundError`
     semantics are folded into the same re-raise (a missing valid
     checkpoint cannot recover anything)."""
+    from ..obs import correlate
     from ..resilience.retry import RetryPolicy
 
+    # one guarded_step call == one collective step: advance the
+    # correlation step index (obs/correlate.py) unconditionally — every
+    # rank executes the same step sequence, so the per-process counters
+    # align across the mesh by construction, and a late-armed obs still
+    # journals the right indices.  Retries and agreed reruns stay in the
+    # SAME step (they are re-executions of it, distinguished by epoch).
+    correlate.next_step(label)
     policy = retry or RetryPolicy.from_env()
     if coordinator is None:
         from .. import cluster

@@ -25,6 +25,24 @@ Four layers (see ``docs/Observability.md``):
   ``utils/hlo.py``) with measured time (the ``utils/benchtime.py``
   protocol where available).
 
+PR 7 grows this into the **mesh-wide observability plane**:
+
+* :mod:`~pencilarrays_tpu.obs.correlate` — the ``(step_idx, epoch,
+  plan_fp)`` correlation keys stamped into every record, joining N
+  ranks' journals without trusting wall clocks;
+* :mod:`~pencilarrays_tpu.obs.timeline` — cross-rank journal merge
+  (rotated segments, torn tails, missing ranks → warnings; clock-skew
+  correction) + Chrome/Perfetto ``trace_event`` export;
+* :mod:`~pencilarrays_tpu.obs.aggregate` — live per-rank snapshot
+  publication over the cluster KV, rank-0 mesh fold
+  (``mesh_metrics.json`` + rank-labeled Prometheus textfile) and the
+  clock-offset beacon;
+* :mod:`~pencilarrays_tpu.obs.straggler` — leave-one-out robust
+  per-hop straggler detection (``cluster.straggler`` events);
+* ``python -m pencilarrays_tpu.obs`` (``pa-obs``) — the post-mortem
+  CLI: ``merge`` / ``lint`` / ``timeline`` / ``trace`` / ``drift`` /
+  ``bundle``.
+
 Everything is **off by default** and near-zero overhead when off: call
 sites guard with :func:`enabled` (one cached env lookup) and never build
 payloads on the disabled path — the observability analog of the
@@ -59,6 +77,8 @@ from .metrics import (  # noqa: F401
 from .tracing import io_op, profile, span  # noqa: F401
 from .drift import drift_report, drift_tracker, record_hop_sample  # noqa: F401
 from .schema import lint_event, lint_journal  # noqa: F401
+from .correlate import current_step, next_step, set_plan, step  # noqa: F401
+from .timeline import merge_journals, to_trace, write_trace  # noqa: F401
 
 __all__ = [
     "ENV_VAR",
@@ -85,4 +105,12 @@ __all__ = [
     "record_hop_sample",
     "lint_event",
     "lint_journal",
+    # mesh observability plane (PR 7)
+    "current_step",
+    "next_step",
+    "step",
+    "set_plan",
+    "merge_journals",
+    "to_trace",
+    "write_trace",
 ]

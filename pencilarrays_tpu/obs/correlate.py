@@ -1,0 +1,134 @@
+"""Correlation keys — the fields that join N ranks' journals into one
+mesh-wide story.
+
+PR 3's flight recorder is strictly per-process: each rank's records
+carry a run id and a per-process ``seq``, but nothing that lines up
+*across* ranks — and every interesting production failure (the PR 6
+drills prove it) is a cross-rank story.  Three keys fix that, stamped
+by :mod:`~pencilarrays_tpu.obs.events` into **every** record:
+
+* ``step_idx`` — a monotonic per-process step index, advanced at every
+  :func:`~pencilarrays_tpu.guard.recover.guarded_step` entry (or
+  explicitly via :func:`next_step` / the :func:`step` context manager).
+  On a mesh every rank executes the same collective step sequence, so
+  the counters align *by construction* — no communication needed: the
+  hop a rank dispatched in step 7 joins its peers' step-7 hops even
+  when wall clocks disagree by minutes.
+* ``epoch`` — the shared recovery epoch
+  (:mod:`~pencilarrays_tpu.cluster.epoch`): which incarnation of the
+  timeline a record belongs to.  A step *rerun* after an agreed
+  restore has the same ``step_idx`` but a later ``epoch``.
+* ``plan_fp`` — a short fingerprint of the most recently
+  built/dispatched plan (FFT plan schedule or reshard route), so a hop
+  record names the compiled program family it belonged to.  Omitted
+  until any plan exists.
+
+``(step_idx, epoch)`` is the join key the timeline merger
+(:mod:`~pencilarrays_tpu.obs.timeline`) and the straggler detector
+(:mod:`~pencilarrays_tpu.obs.straggler`) group by; ``hop`` labels
+disambiguate within a step.
+
+Everything here is deliberately communication-free and cheap enough to
+run with observability *disabled* (two module ints and a string): the
+step counter must advance identically whether or not a given rank had
+obs armed at the time, or late-armed ranks would journal misaligned
+indices.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = [
+    "current_step",
+    "next_step",
+    "step",
+    "current_plan",
+    "set_plan",
+    "plan_fingerprint",
+    "stamp",
+]
+
+_lock = threading.Lock()
+_step = 0
+_plan_fp: Optional[str] = None
+
+
+def current_step() -> int:
+    """The step index records are being stamped with (0 = before any
+    step boundary)."""
+    return _step
+
+
+def next_step(label: Optional[str] = None) -> int:
+    """Advance the monotonic step index (one collective step boundary)
+    and return the new value.  ``guarded_step`` calls this at entry;
+    application loops that do not use the guard call it per iteration."""
+    global _step
+    with _lock:
+        _step += 1
+        return _step
+
+
+@contextmanager
+def step(label: Optional[str] = None):
+    """Scope one application step: advances the index on entry, yields
+    it.  (There is nothing to restore on exit — the index is monotonic;
+    the context-manager shape just marks the step's extent in code.)"""
+    yield next_step(label)
+
+
+def current_plan() -> Optional[str]:
+    """Fingerprint of the most recently built/dispatched plan, if any."""
+    return _plan_fp
+
+
+def set_plan(fingerprint: Optional[str]) -> None:
+    """Install the plan fingerprint subsequent records are stamped with
+    (``None`` clears it).  The planners call this — ``PencilFFTPlan``
+    on build/dispatch, the reshard route executor per routed chain."""
+    global _plan_fp
+    _plan_fp = fingerprint
+
+
+def plan_fingerprint(summary) -> str:
+    """Short stable fingerprint (12 hex chars of sha256) of a plan
+    summary dict — the same digest family ``guard.note_plan`` uses, so
+    a journal's ``plan_fp`` prefixes the crash bundle's
+    ``schedule_sha256``."""
+    try:
+        blob = json.dumps(summary, sort_keys=True, default=str)
+    except Exception:
+        blob = repr(summary)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def _epoch_current() -> int:
+    """The recovery epoch, without importing anything heavy (the
+    cluster package's __init__ pulls only stdlib + its errors)."""
+    try:
+        from ..cluster import epoch
+
+        return epoch.current()
+    except Exception:   # pragma: no cover - never break the recorder
+        return 0
+
+
+def stamp() -> dict:
+    """The correlation fields :func:`~pencilarrays_tpu.obs.events.
+    record_event` folds into every record."""
+    out = {"step_idx": _step, "epoch": _epoch_current()}
+    if _plan_fp is not None:
+        out["plan_fp"] = _plan_fp
+    return out
+
+
+def _reset_for_tests() -> None:
+    global _step, _plan_fp
+    with _lock:
+        _step = 0
+        _plan_fp = None

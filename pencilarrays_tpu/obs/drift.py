@@ -138,6 +138,10 @@ class DriftTracker:
                 "predicted_bytes": s["predicted_bytes"],
                 "measured_s": s["min_s"],
                 "last_s": s["last_s"],
+                # cumulative sum: lets the mesh aggregator window a
+                # rate ((Δtotal)/(Δcount) between folds) so late-onset
+                # degradation is visible despite the all-time min
+                "total_s": s["total_s"],
                 "count": s["count"],
                 "bytes_per_s": (s["predicted_bytes"] / s["min_s"]
                                 if s["min_s"] > 0 and s["predicted_bytes"]

@@ -30,6 +30,14 @@ default; :func:`record_event` is then one cached env probe).  ``1`` /
 directory.  The variable is re-read whenever it changes — a worker can
 arm observability after import, exactly like the fault-injection env
 (``resilience/faults.py``).
+
+Rotation: ``PENCILARRAYS_TPU_OBS_MAX_MB`` caps the journal size — when
+crossed (always at a record boundary), the active file rotates to
+``journal.r<p>.<k>.jsonl`` and a fresh ``journal.r<p>.jsonl`` opens
+with the same O_APPEND discipline; the per-process ``seq`` keeps
+counting across segments and every reader consumes rotated segments
+transparently.  Unset = never rotate (the pre-PR-7 behavior: a
+long-running serving job should set the cap).
 """
 
 from __future__ import annotations
@@ -63,8 +71,13 @@ __all__ = [
 ENV_VAR = "PENCILARRAYS_TPU_OBS"
 DIR_VAR = "PENCILARRAYS_TPU_OBS_DIR"
 FSYNC_VAR = "PENCILARRAYS_TPU_OBS_FSYNC"
+MAX_MB_VAR = "PENCILARRAYS_TPU_OBS_MAX_MB"
 DEFAULT_DIR = "pa_obs"
-SCHEMA_VERSION = 1
+# v2 (PR 7): every record additionally carries the correlation keys
+# ``step_idx`` + ``epoch`` (and ``plan_fp`` once a plan exists) — the
+# fields cross-rank timeline joins group by (obs/correlate.py).  v1
+# journals remain lint-clean: the requirement is versioned.
+SCHEMA_VERSION = 2
 
 # events whose loss would blind a post-mortem: fsync'd under the default
 # "critical" policy.  High-rate events (per-hop dispatch) only flush.
@@ -80,6 +93,9 @@ CRITICAL_EVENTS = frozenset({
     # verdicts opt OUT per record via record_event's _fsync override,
     # so criticality never rides the healthy per-step path)
     "guard.epoch", "cluster.lease", "cluster.verdict",
+    # a flagged straggler gates a scheduling/ops decision and the
+    # flagging rank may be about to act on it
+    "cluster.straggler",
 })
 
 _lock = threading.Lock()
@@ -149,6 +165,9 @@ def _reset_for_tests() -> None:
         _env_on = False
         _run_id = None
         _seq = 0
+    from . import correlate
+
+    correlate._reset_for_tests()
 
 
 def journal_dir() -> str:
@@ -308,9 +327,51 @@ def _fsync_policy() -> str:
     return os.environ.get(FSYNC_VAR, "critical")
 
 
+def _max_bytes() -> Optional[int]:
+    """Rotation cap from ``PENCILARRAYS_TPU_OBS_MAX_MB`` (None = never
+    rotate, the pre-PR-7 behavior)."""
+    v = os.environ.get(MAX_MB_VAR)
+    if not v:
+        return None
+    try:
+        mb = float(v)
+    except ValueError:
+        return None
+    return int(mb * 1024 * 1024) if mb > 0 else None
+
+
+def _rotate_locked() -> None:
+    """Rotate the active journal to ``journal.r<p>.<k>.jsonl`` and
+    reopen a fresh ``journal.r<p>.jsonl`` — always at a record boundary
+    (called after a whole line landed), preserving the O_APPEND
+    discipline on the new fd.  The per-process ``seq`` keeps counting
+    across segments, so readers order a rank's records without caring
+    which segment they came from.  No ``run.start`` is emitted: a
+    rotation is mid-run, not a new run."""
+    global _file
+    d, proc = _file_dir, _file_proc
+    base = os.path.join(d, f"journal.r{proc}.jsonl")
+    try:
+        _file.close()
+    except OSError:
+        pass
+    _file = None
+    k = 1
+    while os.path.exists(os.path.join(d, f"journal.r{proc}.{k}.jsonl")):
+        k += 1
+    try:
+        os.replace(base, os.path.join(d, f"journal.r{proc}.{k}.jsonl"))
+        fsync_dir(d)
+    except OSError:
+        pass    # a failed rename just keeps appending to the old file
+    _file = open(base, "a", buffering=1)
+
+
 def _write_locked(ev: str, fields: dict, proc: Optional[int] = None,
                   fsync: Optional[bool] = None) -> None:
     global _seq
+    from . import correlate
+
     _seq += 1
     rec = {"v": SCHEMA_VERSION, "ev": ev, "run": run_id(),
            "proc": _process_index() if proc is None else proc,
@@ -319,6 +380,14 @@ def _write_locked(ev: str, fields: dict, proc: Optional[int] = None,
     for k, v in fields.items():
         if k not in rec:
             rec[k] = _json_safe(v)
+    # correlation keys (step_idx / epoch / plan_fp) fill in AFTER the
+    # payload: every record joins the cross-rank timeline, but an
+    # emitter that passes one explicitly keeps its value — a
+    # cluster.verdict journals the verdict's OWN epoch, not whatever
+    # the global counter reads at write time (a concurrent advance
+    # between payload construction and this lock must not rewrite it)
+    for k, v in correlate.stamp().items():
+        rec.setdefault(k, v)
     _file.write(json.dumps(rec, separators=(",", ":")) + "\n")
     _file.flush()
     policy = _fsync_policy()
@@ -327,6 +396,13 @@ def _write_locked(ev: str, fields: dict, proc: Optional[int] = None,
         try:
             os.fsync(_file.fileno())
         except OSError:
+            pass
+    cap = _max_bytes()
+    if cap is not None:
+        try:
+            if _file.tell() >= cap:
+                _rotate_locked()
+        except (OSError, ValueError):
             pass
 
 
@@ -358,9 +434,14 @@ def record_event(ev: str, _fsync: Optional[bool] = None, **fields) -> bool:
 def read_journal(directory: Optional[str] = None) -> List[dict]:
     """Parse every ``journal.r*.jsonl`` under ``directory`` (default:
     the active journal dir) into one timeline ordered by wall time then
-    per-process sequence.  Unparseable lines (a torn final line from a
+    per-process sequence.  Rotated segments (``journal.r<p>.<k>.jsonl``,
+    see ``PENCILARRAYS_TPU_OBS_MAX_MB``) match the same glob and are
+    read transparently.  Unparseable lines (a torn final line from a
     crash without O_APPEND atomicity, foreign garbage) are skipped — the
-    reader is a forensic tool and must not die on wreckage."""
+    reader is a forensic tool and must not die on wreckage.  For a
+    *causally* merged cross-rank view with skew correction and lint
+    warnings, use :func:`~pencilarrays_tpu.obs.timeline.merge_journals`
+    (or ``python -m pencilarrays_tpu.obs merge``)."""
     import glob
 
     d = directory or journal_dir()

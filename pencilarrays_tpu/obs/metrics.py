@@ -47,6 +47,92 @@ def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+# -- Prometheus exposition-format helpers -----------------------------------
+# (shared by the per-process exporter and the mesh aggregator's
+# rank-labeled textfile — obs/aggregate.py)
+
+
+def _prom_name(name: str, prefix: str = "pa") -> str:
+    """Metric/label-name sanitation: the exposition format allows only
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*``; anything else becomes ``_`` so a
+    dotted (or hostile) name can never break the line grammar."""
+    import re
+
+    out = re.sub(r"[^a-zA-Z0-9_]", "_", str(name))
+    if prefix:
+        out = prefix + "_" + out
+    if not out or not (out[0].isalpha() or out[0] == "_"):
+        out = "_" + out
+    return out
+
+
+def _prom_escape(value) -> str:
+    """Label-VALUE escaping per the exposition format: backslash,
+    double-quote and newline — a plan fingerprint containing ``"`` or
+    ``\\n`` must not corrupt the textfile."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_labels(labels: Dict[str, str],
+                 extra: Optional[Dict[str, str]] = None) -> str:
+    merged = dict(labels or {})
+    if extra:
+        # the Prometheus honor_labels=false convention: an injected
+        # label (the mesh fold's publisher `rank`) wins the name, and a
+        # colliding series-own label survives as `exported_<name>` —
+        # `cluster.stragglers{rank=1}` published by rank 0 must not
+        # lose WHICH rank was the straggler
+        for k in list(merged):
+            if k in extra:
+                merged[f"exported_{k}"] = merged.pop(k)
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{_prom_name(k, prefix="")}="{_prom_escape(v)}"'
+        for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def _drift_prometheus_lines(report: dict, prefix: str = "pa",
+                            extra: Optional[Dict[str, str]] = None,
+                            seen_types: Optional[set] = None) -> list:
+    """The drift report as gauges: per-hop ``<prefix>_drift{hop=...}``
+    plus the two per-source-class fitted bandwidths.  ``seen_types``
+    dedups ``# TYPE`` headers across repeated calls (the mesh fold
+    calls this once per rank — a second TYPE line for the same metric
+    is an exposition-format error that fails the whole scrape)."""
+    lines = []
+    if seen_types is None:
+        seen_types = set()
+
+    def type_line(n: str) -> None:
+        if n not in seen_types:
+            seen_types.add(n)
+            lines.append(f"# TYPE {n} gauge")
+
+    hops = (report or {}).get("hops") or {}
+    drifted = [(h, e) for h, e in sorted(hops.items())
+               if isinstance(e.get("drift"), (int, float))]
+    if drifted:
+        n = _prom_name("drift", prefix)
+        type_line(n)
+        for hop, e in drifted:
+            ls = _prom_labels({"hop": hop, "source": e.get("source", "?")},
+                              extra)
+            lines.append(f"{n}{ls} {e['drift']:g}")
+    for key, cls in (("fitted_bytes_per_s", "device"),
+                     ("dispatch_fitted_bytes_per_s", "dispatch")):
+        bw = (report or {}).get(key)
+        if isinstance(bw, (int, float)):
+            n = _prom_name("drift_fitted_bytes_per_s", prefix)
+            type_line(n)
+            lines.append(
+                f"{n}{_prom_labels({'class': cls}, extra)} {bw:g}")
+    return lines
+
+
 class Counter:
     """Monotonic count (events, bytes, retries)."""
 
@@ -157,7 +243,12 @@ class MetricsRegistry:
     # -- exporters ---------------------------------------------------------
     def snapshot(self) -> dict:
         """JSON-serializable dump of every instrument plus the drift
-        report and the latest benchtime spread (noise floor)."""
+        report and the latest benchtime spread (noise floor).  Carries
+        both the human-keyed maps (``name{k=v}`` display keys — the
+        stable consumer format) and a structured ``series`` list with
+        labels as dicts, which the mesh aggregator folds without
+        re-parsing display keys (label VALUES may legally contain
+        ``,``/``=``/``{`` — method reprs and plan fingerprints do)."""
         from ..utils.benchtime import last_spread
         from .drift import drift_report
         from .events import run_id
@@ -166,17 +257,23 @@ class MetricsRegistry:
             metrics = list(self._metrics.values())
         out = {"format": "pencilarrays-tpu-metrics", "version": 1,
                "run": run_id(), "t_wall": time.time(),
-               "counters": {}, "gauges": {}, "histograms": {}}
+               "counters": {}, "gauges": {}, "histograms": {},
+               "series": []}
         for m in metrics:
             key = m.name if not m.labels else (
                 m.name + "{" + ",".join(
                     f"{k}={v}" for k, v in sorted(m.labels.items())) + "}")
+            series = {"name": m.name,
+                      "labels": {str(k): str(v)
+                                 for k, v in sorted(m.labels.items())}}
             if isinstance(m, Counter):
                 out["counters"][key] = m.value
+                series.update(kind="counter", value=m.value)
             elif isinstance(m, Gauge):
                 out["gauges"][key] = m.value
+                series.update(kind="gauge", value=m.value)
             else:
-                out["histograms"][key] = {
+                h = {
                     "count": m.count, "total": m.total, "mean": m.mean(),
                     "min": None if m.count == 0 else m.vmin,
                     "max": None if m.count == 0 else m.vmax,
@@ -186,27 +283,27 @@ class MetricsRegistry:
                         str(i + m.LO): c
                         for i, c in enumerate(m.buckets) if c},
                 }
+                out["histograms"][key] = h
+                series.update(kind="histogram", **h)
+            out["series"].append(series)
         out["benchtime"] = last_spread()
         out["drift"] = drift_report()
         return out
 
     def to_prometheus(self, prefix: str = "pa") -> str:
-        """Prometheus textfile-collector exposition of the registry."""
-        def pname(name: str) -> str:
-            return prefix + "_" + name.replace(".", "_").replace("-", "_")
-
-        def plabels(labels: Dict[str, str]) -> str:
-            if not labels:
-                return ""
-            inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
-            return "{" + inner + "}"
-
+        """Prometheus textfile-collector exposition of the registry,
+        plus the cost-model drift report as gauges (previously
+        JSON-snapshot-only, so a scrape pipeline never saw drift).
+        Names and label values go through the exposition-format
+        escaping below — a label value carrying ``"`` or a newline
+        (plan fingerprints, free-form hop labels) must corrupt neither
+        the line it is on nor the lines after it."""
         with self._lock:
             metrics = list(self._metrics.values())
         lines = []
         seen_types = set()
         for m in sorted(metrics, key=lambda m: m.name):
-            n, ls = pname(m.name), plabels(m.labels)
+            n, ls = _prom_name(m.name, prefix), _prom_labels(m.labels)
             if isinstance(m, Counter):
                 if n not in seen_types:
                     lines.append(f"# TYPE {n}_total counter")
@@ -225,6 +322,9 @@ class MetricsRegistry:
                     seen_types.add(n)
                 lines.append(f"{n}_count{ls} {m.count}")
                 lines.append(f"{n}_sum{ls} {m.total:g}")
+        from .drift import drift_report
+
+        lines.extend(_drift_prometheus_lines(drift_report(), prefix))
         return "\n".join(lines) + ("\n" if lines else "")
 
 

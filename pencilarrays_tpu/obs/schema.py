@@ -20,6 +20,11 @@ __all__ = ["COMMON_FIELDS", "EVENT_TYPES", "lint_event", "lint_journal"]
 COMMON_FIELDS: Tuple[str, ...] = (
     "v", "ev", "run", "proc", "seq", "t_wall", "t_mono")
 
+# correlation keys stamped into every record since schema v2
+# (obs/correlate.py): the cross-rank join key.  ``plan_fp`` is only
+# present once a plan exists, so it is not required.
+V2_STAMP_FIELDS: Tuple[str, ...] = ("step_idx", "epoch")
+
 # ev -> required payload fields (extra fields are allowed; missing ones
 # and unknown event types are lint errors)
 EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
@@ -55,6 +60,10 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     # mesh coordination layer (cluster/)
     "cluster.lease": ("rank", "status"),
     "cluster.verdict": ("label", "action", "epoch"),
+    # mesh observability plane (PR 7)
+    "cluster.straggler": ("rank", "hop", "excess_s", "baseline_s"),
+    "clock.sync": ("ref_rank", "offset_s", "method"),
+    "obs.agg": ("status",),
     # profiling / drift
     "profile": ("dir", "status"),
     "drift.sample": ("hop", "predicted_bytes", "measured_s", "source"),
@@ -75,6 +84,12 @@ def lint_event(e: dict) -> List[str]:
     elif v is not None and v > SCHEMA_VERSION:
         errors.append(f"schema version {v} is newer than supported "
                       f"{SCHEMA_VERSION}")
+    if isinstance(v, (int, float)) and v >= 2:
+        for f in V2_STAMP_FIELDS:
+            if f not in e:
+                errors.append(
+                    f"v{v} record missing correlation key {f!r} "
+                    f"(stamped by obs/correlate.py): {e!r}")
     ev = e.get("ev")
     if ev is None:
         return errors

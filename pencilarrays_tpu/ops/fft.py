@@ -51,7 +51,7 @@ from __future__ import annotations
 
 import math
 from functools import lru_cache
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -698,8 +698,14 @@ class PencilFFTPlan:
 
         from .. import guard, obs
 
+        self._plan_fp: Optional[str] = None
         if obs.enabled():
             obs.counter("fft.plans_built").inc()
+            # correlation: subsequent records (hops, faults, probes)
+            # are stamped with this plan's fingerprint (obs/correlate)
+            from ..obs import correlate
+
+            correlate.set_plan(self._fingerprint())
             obs.record_event("plan.build", **self._obs_summary())
         if guard.enabled():
             # crash bundles carry the schedules of recently-built plans
@@ -770,6 +776,16 @@ class PencilFFTPlan:
             return None
         return ("ft", src, tgt, hop_dtype, post, tuple(ops), pre_complex,
                 base, c, bounds)
+
+    def _fingerprint(self) -> str:
+        """Short schedule fingerprint for correlation stamping
+        (``plan_fp`` on journal records) — computed lazily so plans
+        built before obs was armed still stamp correctly later."""
+        if self._plan_fp is None:
+            from ..obs import correlate
+
+            self._plan_fp = correlate.plan_fingerprint(self._obs_summary())
+        return self._plan_fp
 
     def _obs_summary(self) -> dict:
         """The ``plan.build`` journal payload: the static schedule and
@@ -969,6 +985,13 @@ class PencilFFTPlan:
                 f"input must live on plan.input_pencil "
                 f"({self.input_pencil!r}), got {u.pencil!r}"
             )
+        from .. import obs
+
+        if obs.enabled():
+            # correlation: this dispatch's hop records carry the plan
+            from ..obs import correlate
+
+            correlate.set_plan(self._fingerprint())
         tap = self._guard_tap_pre(u)
         nd_extra = u.ndims_extra
         x = u
@@ -1045,6 +1068,12 @@ class PencilFFTPlan:
                 f"input must live on plan.output_pencil "
                 f"({self.output_pencil!r}), got {uh.pencil!r}"
             )
+        from .. import obs
+
+        if obs.enabled():
+            from ..obs import correlate
+
+            correlate.set_plan(self._fingerprint())
         tap = self._guard_tap_pre(uh)
         nd_extra = uh.ndims_extra
         x = uh

@@ -461,13 +461,23 @@ def execute_route(src: PencilArray, route: ReshardRoute, *,
                           hops=len(route.hops))
         if act == "torn":   # this site cannot tear: treat as kill
             faults.kill_now()
-    if eager and guard.enabled():
-        guard.note_plan("reshard_route", {
+    if eager and (obs.enabled() or guard.enabled()):
+        # ONE summary feeds both digests: the journal's plan_fp must be
+        # a prefix of the crash bundle's schedule_sha256 (both hash the
+        # same sorted-JSON blob), or a post-mortem cannot match a hop
+        # record to the route that was in flight
+        summary = {
             "route": [list(h.dest.decomposition) for h in route.hops],
             "methods": [_method_label(h.method) for h in route.hops],
             "verdict": route.verdict,
             "shape": list(route.src.size_global()),
-            "topo": list(route.src.topology.dims)})
+            "topo": list(route.src.topology.dims)}
+        if obs.enabled():
+            from ..obs import correlate
+
+            correlate.set_plan(correlate.plan_fingerprint(summary))
+        if guard.enabled():
+            guard.note_plan("reshard_route", summary)
         return _execute_route_guarded(src, route, donate,
                                       corrupt=act == "corrupt")
     fn = _metered_cached(

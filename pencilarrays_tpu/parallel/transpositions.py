@@ -709,10 +709,12 @@ def _obs_record_hop(pin: Pencil, pout: Pencil, R: Optional[int],
     obs.counter("transpose.predicted_bytes").inc(nbytes)
     obs.histogram("transpose.dispatch_seconds", method=label).observe(
         dispatch_s)
-    if nbytes:
-        # per-dispatch host wall time: the free drift proxy (benchtime /
-        # auto-measure samples outrank it in the report)
-        obs.record_hop_sample(hop, nbytes, dispatch_s, source="dispatch")
+    # per-dispatch host wall time: the free drift proxy (benchtime /
+    # auto-measure samples outrank it in the report).  Zero-byte hops
+    # (local permutes) are recorded too: their drift stays None (nothing
+    # on the wire to reconcile) but their measured duration is what the
+    # mesh straggler detector compares across ranks (obs/straggler.py)
+    obs.record_hop_sample(hop, nbytes, dispatch_s, source="dispatch")
     obs.record_event(
         "hop", method=label, hop=hop, r=R, chunks=chunks,
         fused=bool(fused_k), predicted_bytes=nbytes, predicted=cost,
@@ -1091,6 +1093,15 @@ def transpose(src: PencilArray, dest: Pencil, *,
 
     with timeit(pin.timer, "transpose!"):
         eager = not isinstance(src.data, jax.core.Tracer)
+        # the hop tap observes EAGER dispatches only: under an outer
+        # jit this call runs at trace time (once per compile), where a
+        # "duration" would be lowering time, not a dispatch — it must
+        # neither flood the journal per compile nor poison the drift
+        # fit (use obs.profile for device-side visibility of jitted
+        # programs).  The clock starts BEFORE the fault probe so a
+        # `delay`-mode stall (the injected straggler) is part of the
+        # measured dispatch — what the mesh straggler detector reads.
+        t0 = time.perf_counter() if (obs.enabled() and eager) else None
         # the SDC drill point: eager dispatches only (a traced hop is
         # one compile, not an exchange), gated on armed() so the
         # no-faults hot path pays one cached env probe
@@ -1100,13 +1111,6 @@ def transpose(src: PencilArray, dest: Pencil, *,
                               method=_method_label(method))
             if act == "torn":   # this site cannot tear: treat as kill
                 faults.kill_now()
-        # the hop tap observes EAGER dispatches only: under an outer
-        # jit this call runs at trace time (once per compile), where a
-        # "duration" would be lowering time, not a dispatch — it must
-        # neither flood the journal per compile nor poison the drift
-        # fit (use obs.profile for device-side visibility of jitted
-        # programs)
-        t0 = time.perf_counter() if (obs.enabled() and eager) else None
         if eager and guard.enabled():
             # guarded path: probes ride the SAME program; a corrupt
             # drill rides between exchange and post-probe

@@ -38,7 +38,12 @@ same failure.  Spec grammar (comma/semicolon-separated)::
   counter-addressed bitflip/NaN poke of
   ``guard.integrity.corrupt_block`` — silent data corruption on
   demand, so chaos tests can assert typed-error-or-bit-identical,
-  never garbage).
+  never garbage), ``delay`` (sleep
+  ``PENCILARRAYS_TPU_FAULTS_DELAY_S`` seconds — default 0.25 — at the
+  point, then proceed normally: the deterministic *straggler*, e.g.
+  ``hop.exchange:delay%rank1`` makes rank 1 drag every exchange
+  without changing any value; guard/cluster semantics are untouched,
+  which is exactly what the straggler-detection drill needs).
 * ``%rank<k>`` — rank-addressed injection: the rule triggers only in
   the process whose mesh rank is ``k`` (``PENCILARRAYS_TPU_CLUSTER_RANK``,
   else the jax-assigned process id, else 0 — the cluster layer's
@@ -83,7 +88,9 @@ __all__ = [
     "hit_count",
     "block_write_hook",
     "kill_now",
+    "delay_seconds",
     "ENV_VAR",
+    "DELAY_S_VAR",
 ]
 
 ENV_VAR = "PENCILARRAYS_TPU_FAULTS"
@@ -99,7 +106,19 @@ POINTS = frozenset({
     "hop.exchange",
 })
 
-MODES = frozenset({"error", "kill", "torn", "corrupt"})
+MODES = frozenset({"error", "kill", "torn", "corrupt", "delay"})
+
+DELAY_S_VAR = "PENCILARRAYS_TPU_FAULTS_DELAY_S"
+DEFAULT_DELAY_S = 0.25
+
+
+def delay_seconds() -> float:
+    """The injected-straggler sleep (``delay`` mode), env-tunable so a
+    drill can scale the excess against its own hop durations."""
+    try:
+        return float(os.environ.get(DELAY_S_VAR, DEFAULT_DELAY_S))
+    except ValueError:
+        return DEFAULT_DELAY_S
 
 
 @dataclass(frozen=True)
@@ -289,6 +308,13 @@ def fire(point: str, **ctx) -> Optional[str]:
         if r.rank is not None and r.rank != _self_rank():
             continue   # addressed to another rank; counters still tick
         _obs_firing(point, r.mode, hit, ctx)
+        if r.mode == "delay":
+            # the deterministic straggler: stall, then proceed — the
+            # point's semantics (and any LATER rule on it) are untouched
+            import time
+
+            time.sleep(delay_seconds())
+            continue
         if r.mode == "kill":
             kill_now()
         if r.mode in ("torn", "corrupt"):

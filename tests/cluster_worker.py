@@ -30,6 +30,13 @@ KV namespace — a KV root is one job incarnation):
 * ``restore`` — fresh processes (all ranks, including the previous
   victim's slot) elect ``common_latest_valid()`` and restore it: the
   coordinated-restore rerun must be bit-identical to ground truth.
+* ``straggle`` / ``control`` — the PR 7 straggler drill: every rank
+  runs the same guarded transpose steps, with rank 1 dragged by the
+  deterministic ``hop.exchange:delay%rank1`` fault (``straggle``) or
+  undelayed (``control``); every rank publishes its metrics snapshot
+  over the KV and rank 0 folds the mesh view + runs straggler
+  detection.  The test asserts exactly one ``cluster.straggler``
+  event naming rank 1 in the delayed run and zero in the control.
 
 Usage::
 
@@ -60,6 +67,9 @@ def main():
     os.environ.setdefault("PENCILARRAYS_TPU_CLUSTER_LEASE_TTL", "2.0")
     os.environ.setdefault("PENCILARRAYS_TPU_CLUSTER_VERDICT_TIMEOUT", "60")
     os.environ["PENCILARRAYS_TPU_OBS"] = os.path.join(tmpdir, "obs")
+    # tight aggregation cadence: the drill exercises the live mesh
+    # publish/fold loop, not just the explicit fold at the end
+    os.environ.setdefault("PENCILARRAYS_TPU_OBS_AGG_S", "0.5")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -148,6 +158,30 @@ def main():
         back = mgr.restore(step).read("u", pen)
         assert np.array_equal(pa.gather(back), truth), \
             "coordinated restore is not bit-identical to ground truth"
+    elif phase in ("straggle", "control"):
+        from pencilarrays_tpu import cluster
+
+        if phase == "straggle":
+            # the deterministic straggler: rank 1 drags EVERY exchange
+            # by a fixed 0.3 s; values, guard and consensus semantics
+            # are untouched (every verdict stays `ok`)
+            os.environ["PENCILARRAYS_TPU_FAULTS_DELAY_S"] = "0.3"
+            os.environ["PENCILARRAYS_TPU_FAULTS"] = \
+                "hop.exchange:delay%rank1"
+        state = {"u": pa.PencilArray.from_global(pen, truth)}
+        for _ in range(4):
+            guard.guarded_step(lambda: pa.transpose(state["u"], pen2),
+                               label="straggle-step")
+        coord = cluster.coordinator()
+        assert coord is not None and coord.aggregator is not None, \
+            "obs+cluster armed but no mesh aggregator"
+        agg = coord.aggregator
+        assert agg.publish_once(), "snapshot publish failed"
+        # barrier: rank 0 must not fold before every rank published
+        coord.allgather("straggle-published", {"rank": rank})
+        if rank == 0:
+            fold = agg.fold_once(wait=True, timeout=60)
+            assert fold is not None and not fold["missing_ranks"], fold
     else:
         raise SystemExit(f"unknown phase {phase!r}")
     print(f"CLUSTER_OK phase={phase} rank={rank}")

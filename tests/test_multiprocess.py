@@ -151,6 +151,22 @@ def _run_kill_sequence(tmp_path, nprocs_ckpt, nprocs_kill, nprocs_recover):
                           guard_recover=(nprocs_recover == 1))
 
 
+def _pa_obs_check(obs_dir):
+    """Run the REAL post-mortem CLI (`pa-obs lint` + `pa-obs timeline`)
+    over a drill's artifacts — the drills' timeline assertions ride the
+    same code path an operator types — and return the merged events."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from pencilarrays_tpu.obs.__main__ import main
+    from pencilarrays_tpu.obs.timeline import merge_journals
+
+    assert main(["lint", obs_dir]) == 0, "pa-obs lint failed"
+    assert main(["timeline", obs_dir]) == 0, "pa-obs timeline failed"
+    return merge_journals(obs_dir).events
+
+
 def _assert_kill_timeline(obs_dir, after_kill, guard_recover=False):
     """The journal is the post-mortem: step 1 committed, step 2 began
     and hit the injected torn fault, step 2 NEVER committed — and after
@@ -158,15 +174,8 @@ def _assert_kill_timeline(obs_dir, after_kill, guard_recover=False):
     additionally ran the guard's detect-and-recover ladder, so its
     timeline must carry the guard.sdc detections and a guard.recover
     sequence ending in ``recovered``.  Every record passes the schema
-    lint."""
-    import sys
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    from pencilarrays_tpu.obs import lint_journal, read_journal
-
-    events = read_journal(obs_dir)
-    assert lint_journal(events) == [], lint_journal(events)[:5]
+    lint, via the real ``pa-obs`` CLI path."""
+    events = _pa_obs_check(obs_dir)
     commits = {e["step"] for e in events if e["ev"] == "ckpt.commit"}
     assert commits == {1}, commits  # step 2's commit must never exist
     begins = {e["step"] for e in events
@@ -274,13 +283,7 @@ def _launch_cluster_phase(tmp_path, world, phase, expect_kill_rank=None):
 
 
 def _cluster_events(tmp_path):
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    from pencilarrays_tpu.obs import lint_journal, read_journal
-
-    events = read_journal(os.path.join(str(tmp_path), "obs"))
-    assert lint_journal(events) == [], lint_journal(events)[:5]
-    return events
+    return _pa_obs_check(os.path.join(str(tmp_path), "obs"))
 
 
 def _assert_cluster_sdc_timeline(tmp_path, world):
@@ -316,6 +319,73 @@ def _assert_cluster_sdc_timeline(tmp_path, world):
     # rank 1's poisoned exchanges were journaled as faults + detections
     sdc = [e for e in events if e["ev"] == "guard.sdc"]
     assert sdc and all(e["proc"] == 1 for e in sdc), sdc
+    _assert_sdc_trace(tmp_path, world)
+
+
+def _assert_sdc_trace(tmp_path, world):
+    """PR 7 acceptance: ``pa-obs trace`` over the SDC drill artifacts
+    emits a Perfetto-loadable trace_event JSON whose per-rank tracks
+    carry the hop spans, rank 1's injected fault, every rank's recovery
+    ladder, and the shared epoch markers — all joined on identical
+    ``(step_idx, epoch)`` correlation keys on every rank."""
+    import json
+
+    from pencilarrays_tpu.obs.__main__ import main
+
+    obs_dir = os.path.join(str(tmp_path), "obs")
+    out = os.path.join(str(tmp_path), "trace.json")
+    assert main(["trace", obs_dir, "-o", out]) == 0
+    with open(out) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    assert isinstance(evs, list) and evs
+    assert sorted(trace["otherData"]["ranks"]) == list(range(world))
+    join = {}
+    for r in range(world):
+        mine = [e for e in evs if e.get("pid") == r and e.get("ph") != "M"]
+        hops = [e for e in mine if e.get("ph") == "X"
+                and e["name"].startswith("hop ")]
+        assert hops, f"rank {r}: no hop spans on its track"
+        assert all("dur" in e and e["dur"] > 0 for e in hops), hops
+        stages = {e["name"].split(":", 1)[1] for e in mine
+                  if e["name"].startswith("recover:")}
+        # every rank ran the agreed ladder; the failing rank also
+        # journaled its detections as `error` stages
+        assert {"retry", "restore", "recovered"} <= stages, (r, stages)
+        if r == 1:
+            assert "error" in stages, stages
+        epochs = [e for e in mine if e["name"].startswith("epoch ")]
+        assert epochs and all(e.get("s") == "g" for e in epochs), \
+            (r, epochs)
+        exchanges = [e for e in mine
+                     if (e.get("ph") == "X"
+                         and e["name"].startswith("hop "))
+                     or e["name"] == "fault hop.exchange:corrupt"
+                     or e["name"].startswith("guard.sdc")]
+        join[r] = {
+            # each attempt's exchange activity: a clean hop span, or —
+            # on the poisoned rank — the fault/SDC markers that replaced
+            # it (a detected attempt raises before the hop tap)
+            "attempts": {(e["args"]["step_idx"], e["args"]["epoch"])
+                         for e in exchanges},
+            "hops": {(e["args"]["step_idx"], e["args"]["epoch"])
+                     for e in hops},
+            "epochs": {(e["args"]["step_idx"], e["args"]["epoch"],
+                        e["name"]) for e in epochs},
+        }
+    faults = [e for e in evs if e.get("pid") == 1
+              and e["name"] == "fault hop.exchange:corrupt"]
+    assert faults, "rank 1's injected fault is missing from its track"
+    # THE join contract: identical (step, epoch) keys on every rank —
+    # every attempt rank 0 dispatched lines up with what the poisoned
+    # rank was doing at that exact (step, epoch), the shared epoch
+    # markers carry the same keys everywhere, and the agreed
+    # post-restore rerun is a clean hop span on ALL ranks
+    final = max(join[0]["attempts"])
+    for r in range(1, world):
+        assert join[r]["attempts"] == join[0]["attempts"], join
+        assert join[r]["epochs"] == join[0]["epochs"], join
+        assert final in join[r]["hops"], join
 
 
 def _assert_cluster_kill_timeline(tmp_path, world, victim):
@@ -371,3 +441,47 @@ def test_cluster_coordinated_recovery_4proc(tmp_path):
     """The 4-rank variant of the drill (the ISSUE's acceptance shape:
     rank 2 is the SIGKILL victim, three survivors must all detect it)."""
     _run_cluster_sequence(tmp_path, 4)
+
+
+@pytest.mark.chaos
+def test_cluster_straggler_detection(tmp_path):
+    """PR 7 acceptance: a ``hop.exchange:delay%rank1`` fault on a
+    2-rank FileKV mesh produces exactly ONE ``cluster.straggler`` event
+    naming rank 1 with the measured excess (emitted by rank 0's mesh
+    fold, deduplicated across cadence ticks), and the undelayed control
+    run produces ZERO straggler events."""
+    straggle = tmp_path / "straggle"
+    control = tmp_path / "control"
+    straggle.mkdir()
+    control.mkdir()
+
+    _launch_cluster_phase(straggle, 2, "straggle")
+    events = _cluster_events(straggle)
+    flags = [e for e in events if e["ev"] == "cluster.straggler"]
+    assert len(flags) == 1, flags
+    f = flags[0]
+    assert f["rank"] == 1 and f["proc"] == 0, f    # rank 0 names rank 1
+    # the injected drag is 0.3 s; the measured excess must carry most
+    # of it (baseline = rank 0's undelayed dispatch, a few ms)
+    assert f["excess_s"] > 0.1, f
+    assert f["baseline_s"] < f["excess_s"], f
+    delays = [e for e in events
+              if e["ev"] == "fault" and e["mode"] == "delay"]
+    assert delays and all(e["proc"] == 1 for e in delays), delays
+    # the live fold published the mesh artifacts next to the journal
+    mesh = os.path.join(str(straggle), "obs", "mesh_metrics.json")
+    assert os.path.exists(mesh)
+    import json
+
+    with open(mesh) as fh:
+        fold = json.load(fh)
+    assert fold["missing_ranks"] == [] and fold["ranks"] == [0, 1]
+    with open(os.path.join(str(straggle), "obs",
+                           "mesh_metrics.prom")) as fh:
+        prom = fh.read()
+    assert 'rank="0"' in prom and 'rank="1"' in prom
+
+    _launch_cluster_phase(control, 2, "control")
+    events = _cluster_events(control)
+    assert [e for e in events if e["ev"] == "cluster.straggler"] == []
+    assert [e for e in events if e["ev"] == "fault"] == []
