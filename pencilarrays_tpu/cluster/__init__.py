@@ -61,6 +61,8 @@ from .errors import (  # noqa: F401
     ClusterError,
     ConsensusTimeoutError,
     PeerFailureError,
+    PeerLeftError,
+    ReformError,
 )
 
 __all__ = [
@@ -73,8 +75,10 @@ __all__ = [
     "VERDICT_TIMEOUT_VAR",
     "ClusterError",
     "PeerFailureError",
+    "PeerLeftError",
     "ClusterAbortError",
     "ConsensusTimeoutError",
+    "ReformError",
     "enabled",
     "enable",
     "disable",
@@ -82,6 +86,7 @@ __all__ = [
     "world_size",
     "coordinator",
     "current_epoch",
+    "elastic",
 ]
 
 ENV_VAR = "PENCILARRAYS_TPU_CLUSTER"
@@ -255,17 +260,27 @@ def disable() -> None:
 
 
 def _reset_for_tests() -> None:
-    """Full gate reset (tests toggle env/overrides between cases)."""
+    """Full gate reset (tests toggle env/overrides between cases).
+    An installed reformed coordinator (elastic) counts as an override
+    and is shut down; elastic membership/registry state is cleared so
+    reformation drills cannot leak generations into later tests."""
     global _override, _coord, _coord_key
     with _lock:
+        if _override is not None and _override is not False:
+            try:
+                _override.shutdown()
+            except Exception:
+                pass
         _override = None
         if _coord is not None:
             _coord.shutdown()
         _coord = None
         _coord_key = None
+    from . import elastic as _elastic
     from . import epoch as _epoch
 
     _epoch._reset_for_tests()
+    _elastic._reset_for_tests()
 
 
 def current_epoch() -> int:
@@ -273,3 +288,8 @@ def current_epoch() -> int:
     from . import epoch as _epoch
 
     return _epoch.current()
+
+
+# elastic mesh reformation (import-light: the gate is one env probe and
+# nothing heavy loads until a reformation actually runs)
+from . import elastic  # noqa: E402,F401

@@ -55,6 +55,14 @@ def merge_statuses(statuses: Sequence[dict]) -> dict:
         return {"action": "ok", "ranks": []}
     ranks = [r for r, _ in failing]
     errors = {r: s.get("error") for r, s in failing}
+    # planned departures announced AT the step boundary: when every
+    # non-ok status is a clean "leave", nothing failed — the agreed
+    # action is "leave" (the elastic layer reforms the mesh around the
+    # departing ranks; leavers exit the step cleanly).  A leave mixed
+    # with a real failure falls through to the recovery merge below,
+    # where the leaver's can_retry=False forbids a half-mesh rerun.
+    if all(s.get("status") == "leave" for _, s in failing):
+        return {"action": "leave", "ranks": ranks, "errors": errors}
     if all(s.get("can_retry") for s in statuses):
         return {"action": "retry", "ranks": ranks, "errors": errors}
     if all(s.get("can_restore") for s in statuses):
@@ -93,6 +101,10 @@ class Coordinator:
                                  namespace=namespace)
         self._round = 0
         self._prev_key: Optional[str] = None
+        # set by announce_leave(): the next step boundary publishes
+        # status "leave" instead of "ok" (planned scale-down announced
+        # AT the boundary — see merge_statuses and guard/recover.py)
+        self.leaving = False
         self.leases.start()
         # mesh observability plane (PR 7): with obs armed, every rank
         # publishes its metrics snapshot on a cadence and rank 0 folds
@@ -222,6 +234,23 @@ class Coordinator:
         for blob in gathered[1:]:
             common &= set(blob["steps"])
         return sorted(common)
+
+    def announce_leave(self) -> None:
+        """Flag this rank as departing: its NEXT ``guarded_step``
+        boundary publishes status ``"leave"``, so the mesh agrees the
+        action ``leave`` AT the boundary — the departing rank exits the
+        step cleanly with its result, survivors get a prompt typed
+        ``PeerLeftError`` (and, with elastic armed, reform) instead of
+        waiting out a lease ttl.  Call :meth:`leave` after the step
+        returns to publish the durable record and stop heartbeating."""
+        self.leaving = True
+
+    def leave(self) -> None:
+        """Graceful departure from the mesh: publish the durable
+        ``cluster.leave`` record (peers see planned scale-down, not a
+        crash — see :meth:`LeaseBoard.leave`), then shut down."""
+        self.leases.leave()
+        self.shutdown()
 
     def shutdown(self) -> None:
         """Stop the heartbeat (the lease then expires after ttl) and

@@ -13,8 +13,10 @@ from typing import Dict, Optional, Sequence
 __all__ = [
     "ClusterError",
     "PeerFailureError",
+    "PeerLeftError",
     "ClusterAbortError",
     "ConsensusTimeoutError",
+    "ReformError",
 ]
 
 
@@ -36,6 +38,35 @@ class PeerFailureError(ClusterError):
         self.rank = rank
         self.age_s = age_s
         self.bundle = bundle
+
+
+class PeerLeftError(ClusterError):
+    """A peer rank left the mesh *cleanly*: it published a
+    ``cluster.leave`` record before letting its lease lapse, so this is
+    planned scale-down, not a crash — no crash bundle is written and
+    ``cluster.peer_failures`` does not tick (the false-alarm fix).
+    With the elastic layer armed this triggers mesh reformation exactly
+    like a :class:`PeerFailureError`; without it, callers see a typed,
+    attributable departure instead of a fabricated failure."""
+
+    def __init__(self, message: str, *, rank: Optional[int] = None):
+        super().__init__(message)
+        self.rank = rank
+
+
+class ReformError(ClusterError):
+    """Elastic mesh reformation failed: the membership consensus did
+    not converge (live-set views kept diverging, or a timeout expired),
+    or the post-agreement rebuild/restore raised.  ``stage`` names the
+    reformation stage that failed; the original recovery error (if the
+    reformation was failure-triggered) should be chained as the
+    cause."""
+
+    def __init__(self, message: str, *, stage: Optional[str] = None,
+                 gen: Optional[int] = None):
+        super().__init__(message)
+        self.stage = stage
+        self.gen = gen
 
 
 class ClusterAbortError(ClusterError):

@@ -32,7 +32,7 @@ import threading
 import time
 from typing import Optional
 
-from .errors import PeerFailureError
+from .errors import PeerFailureError, PeerLeftError
 
 __all__ = ["LeaseBoard"]
 
@@ -70,9 +70,17 @@ class LeaseBoard:
         # staleness is judged against the last KNOWN renewal, and
         # "never joined" only ever fires for a peer we have never seen
         self._last_seen: dict = {}
+        # ranks with a published cluster.leave record: a clean departure
+        # is remembered (a leave never un-happens within one namespace),
+        # and each is journaled as an observed departure exactly once
+        self._left: set = set()
+        self._left_journaled: set = set()
 
     def _key(self, rank: int) -> str:
         return f"{self.ns}/lease/r{rank}"
+
+    def _leave_key(self, rank: int) -> str:
+        return f"{self.ns}/leave/r{rank}"
 
     # -- heartbeat ---------------------------------------------------------
     def renew(self) -> None:
@@ -115,6 +123,34 @@ class LeaseBoard:
         indistinguishable from a crash, so expiry is the one signal)."""
         self._stop.set()
 
+    def leave(self) -> None:
+        """Graceful departure: publish a durable ``leave`` record BEFORE
+        the lease can lapse, then stop the heartbeat.  Peers that later
+        see this rank's lease expire find the record and surface a typed
+        :class:`PeerLeftError` — planned scale-down, no crash bundle, no
+        ``cluster.peer_failures`` false alarm — which the elastic layer
+        turns into a reformation instead of an abort."""
+        from . import epoch
+        from .. import obs
+
+        self.kv.set(self._leave_key(self.rank), json.dumps({
+            "t": time.time(), "pid": os.getpid(),
+            "epoch": epoch.current()}))
+        if obs.enabled():
+            obs.record_event("cluster.member", rank=self.rank,
+                             change="leave", world=self.world)
+        self.stop()
+
+    def peer_left(self, rank: int) -> bool:
+        """Did ``rank`` publish a clean-departure record?  Positive
+        answers are cached (a leave is permanent within a namespace)."""
+        if rank in self._left:
+            return True
+        if self.kv.try_get(self._leave_key(rank)) is not None:
+            self._left.add(rank)
+            return True
+        return False
+
     # -- expiry detection --------------------------------------------------
     def peer_age(self, rank: int, now: Optional[float] = None
                  ) -> Optional[float]:
@@ -148,9 +184,45 @@ class LeaseBoard:
             if age is None:
                 if now - self._start <= self.join_grace:
                     continue    # join grace: the peer may still be booting
+                if self.peer_left(rank):
+                    self._peer_departed(rank)
                 self._peer_failed(rank, None)
             elif age > self.ttl:
+                # an expired lease with a leave record is planned
+                # scale-down, not a death: typed PeerLeftError, no
+                # crash bundle, no peer_failures counter
+                if self.peer_left(rank):
+                    self._peer_departed(rank)
                 self._peer_failed(rank, age)
+
+    def live_ranks(self, now: Optional[float] = None) -> list:
+        """Ranks this board currently believes are members: self, plus
+        every peer with a fresh (``<= ttl``) lease and no leave record —
+        the local input to the elastic membership consensus.  Peers
+        never seen at all are excluded (a booting replacement enters
+        through the join path, not by being presumed alive)."""
+        now = time.time() if now is None else now
+        live = [self.rank]
+        for rank in range(self.world):
+            if rank == self.rank:
+                continue
+            if self.peer_left(rank):
+                continue
+            age = self.peer_age(rank, now)
+            if age is not None and age <= self.ttl:
+                live.append(rank)
+        return sorted(live)
+
+    def _peer_departed(self, rank: int) -> None:
+        from .. import obs
+
+        if obs.enabled() and rank not in self._left_journaled:
+            self._left_journaled.add(rank)
+            obs.record_event("cluster.member", rank=rank, change="left",
+                             observed_by=self.rank, world=self.world)
+        raise PeerLeftError(
+            f"peer rank {rank} left the mesh cleanly (cluster.leave "
+            f"record found; observed by rank {self.rank})", rank=rank)
 
     def _peer_failed(self, rank: int, age: Optional[float]) -> None:
         from .. import obs

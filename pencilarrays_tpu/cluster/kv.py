@@ -94,6 +94,26 @@ class FileKV:
         except FileNotFoundError:
             pass
 
+    def list_dir(self, prefix: str) -> dict:
+        """All ``key -> value`` pairs directly under ``prefix`` (one
+        level, no recursion) — the discovery primitive the elastic
+        layer uses to find pending join requests.  Missing prefix means
+        no entries; unreadable entries (a concurrent atomic publish) are
+        skipped, never raised."""
+        root = self._path(prefix)
+        out = {}
+        try:
+            names = sorted(os.listdir(root))
+        except OSError:
+            return out
+        for name in names:
+            if not _SEGMENT_RE.match(name):
+                continue
+            v = self.try_get(f"{prefix}/{name}")
+            if v is not None:
+                out[f"{prefix}/{name}"] = v
+        return out
+
 
 class JaxKV:
     """The jax distributed runtime's KV store (the coordinator service).
@@ -175,6 +195,19 @@ class JaxKV:
             self._client.key_value_delete(key)
         except Exception:
             pass
+
+    def list_dir(self, prefix: str) -> dict:
+        """Directory listing via the coordinator's ``key_value_dir_get``
+        (present on every jaxlib this tree supports); an older client
+        without it degrades to an empty listing — join discovery then
+        simply finds nobody, it never crashes a reformation."""
+        get = getattr(self._client, "key_value_dir_get", None)
+        if get is None:
+            return {}
+        try:
+            return {k: v for k, v in get(prefix)}
+        except Exception:
+            return {}
 
 
 def resolve_kv(env_value: str):

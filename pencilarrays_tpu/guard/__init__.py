@@ -82,6 +82,7 @@ __all__ = [
     "finite_tick",
     "watchdog",
     "guarded_step",
+    "elastic_step",
     "write_crash_bundle",
     "note_plan",
 ]
@@ -235,10 +236,10 @@ def _forced(mode: str, directory: Optional[str] = None):
 def __getattr__(name):
     # Heavy pieces load lazily so the gate itself stays import-light
     # (transpositions imports this package at module import time).
-    if name == "guarded_step":
-        from .recover import guarded_step
+    if name in ("guarded_step", "elastic_step"):
+        from . import recover as _recover
 
-        return guarded_step
+        return getattr(_recover, name)
     if name in ("write_crash_bundle", "note_plan"):
         from . import bundle as _bundle
 

@@ -44,7 +44,7 @@ from typing import Callable, Optional
 
 from .errors import HangTimeoutError, IntegrityError
 
-__all__ = ["guarded_step"]
+__all__ = ["guarded_step", "elastic_step"]
 
 
 def _journal(stage: str, label: str, **fields) -> None:
@@ -221,13 +221,19 @@ def _mesh_guarded_step(coord, fn, ckpt_mgr, restore, policy, label,
         delay = (policy.delay_for(attempt) if attempt < attempts else None)
         can_retry = (restored_step is None and delay is not None
                      and time.monotonic() - start + delay <= policy.deadline)
+        # a rank flagged via announce_leave() publishes its departure AT
+        # the boundary (only from a CLEAN attempt: a failing leaver must
+        # not masquerade as a planned departure)
+        leaving = err is None and getattr(coord, "leaving", False)
         verdict = coord.agree(label, {
-            "status": ("ok" if err is None else
+            "status": ("leave" if leaving else
+                       "ok" if err is None else
                        "hang" if isinstance(err, HangTimeoutError)
                        else "integrity"),
             "error": f"{type(err).__name__}: {err}" if err else None,
-            "can_retry": bool(can_retry),
-            "can_restore": (restored_step is None and ckpt_mgr is not None
+            "can_retry": bool(can_retry) and not leaving,
+            "can_restore": (not leaving and restored_step is None
+                            and ckpt_mgr is not None
                             and restore is not None),
         })
         action = verdict["action"]
@@ -243,6 +249,18 @@ def _mesh_guarded_step(coord, fn, ckpt_mgr, restore, policy, label,
                      epoch=verdict["epoch"], delay_s=delay)
             time.sleep(delay)   # can_retry was AND-merged: delay is set
             continue
+        if action == "leave":
+            # planned departures announced at the boundary: the leavers
+            # exit the step cleanly with their result; survivors raise
+            # the typed departure (no bundle, no peer_failures) that
+            # elastic_step turns into a reformation
+            from ..cluster import PeerLeftError
+
+            if coord.rank in verdict["ranks"]:
+                return out
+            raise PeerLeftError(
+                f"{label}: rank(s) {verdict['ranks']} announced a clean "
+                f"departure at the step boundary", rank=verdict["ranks"][0])
         if action == "restore":
             # the coordinated restore runs under the same watchdog
             # discipline as the step: a rank wedged in election I/O or
@@ -279,3 +297,88 @@ def _mesh_guarded_step(coord, fn, ckpt_mgr, restore, policy, label,
             f"{verdict['ranks']} failed unrecoverably "
             f"({verdict.get('errors')})",
             ranks=verdict["ranks"], errors=verdict.get("errors"))
+
+
+def elastic_step(fn: Callable, *, ckpt_mgr=None,
+                 restore: Optional[Callable] = None, retry=None,
+                 label: str = "step",
+                 watchdog_timeout: Optional[float] = None,
+                 coordinator=None, rebuild: Optional[Callable] = None,
+                 max_reforms: int = 4):
+    """:func:`guarded_step` plus the elastic rung: retry → restore →
+    **reform+restore** → re-raise.
+
+    When the mesh ladder ends in a peer-loss error —
+    :class:`~pencilarrays_tpu.cluster.PeerFailureError` (a SIGKILLed or
+    wedged rank) or :class:`~pencilarrays_tpu.cluster.PeerLeftError`
+    (planned scale-down) — and the elastic layer is armed
+    (``PENCILARRAYS_TPU_ELASTIC``), the survivors run
+    :func:`~pencilarrays_tpu.cluster.elastic.reform`: membership
+    consensus, a reformed (smaller or re-grown) coordinator, plan
+    rebuild (registered factories + ``rebuild`` callback), and a
+    coordinated restore of the agreed checkpoint across the changed
+    decomposition — then the step reruns under the reformed mesh.  Up
+    to ``max_reforms`` reformations are attempted per call (a cascade
+    of failures shrinks the mesh repeatedly until the
+    ``ELASTIC_MIN_WORLD`` floor).
+
+    With the gate off (the shipped default) — or no active coordinator
+    — this function IS :func:`guarded_step`: the peer-loss error
+    propagates exactly as in PR 6 (test-pinned), and the single-process
+    local ladder is untouched.  A failed reformation journals
+    ``guard.recover`` stage ``failed`` and re-raises the ORIGINAL
+    peer-loss error with the reformation failure chained as context."""
+    from .. import cluster
+    from ..cluster import PeerFailureError, PeerLeftError, elastic
+
+    coord = coordinator
+    if coord is None:
+        coord = cluster.coordinator()
+    reforms = 0
+    reformed = None
+    while True:
+        t_attempt = time.monotonic()
+        try:
+            out = guarded_step(fn, ckpt_mgr=ckpt_mgr, restore=restore,
+                               retry=retry, label=label,
+                               watchdog_timeout=watchdog_timeout,
+                               coordinator=coord)
+            if reformed is not None:
+                _journal("recovered", label, rank=coord.rank,
+                         via="reform", step=reformed.restored_step,
+                         epoch=reformed.membership.epoch,
+                         gen=reformed.membership.gen)
+            return out
+        except (PeerFailureError, PeerLeftError) as e:
+            if not elastic.enabled() or coord is None:
+                raise           # PR 6 semantics, bit-for-bit
+            if reforms >= max_reforms:
+                _journal("failed", label, rank=coord.rank, error=str(e),
+                         escalation="max-reforms", reforms=reforms)
+                raise
+            reforms += 1
+            planned = isinstance(e, PeerLeftError)
+            # NOT detection latency: this spans the whole attempt (step
+            # compute + retries + the boundary exchange).  True detect
+            # time is bounded by the lease ttl and measured as such in
+            # the --elastic bench arm; mislabeling this as detect_s
+            # would corrupt the MTTR breakdown operators tune against.
+            failed_after_s = time.monotonic() - t_attempt
+            _journal("reform", label, rank=coord.rank,
+                     peer=getattr(e, "rank", None), planned=planned,
+                     failed_after_s=failed_after_s, error=str(e))
+            try:
+                r = elastic.reform(
+                    coord, reason="leave" if planned else "peer-failure",
+                    ckpt_mgr=ckpt_mgr, restore=restore, rebuild=rebuild)
+            except BaseException as re:
+                _journal("failed", label, rank=coord.rank,
+                         escalation="reform",
+                         error=f"{type(re).__name__}: {re}")
+                raise e from re
+            coord = r.coordinator
+            reformed = r
+            # rerun the step under the reformed mesh (the restore rung
+            # already reloaded the agreed checkpoint); "recovered" is
+            # journaled only once the rerun actually succeeds
+            continue

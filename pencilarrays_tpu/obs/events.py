@@ -93,6 +93,9 @@ CRITICAL_EVENTS = frozenset({
     # verdicts opt OUT per record via record_event's _fsync override,
     # so criticality never rides the healthy per-step path)
     "guard.epoch", "cluster.lease", "cluster.verdict",
+    # elastic reformation: every stage record gates (or attributes) a
+    # membership decision, and mid-reform is exactly when writers die
+    "cluster.reform", "cluster.member",
     # a flagged straggler gates a scheduling/ops decision and the
     # flagging rank may be about to act on it
     "cluster.straggler",

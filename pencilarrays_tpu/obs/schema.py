@@ -60,6 +60,12 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     # mesh coordination layer (cluster/)
     "cluster.lease": ("rank", "status"),
     "cluster.verdict": ("label", "action", "epoch"),
+    # elastic mesh reformation (cluster/elastic.py): the reformation
+    # timeline (stages begin/view/membership/mesh/replan/restore/
+    # complete/failed, plus join-request/join) and membership changes
+    # (leave/left/drop/join)
+    "cluster.reform": ("gen", "stage"),
+    "cluster.member": ("rank", "change"),
     # mesh observability plane (PR 7)
     "cluster.straggler": ("rank", "hop", "excess_s", "baseline_s"),
     "clock.sync": ("ref_rank", "offset_s", "method"),

@@ -329,6 +329,7 @@ _TRACK_OF = {
     "cluster.verdict": "cluster", "cluster.lease": "cluster",
     "cluster.straggler": "cluster", "clock.sync": "cluster",
     "obs.agg": "cluster",
+    "cluster.reform": "cluster", "cluster.member": "cluster",
 }
 
 # events exported as complete ("X") spans: payload field holding the
@@ -361,6 +362,10 @@ def _span_name(e: dict) -> str:
         return f"epoch {e.get('epoch', '?')}"
     if ev == "cluster.straggler":
         return f"straggler r{e.get('rank', '?')}"
+    if ev == "cluster.reform":
+        return f"reform g{e.get('gen', '?')}:{e.get('stage', '?')}"
+    if ev == "cluster.member":
+        return f"member r{e.get('rank', '?')}:{e.get('change', '?')}"
     return ev
 
 
@@ -407,6 +412,11 @@ def to_trace(tl: MergedTimeline) -> dict:
                    "tid": tid, "ts": ts_end, "s": "t", "args": args}
             if ev == "guard.epoch":
                 rec["s"] = "g"   # the shared cross-rank marker
+            elif ev == "cluster.reform" and e.get("stage") in (
+                    "membership", "complete"):
+                # reformation boundaries are mesh-wide alignment lines,
+                # exactly like epoch advances (which they also cause)
+                rec["s"] = "g"
             out.append(rec)
     return {"traceEvents": out, "displayTimeUnit": "ms",
             "otherData": {
@@ -480,7 +490,8 @@ def render(tl: MergedTimeline, *, max_groups: int = 200) -> str:
                 if ev in ("fault", "guard.sdc", "guard.hang",
                           "guard.recover", "cluster.verdict",
                           "cluster.straggler", "guard.epoch",
-                          "guard.bundle", "retry"):
+                          "guard.bundle", "retry",
+                          "cluster.reform", "cluster.member"):
                     loud.append(_span_name(e))
                 else:
                     counts[ev] = counts.get(ev, 0) + 1

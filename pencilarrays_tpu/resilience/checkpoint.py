@@ -393,20 +393,28 @@ class CheckpointManager:
         if obs.enabled():
             obs.record_event("ckpt.verify", step=step, ok=True)
 
-    def _verify_dataset(self, step: int, manifest: dict, name: str,
-                        ds: dict) -> None:
-        shape = tuple(ds["dims_logical"]) + tuple(ds["extra_dims"])
+    def _checksum_blocks(self, step: int, manifest: dict, name: str,
+                         ds: dict) -> Optional[List[dict]]:
+        """Manifest blocks eligible for CRC verification, or ``None``
+        when checksums are absent or the writer's algorithm is
+        unavailable here.  A checkpoint is verified with the WRITER's
+        algorithm; when this host cannot compute it, degrade to
+        structural checks rather than falsely failing (or falsely
+        passing) CRCs."""
         blocks = ds.get("blocks")
         algo = manifest.get("algo")
         if blocks is not None and not checksum.supported(algo):
-            # a checkpoint is verified with the WRITER's algorithm; when
-            # this host cannot compute it, degrade to structural checks
-            # rather than falsely failing (or falsely passing) CRCs
             logger.warning(
                 "checkpoint step %d: checksum algorithm %r unavailable on "
                 "this host — skipping CRC verification of dataset %r",
                 step, algo, name)
-            blocks = None
+            return None
+        return blocks
+
+    def _verify_dataset(self, step: int, manifest: dict, name: str,
+                        ds: dict) -> None:
+        shape = tuple(ds["dims_logical"]) + tuple(ds["extra_dims"])
+        blocks = self._checksum_blocks(step, manifest, name, ds)
         data_path = os.path.join(self._step_dir(step),
                                  manifest.get("data_file", self._data_name))
         if blocks is not None:
@@ -424,6 +432,15 @@ class CheckpointManager:
             # layout (chunks-layout and Orbax checkpoints land here)
             self._check_dataset_present(step, data_path, name)
             return
+        self._verify_block_list(step, manifest, name, ds, blocks)
+
+    def _verify_block_list(self, step: int, manifest: dict, name: str,
+                           ds: dict, blocks: List[dict]) -> None:
+        """Checksum-verify ``blocks`` (any subset of the manifest's
+        block list) against the stored data."""
+        algo = manifest.get("algo")
+        data_path = os.path.join(self._step_dir(step),
+                                 manifest.get("data_file", self._data_name))
         try:
             with self._open_block_reader(manifest, data_path, name,
                                          ds) as read_block:
@@ -457,6 +474,70 @@ class CheckpointManager:
                 f"checkpoint step {step} dataset {name!r}: data unreadable "
                 f"({type(e).__name__}: {e})",
                 step=step, dataset=name, path=data_path) from e
+
+    def _verify_dataset_local(self, step: int, manifest: dict, name: str,
+                              ds: dict, pencil) -> None:
+        """Cross-decomposition restore verification: map the WRITER's
+        global-corner block manifest onto the READER pencil's local
+        extents and checksum-verify exactly the intersecting blocks.
+
+        The manifest keys blocks by logical-order global corner — a
+        deliberately decomposition-independent address — so a reformed
+        mesh (different process count, different decomposition, even
+        ``world == 1``) can restore a checkpoint written under a
+        topology that no longer exists, verifying only the bytes this
+        process is about to trust instead of re-reading the whole
+        global array on every rank.  Degrades exactly like
+        :meth:`_verify_dataset` when checksums are absent or the
+        writer's algorithm is unavailable here."""
+        blocks = self._checksum_blocks(step, manifest, name, ds)
+        if blocks is None:
+            data_path = os.path.join(
+                self._step_dir(step),
+                manifest.get("data_file", self._data_name))
+            self._check_dataset_present(step, data_path, name)
+            return
+        self._verify_block_list(step, manifest, name, ds,
+                                self._local_blocks(pencil, ds, blocks))
+
+    @staticmethod
+    def _local_blocks(pencil, ds: dict, blocks: List[dict]) -> List[dict]:
+        """The manifest blocks whose logical-order global extents
+        intersect any block of ``pencil`` owned by THIS process (every
+        block, on a single-process mesh)."""
+        import jax
+
+        from ..parallel.pencil import LogicalOrder
+
+        nd = len(ds["dims_logical"])
+        proc = jax.process_index()
+        topo = pencil.topology
+        local_ranges = []
+        for rank in range(len(topo)):
+            coords = topo.coords(rank)
+            if topo.device(coords).process_index != proc:
+                continue
+            local_ranges.append(pencil.range_local(coords, LogicalOrder))
+        return CheckpointManager._blocks_intersecting(
+            local_ranges, nd, blocks)
+
+    @staticmethod
+    def _blocks_intersecting(local_ranges, nd: int,
+                             blocks: List[dict]) -> List[dict]:
+        """Pure intersection: manifest blocks (logical-order global
+        ``start``/``shape``, the first ``nd`` dims being the spatial
+        ones) overlapping any of ``local_ranges`` (tuples of ``range``
+        per spatial dim)."""
+        out = []
+        for b in blocks:
+            start, bshape = b["start"], b["shape"]
+            for rngs in local_ranges:
+                if all(start[d] < rngs[d].stop
+                       and start[d] + bshape[d] > rngs[d].start
+                       for d in range(nd)):
+                    out.append(b)
+                    break
+        return out
 
     def _check_dataset_present(self, step: int, data_path: str,
                                name: str) -> None:
@@ -691,12 +772,17 @@ class Checkpoint:
         return sorted(self.manifest["datasets"])
 
     def read(self, name: str, pencil, extra_dims: Optional[Tuple] = None,
-             *, verify: Optional[bool] = None):
+             *, verify=None):
         """Read dataset ``name`` into ``pencil`` (any decomposition or
         process count — the drivers' restart contract).  With
         verification on, every manifest block is checksum-validated
         first; corruption raises :class:`CorruptCheckpointError` instead
-        of returning garbage."""
+        of returning garbage.  ``verify="local"`` is the
+        cross-decomposition restore mode: only the writer's manifest
+        blocks that intersect THIS process's local extents of
+        ``pencil`` are verified — what an elastic reformation onto a
+        smaller mesh wants, where re-verifying the whole global array
+        on every surviving rank would multiply restore latency."""
         from ..io import open_file
         from ..utils.timers import timeit
 
@@ -712,7 +798,10 @@ class Checkpoint:
         if obs.enabled():
             t0 = time.perf_counter()
         with timeit(self.manager.timer, "checkpoint restore"):
-            if do_verify:
+            if do_verify == "local":
+                self.manager._verify_dataset_local(
+                    self.step, mf, name, mf["datasets"][name], pencil)
+            elif do_verify:
                 self.manager._verify_dataset(self.step, mf, name,
                                              mf["datasets"][name])
             data_path = os.path.join(

@@ -30,6 +30,17 @@ KV namespace — a KV root is one job incarnation):
 * ``restore`` — fresh processes (all ranks, including the previous
   victim's slot) elect ``common_latest_valid()`` and restore it: the
   coordinated-restore rerun must be bit-identical to ground truth.
+* ``elastic`` / ``elastic_ref`` — the ISSUE 8 elastic-reformation
+  drill: every rank runs ``nsteps`` checkpointed ``elastic_step``
+  iterations of the same deterministic state evolution.  In
+  ``elastic``, rank ``world-1`` is SIGKILLed mid-step-3
+  (``hop.exchange:kill%rank<v>``): survivors must detect the loss by
+  lease expiry, run the membership consensus, reform to ``world-1``
+  ranks (dense reindex, generation-suffixed namespace), re-plan,
+  restore the agreed step-2 checkpoint through the cross-decomposition
+  read path, rerun the killed step and FINISH — printing a
+  ``FINAL=<sha256>`` digest that must be bit-identical to the
+  never-killed ``elastic_ref`` run's.
 * ``straggle`` / ``control`` — the PR 7 straggler drill: every rank
   runs the same guarded transpose steps, with rank 1 dragged by the
   deterministic ``hop.exchange:delay%rank1`` fault (``straggle``) or
@@ -158,6 +169,42 @@ def main():
         back = mgr.restore(step).read("u", pen)
         assert np.array_equal(pa.gather(back), truth), \
             "coordinated restore is not bit-identical to ground truth"
+    elif phase in ("elastic", "elastic_ref"):
+        import hashlib
+
+        os.environ["PENCILARRAYS_TPU_ELASTIC"] = "1"
+        nsteps, kill_step = 4, 3
+        if phase == "elastic":
+            # 2 hop.exchange hits per step (the two transposes of the
+            # step body): the victim dies on the FIRST transpose of
+            # step `kill_step`
+            os.environ["PENCILARRAYS_TPU_FAULTS"] = (
+                f"hop.exchange:kill%rank{world - 1}"
+                f"@{2 * (kill_step - 1) + 1}")
+        state = {"u": pa.PencilArray.from_global(pen, truth)}
+
+        def evolve(x):
+            return type(x)(x.pencil, x.data * 1.25 - 0.5, x.extra_dims)
+
+        def estep():
+            return pa.transpose(pa.transpose(state["u"], pen2), pen)
+
+        def erestore(ckpt):
+            # the cross-decomposition restore path: the writer's
+            # global-corner block manifest mapped onto THIS (possibly
+            # reformed) mesh's local extents, checksum-verified
+            state["u"] = ckpt.read("u", pen, verify="local")
+
+        mgr.save(0, {"u": state["u"]})
+        for k in range(1, nsteps + 1):
+            out = guard.elastic_step(
+                estep, ckpt_mgr=mgr, restore=erestore,
+                retry=RetryPolicy(max_attempts=2, base_delay=0.01),
+                label=f"estep{k}")
+            state["u"] = evolve(out)
+            mgr.save(k, {"u": state["u"]})
+        final = np.ascontiguousarray(np.asarray(pa.gather(state["u"])))
+        print(f"FINAL={hashlib.sha256(final.tobytes()).hexdigest()}")
     elif phase in ("straggle", "control"):
         from pencilarrays_tpu import cluster
 

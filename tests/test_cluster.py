@@ -47,9 +47,13 @@ from pencilarrays_tpu.resilience import CheckpointManager, RetryPolicy, faults
 def _clean(monkeypatch):
     """Every test starts with cluster/guard/obs disabled, faults
     cleared, epoch 0."""
+    from pencilarrays_tpu.cluster import elastic as elastic_mod
+
     for var in (cluster.ENV_VAR, cluster.RANK_VAR, cluster.WORLD_VAR,
                 cluster.LEASE_TTL_VAR, cluster.VERDICT_TIMEOUT_VAR,
-                guard.ENV_VAR, obs.ENV_VAR, faults.ENV_VAR):
+                guard.ENV_VAR, obs.ENV_VAR, faults.ENV_VAR,
+                elastic_mod.ENV_VAR, elastic_mod.TIMEOUT_VAR,
+                elastic_mod.MIN_WORLD_VAR):
         monkeypatch.delenv(var, raising=False)
     cluster._reset_for_tests()
     guard._reset_for_tests()
@@ -724,3 +728,368 @@ def test_epoch_stamped_into_crash_bundle(tmp_path):
     path = guard.write_crash_bundle("test", "epoch-stamp")
     with open(os.path.join(path, "MANIFEST.json")) as f:
         assert json.load(f)["epoch"] == 7
+
+
+# ---------------------------------------------------------------------------
+# elastic mesh reformation (ISSUE 8): leave, membership, reform, rejoin
+# ---------------------------------------------------------------------------
+
+from pencilarrays_tpu.cluster import (PeerLeftError, ReformError,  # noqa: E402
+                                      elastic)
+
+
+def test_merge_leave_action():
+    """Every non-ok status being a clean 'leave' merges to action
+    'leave' — planned scale-down, never a recovery verdict; a leave
+    MIXED with a real failure still enters the recovery merge."""
+    ok = {"status": "ok", "can_retry": True, "can_restore": True}
+    bye = {"status": "leave", "can_retry": False, "can_restore": False}
+    v = merge_statuses([ok, bye])
+    assert v["action"] == "leave" and v["ranks"] == [1]
+    bad = {"status": "integrity", "can_retry": True, "can_restore": True,
+           "error": "sdc"}
+    v = merge_statuses([bad, bye, ok])
+    assert v["action"] in ("restore", "raise")   # leaver blocks all-retry
+
+
+def test_graceful_leave_is_typed_not_a_failure(tmp_path):
+    """Satellite: a rank that published ``cluster.leave`` before its
+    lease lapsed surfaces as PeerLeftError — NOT PeerFailureError, no
+    crash bundle, no ``cluster.peer_failures`` tick — and the journal
+    carries the leave + observed-departure membership records."""
+    obs.enable(str(tmp_path / "obs"))
+    guard.enable(str(tmp_path / "bundles"))
+    kv = FileKV(str(tmp_path / "kv"))
+    a = LeaseBoard(kv, 0, 2, ttl=0.3)
+    b = LeaseBoard(kv, 1, 2, ttl=0.3)
+    a.start()
+    b.start()
+    a.check_peers()
+    b.leave()
+    time.sleep(0.9)
+    with pytest.raises(PeerLeftError) as ei:
+        a.check_peers()
+    assert ei.value.rank == 1
+    assert not isinstance(ei.value, PeerFailureError)
+    assert not os.path.exists(str(tmp_path / "bundles"))   # no false alarm
+    snap = obs.snapshot()
+    assert not any(k.startswith("cluster.peer_failures")
+                   for k in snap["counters"]), snap["counters"]
+    events = obs.read_journal(str(tmp_path / "obs"))
+    assert obs.lint_journal(events) == []
+    changes = [(e["rank"], e["change"]) for e in events
+               if e["ev"] == "cluster.member"]
+    assert (1, "leave") in changes      # announced by the leaver
+    assert (1, "left") in changes       # observed by the survivor
+    a.stop()
+    obs.disable()
+
+
+def test_live_ranks_excludes_dead_and_left(tmp_path):
+    kv = FileKV(str(tmp_path / "kv"))
+    boards = {r: LeaseBoard(kv, r, 3, ttl=0.4) for r in range(3)}
+    for b in boards.values():
+        b.start()
+    time.sleep(0.1)
+    assert boards[0].live_ranks() == [0, 1, 2]
+    boards[1].leave()                    # clean departure
+    boards[2].stop()                     # crash: renewals just stop
+    time.sleep(0.9)
+    assert boards[0].live_ranks() == [0]
+    boards[0].stop()
+
+
+def test_reform_shrinks_world_and_advances_epoch(tmp_path):
+    """Two survivors of a 3-rank mesh reform together: same agreed
+    membership/generation/epoch on both, dense reindex, and the
+    reformed pair immediately reaches consensus in the new
+    namespace."""
+    obs.enable(str(tmp_path / "obs"))
+    kv = FileKV(str(tmp_path / "kv"))
+    coords = {r: Coordinator(kv, r, 3, lease_ttl=0.4, verdict_timeout=20)
+              for r in range(3)}
+    coords[2].shutdown()                 # rank 2 "dies"
+    time.sleep(0.9)
+    try:
+        res = _run_ranks(
+            lambda: elastic.reform(coords[0], reason="peer-failure",
+                                   install=False),
+            lambda: elastic.reform(coords[1], reason="peer-failure",
+                                   install=False))
+        m0, m1 = res[0].membership, res[1].membership
+        assert m0.members == m1.members == [0, 1]
+        assert m0.gen == m1.gen == 1
+        assert m0.epoch == m1.epoch == 1
+        assert (m0.new_rank, m1.new_rank) == (0, 1)
+        assert m0.new_world == 2
+        assert m0.namespace == m1.namespace
+        assert epoch.current() == 1
+        ok = {"status": "ok", "can_retry": True, "can_restore": False}
+        post = _run_ranks(lambda: res[0].coordinator.agree("post", ok),
+                          lambda: res[1].coordinator.agree("post", ok))
+        assert post[0] == post[1] and post[0]["action"] == "ok"
+        events = obs.read_journal(str(tmp_path / "obs"))
+        assert obs.lint_journal(events) == []
+        stages = [e["stage"] for e in events if e["ev"] == "cluster.reform"]
+        assert stages.count("complete") == 2    # one per survivor
+        drops = [(e["rank"], e["change"]) for e in events
+                 if e["ev"] == "cluster.member"]
+        assert (2, "drop") in drops
+    finally:
+        for r in res:
+            res[r].coordinator.shutdown()
+        obs.disable()
+
+
+def test_reform_join_grows_world_back(tmp_path):
+    """Rejoin: a replacement publishes a join request; the next
+    reformation boundary admits it — the survivor and the joiner end
+    with coordinators agreeing in the same reformed namespace."""
+    kv = FileKV(str(tmp_path / "kv"))
+    c0 = Coordinator(kv, 0, 1, lease_ttl=5.0, verdict_timeout=20)
+    out = {}
+
+    def survivor():
+        # wait until the join request is visible, then hit a
+        # reformation boundary (operator-requested resize)
+        kv.get("pa/join/sspare", 20.0)
+        out["r"] = elastic.reform(c0, reason="resize", install=False)
+        return True
+
+    def joiner():
+        out["j"] = elastic.request_join(kv, "spare", namespace="pa",
+                                        timeout=30)
+        return True
+
+    try:
+        _run_ranks(survivor, joiner)
+        m = out["r"].membership
+        assert m.members == [0] and m.joiners == ["spare"]
+        assert m.new_world == 2
+        jm = out["j"].membership
+        assert jm.new_rank == 1 and jm.new_world == 2
+        assert jm.namespace == m.namespace
+        ok = {"status": "ok", "can_retry": True, "can_restore": False}
+        post = _run_ranks(lambda: out["r"].coordinator.agree("post", ok),
+                          lambda: out["j"].coordinator.agree("post", ok))
+        assert post[0] == post[1] and post[0]["action"] == "ok"
+        # the consumed request cannot re-admit a ghost at the next round
+        assert kv.try_get("pa/join/sspare") is None
+    finally:
+        c0.shutdown()
+        for k in out:
+            out[k].coordinator.shutdown()
+
+
+def test_elastic_gate_off_preserves_peer_failure(tmp_path, monkeypatch):
+    """Acceptance: with the elastic gate off (the shipped default),
+    elastic_step IS guarded_step — the PeerFailureError propagates
+    untouched and reform() is never even called."""
+    assert not elastic.enabled()
+    monkeypatch.setattr(elastic, "reform",
+                        lambda *a, **k: pytest.fail(
+                            "reform() called on the disabled path"))
+    guard.enable(str(tmp_path / "bundles"))
+    kv = FileKV(str(tmp_path / "kv"))
+    c0 = Coordinator(kv, 0, 2, lease_ttl=0.3, verdict_timeout=20)
+    time.sleep(0.8)    # rank 1 never joins; grace shrunk below
+    c0.leases.join_grace = 0.5
+    try:
+        with pytest.raises(PeerFailureError):
+            guard.elastic_step(lambda: 1, label="off",
+                               retry=RetryPolicy(max_attempts=1),
+                               coordinator=c0)
+    finally:
+        c0.shutdown()
+
+
+def test_elastic_step_reforms_restores_and_reruns(tmp_path):
+    """The new ladder rung end to end, in-process: rank 1 dies, rank 0
+    reforms to world=1, restores the agreed step through the
+    cross-decomposition read path, reruns, and returns a value
+    bit-identical to ground truth."""
+    truth = np.random.default_rng(9).standard_normal((11, 9, 13))
+    pen, u1 = _mk_state(truth)
+    pen2 = pa.Pencil(pen.topology, truth.shape, (0,))
+    obs.enable(str(tmp_path / "obs"))
+    guard.enable(str(tmp_path / "bundles"))
+    elastic.enable()
+    kv = FileKV(str(tmp_path / "kv"))
+    c0 = Coordinator(kv, 0, 2, lease_ttl=0.4, verdict_timeout=20)
+    c1 = Coordinator(kv, 1, 2, lease_ttl=0.4, verdict_timeout=20)
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=4)
+    mgr.save(1, {"u": u1})
+    state = {"u": _mk_state(truth + 1000.0)[1]}   # diverged pre-restore
+
+    def restore_cb(ckpt):
+        state["u"] = ckpt.read("u", pen, verify="local")
+
+    c1.shutdown()
+    time.sleep(0.9)
+    try:
+        out = guard.elastic_step(
+            lambda: pa.transpose(state["u"], pen2),
+            ckpt_mgr=mgr, restore=restore_cb,
+            retry=RetryPolicy(max_attempts=2, base_delay=0.01),
+            label="elastic", coordinator=c0)
+        assert np.array_equal(pa.gather(out), truth)
+        events = obs.read_journal(str(tmp_path / "obs"))
+        assert obs.lint_journal(events) == []
+        stages = [e["stage"] for e in events if e["ev"] == "cluster.reform"]
+        assert stages[0] == "begin" and stages[-1] == "complete"
+        assert "restore" in stages
+        rec = [(e["stage"], e.get("via")) for e in events
+               if e["ev"] == "guard.recover"]
+        assert ("reform", None) in rec
+        assert rec[-1] == ("recovered", "reform")
+        snap = obs.snapshot()
+        assert snap["counters"].get("cluster.reforms{outcome=ok}") == 1.0
+    finally:
+        cluster._reset_for_tests()   # shuts down the installed coordinator
+        obs.disable()
+
+
+def test_plan_registry_rebuilt_on_reform(tmp_path):
+    """Registered plan factories re-run at every reformation with the
+    new topology context, and the compiled-executable caches are
+    dropped (they are keyed by pencils of the dead mesh)."""
+    from pencilarrays_tpu.parallel import transpositions as tr
+
+    truth = np.random.default_rng(10).standard_normal((8, 6, 4))
+    pen, u = _mk_state(truth)
+    pen2 = pa.Pencil(pen.topology, truth.shape, (0,))
+    pa.gather(pa.transpose(u, pen2))     # prime a compiled hop
+    assert tr._compiled_transpose.cache_info().currsize > 0
+    built = []
+
+    def factory(ctx):
+        built.append((ctx.membership.new_world, ctx.coordinator))
+        return ("plan-for", ctx.membership.new_world)
+
+    elastic.register_plan("fft-main", factory)
+    kv = FileKV(str(tmp_path / "kv"))
+    c0 = Coordinator(kv, 0, 2, lease_ttl=0.4, verdict_timeout=20)
+    c1 = Coordinator(kv, 1, 2, lease_ttl=0.4, verdict_timeout=20)
+    c1.leave()
+    time.sleep(0.9)
+    try:
+        r = elastic.reform(c0, reason="leave", install=False)
+        assert built and built[0][0] == 1
+        assert built[0][1] is r.coordinator
+        assert elastic.plan("fft-main") == ("plan-for", 1)
+        assert tr._compiled_transpose.cache_info().currsize == 0
+    finally:
+        c0.shutdown()
+        r.coordinator.shutdown()
+
+
+def test_reform_runs_under_hang_watchdog(tmp_path, monkeypatch):
+    """Satellite bugfix: a survivor wedged during reformation (here: a
+    rebuild callback that never returns) leaves a crash bundle and a
+    typed HangTimeoutError — never a silent stall kept alive by its own
+    fresh heartbeat."""
+    from pencilarrays_tpu.guard import HangTimeoutError
+
+    guard.enable(str(tmp_path / "bundles"))
+    monkeypatch.setenv(guard.TIMEOUT_VAR, "1.0")
+    kv = FileKV(str(tmp_path / "kv"))
+    c0 = Coordinator(kv, 0, 1, lease_ttl=5.0, verdict_timeout=20)
+    try:
+        with pytest.raises(HangTimeoutError) as ei:
+            elastic.reform(c0, reason="wedged", install=False,
+                           rebuild=lambda ctx: time.sleep(30))
+        assert ei.value.bundle and os.path.isdir(ei.value.bundle)
+        snap = obs.snapshot()
+        assert snap["counters"].get(
+            "cluster.reforms{outcome=failed}", 0) >= 0
+    finally:
+        c0.shutdown()
+
+
+def test_min_world_floor_is_enforced(tmp_path, monkeypatch):
+    monkeypatch.setenv(elastic.MIN_WORLD_VAR, "2")
+    kv = FileKV(str(tmp_path / "kv"))
+    c0 = Coordinator(kv, 0, 2, lease_ttl=0.3, verdict_timeout=20)
+    time.sleep(0.7)                      # rank 1 gone (never heartbeats)
+    try:
+        with pytest.raises(ReformError, match="MIN_WORLD"):
+            elastic.reform(c0, reason="peer-failure", install=False)
+    finally:
+        c0.shutdown()
+
+
+def test_reset_clears_elastic_state():
+    """Satellite bugfix: drills must not leak elastic gate/generation/
+    registry state into later tests."""
+    elastic.enable()
+    elastic.register_plan("x", lambda ctx: 1)
+    elastic._note_gen(7)
+    cluster._reset_for_tests()
+    assert not elastic.enabled()
+    assert elastic.plans() == {}
+    assert elastic._gen == 0
+
+
+def test_announce_leave_at_step_boundary(tmp_path):
+    """The boundary-time departure path: a rank flagged via
+    announce_leave() publishes status 'leave' at its next step
+    boundary — the leaver exits the step cleanly WITH its result, the
+    survivor gets an immediate typed PeerLeftError (no ttl wait), and
+    nobody writes a crash bundle."""
+    guard.enable(str(tmp_path / "bundles"))
+    c0, c1 = _pair(tmp_path, ttl=30.0)   # huge ttl: leases CANNOT expire
+
+    def survivor():
+        t0 = time.monotonic()
+        with pytest.raises(PeerLeftError) as ei:
+            guard.guarded_step(lambda: "survivor",
+                               retry=RetryPolicy(max_attempts=1),
+                               label="drain", coordinator=c0)
+        assert ei.value.rank == 1
+        assert time.monotonic() - t0 < 20.0   # boundary, not ttl
+        return True
+
+    def leaver():
+        c1.announce_leave()
+        out = guard.guarded_step(lambda: "last-step",
+                                 retry=RetryPolicy(max_attempts=1),
+                                 label="drain", coordinator=c1)
+        assert out == "last-step"             # exits WITH its result
+        c1.leave()
+        return True
+
+    try:
+        res = _run_ranks(survivor, leaver)
+        assert res == {0: True, 1: True}
+        assert not os.path.exists(str(tmp_path / "bundles"))
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+
+
+def test_failed_reform_leaves_old_coordinator_alive(tmp_path, monkeypatch):
+    """Review hardening: a FAILED reformation must not leave this rank
+    with a heartbeat-dead coordinator (peers would declare it failed
+    after one ttl) nor leak the half-built new world's heartbeat into
+    the reformed namespace."""
+    kv = FileKV(str(tmp_path / "kv"))
+    c0 = Coordinator(kv, 0, 1, lease_ttl=0.4, verdict_timeout=20)
+
+    def boom(ctx):
+        raise RuntimeError("replan exploded")
+
+    try:
+        with pytest.raises(RuntimeError, match="replan exploded"):
+            elastic.reform(c0, reason="x", install=False, rebuild=boom)
+        # the OLD lease is still being renewed (shutdown would stop it)
+        time.sleep(0.9)
+        assert c0.leases.peer_age(0) is not None
+        assert c0.leases.peer_age(0) <= 0.4
+        # the half-built generation's lease is NOT being renewed
+        raw = kv.try_get("pa.g1/lease/r0")
+        if raw is not None:
+            time.sleep(0.9)
+            assert float(json.loads(kv.try_get("pa.g1/lease/r0"))["t"]) \
+                == float(json.loads(raw)["t"])
+    finally:
+        c0.shutdown()

@@ -443,6 +443,124 @@ def test_cluster_coordinated_recovery_4proc(tmp_path):
     _run_cluster_sequence(tmp_path, 4)
 
 
+# ---------------------------------------------------------------------------
+# elastic mesh reformation drills (ISSUE 8): SIGKILL one rank mid-step →
+# survivors shrink, re-plan, restore the agreed step, and FINISH
+# ---------------------------------------------------------------------------
+
+_FINAL_RE = re.compile(r"FINAL=([0-9a-f]{64})")
+
+
+def _final_digest(out):
+    m = _FINAL_RE.search(out)
+    assert m, f"no FINAL digest in worker output:\n{out[-2000:]}"
+    return m.group(1)
+
+
+def _assert_elastic_timeline(tmp_path, world, victim):
+    """The ``pa-obs``-linted reformation story, per survivor: the
+    victim's kill journaled from inside the dying process; lease expiry
+    naming the victim; one reform sequence begin→membership→mesh→
+    replan→restore→complete agreeing on the survivor set; the epoch
+    bump attributed to the reformation; the agreed step-2 restore; the
+    recover ladder ending ``recovered via=reform``; and NO post-reform
+    wreckage (no further expiries, no second reformation) — the mesh
+    simply finished the run."""
+    events = _cluster_events(tmp_path)
+    kills = [e for e in events if e["ev"] == "fault" and e["mode"] == "kill"]
+    assert kills and all(e["proc"] == victim and e["point"] == "hop.exchange"
+                         for e in kills), kills
+    survivors = [r for r in range(world) if r != victim]
+    for r in survivors:
+        mine = [e for e in events if e.get("proc") == r]
+        expired = [e for e in mine if e["ev"] == "cluster.lease"
+                   and e["status"] == "expired"]
+        assert expired and all(e["rank"] == victim for e in expired), \
+            (r, expired)
+        stages = [e["stage"] for e in mine if e["ev"] == "cluster.reform"]
+        assert stages.count("begin") == 1, (r, stages)
+        assert stages.count("complete") == 1, (r, stages)
+        for a, b in zip(("begin", "membership", "mesh", "replan",
+                         "restore", "complete"),
+                        ("membership", "mesh", "replan", "restore",
+                         "complete", None)):
+            if b is not None:
+                assert stages.index(a) < stages.index(b), (r, stages)
+        memb = [e for e in mine if e["ev"] == "cluster.reform"
+                and e["stage"] == "membership"]
+        assert memb[0]["members"] == survivors, (r, memb)
+        assert memb[0]["new_world"] == world - 1, (r, memb)
+        drops = [(e["rank"], e["change"]) for e in mine
+                 if e["ev"] == "cluster.member"]
+        assert (victim, "drop") in drops, (r, drops)
+        # the epoch bump is attributed to the reformation
+        bumps = [e for e in mine if e["ev"] == "guard.epoch"]
+        assert any(str(e.get("reason", "")).startswith("reform:")
+                   for e in bumps), (r, bumps)
+        # the agreed restore: step 2 (steps 0-2 committed pre-kill)
+        assert {e["step"] for e in mine if e["ev"] == "ckpt.restore"} \
+            == {2}, r
+        rec = [(e["stage"], e.get("via")) for e in mine
+               if e["ev"] == "guard.recover"]
+        assert ("reform", None) in rec, (r, rec)
+        assert ("recovered", "reform") in rec, (r, rec)
+        # every step committed: the run FINISHED after the reformation
+        commits = {e["step"] for e in mine if e["ev"] == "ckpt.commit"}
+        assert commits == {0, 1, 2, 3, 4}, (r, commits)
+        # no post-reform wreckage
+        done = next(i for i, e in enumerate(mine)
+                    if e["ev"] == "cluster.reform"
+                    and e["stage"] == "complete")
+        post = mine[done + 1:]
+        assert not [e for e in post if e["ev"] == "cluster.lease"
+                    and e["status"] == "expired"], r
+        assert not [e for e in post if e["ev"] == "cluster.reform"], r
+        assert not [e for e in post if e["ev"] == "guard.bundle"], r
+    # the victim's journal stops before any reformation record
+    assert not [e for e in events if e.get("proc") == victim
+                and e["ev"] == "cluster.reform"]
+
+
+def _run_elastic_sequence(tmp_path, world):
+    victim = world - 1
+    ref = tmp_path / "ref"
+    el = tmp_path / "el"
+    ref.mkdir()
+    el.mkdir()
+    ref_outs = _launch_cluster_phase(ref, world, "elastic_ref")
+    finals = {_final_digest(out) for out in ref_outs}
+    assert len(finals) == 1, finals     # the reference is deterministic
+    ref_final = finals.pop()
+    el_outs = _launch_cluster_phase(el, world, "elastic",
+                                    expect_kill_rank=victim)
+    for rank, out in enumerate(el_outs):
+        if rank == victim:
+            continue
+        assert _final_digest(out) == ref_final, (
+            f"rank {rank}: post-reformation output differs from the "
+            f"never-killed reference:\n{out[-2000:]}")
+    _assert_elastic_timeline(el, world, victim)
+
+
+@pytest.mark.chaos
+def test_elastic_reformation_survives_rank_loss(tmp_path):
+    """ISSUE 8 acceptance: 2-rank FileKV drill — rank 1 SIGKILLed
+    mid-step → rank 0 reforms to world=1, restores the agreed
+    epoch-stamped step, and produces bit-identical final output vs an
+    unkilled reference run, with the full detect→reform→restore→resume
+    sequence lint-clean on the pa-obs timeline."""
+    _run_elastic_sequence(tmp_path, 2)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_elastic_reformation_4rank(tmp_path):
+    """The 4-rank variant: three survivors run the membership consensus
+    together, reform to world=3 with dense reindexing, and all finish
+    bit-identically."""
+    _run_elastic_sequence(tmp_path, 4)
+
+
 @pytest.mark.chaos
 def test_cluster_straggler_detection(tmp_path):
     """PR 7 acceptance: a ``hop.exchange:delay%rank1`` fault on a

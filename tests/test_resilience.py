@@ -631,3 +631,88 @@ def test_blocks_stream_through_observer_without_extra_copy(pen):
     for b in blocks:
         sl = tuple(slice(s, s + e) for s, e in zip(b["start"], b["shape"]))
         assert crc_of_array(u[sl]) == b["crc"]
+
+
+# -- cross-decomposition restore (ISSUE 8) ---------------------------------
+def _tear_byte(step_dir):
+    path = os.path.join(step_dir, "data.bin")
+    with open(path, "r+b") as f:
+        f.seek(64)
+        b = f.read(1)
+        f.seek(64)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _reader_pencils(devices, shape):
+    """Three (writer-layout -> reader-layout) targets, including a
+    world-size change: same 4 devices re-decomposed (4,1), a 2-device
+    mesh, and a single device (``world == 1`` — the post-reformation
+    shape of the 2-rank elastic drill)."""
+    return [
+        Pencil(Topology((4, 1), devices=devices[:4]), shape, (1, 2)),
+        Pencil(Topology((1, 2), devices=devices[:2]), shape, (0, 1),
+               permutation=Permutation(2, 0, 1)),
+        Pencil(Topology((1,), devices=devices[:1]), shape, (2,)),
+    ]
+
+
+def test_cross_decomposition_restore_bit_identical(tmp_path, devices):
+    """A checkpoint written on a (2,2) decomposition restores onto
+    (4,1), (1,2) and world=1 bit-identically, with full checksum
+    verification AND the local-extent mode — the manifest keys blocks
+    by logical-order global corner, so the reader's decomposition (and
+    device count) is free to differ from the writer's."""
+    shape = (11, 13, 10)
+    truth = np.random.default_rng(21).standard_normal(shape)
+    pen_w = Pencil(Topology((2, 2), devices=devices[:4]), shape, (1, 2))
+    mgr = CheckpointManager(str(tmp_path), keep=4)
+    mgr.save(1, {"u": PencilArray.from_global(pen_w, truth)})
+    for pen_r in _reader_pencils(devices, shape):
+        ck = mgr.restore(1)
+        back = ck.read("u", pen_r, verify=True)
+        np.testing.assert_array_equal(gather(back), truth)
+        back = ck.read("u", pen_r, verify="local")
+        np.testing.assert_array_equal(gather(back), truth)
+
+
+def test_cross_decomposition_restore_skips_torn_step(tmp_path, devices):
+    """Torn-step skipping is preserved across a decomposition change:
+    the newest step's data file is corrupted, so ``latest_valid()``
+    falls back to step 1 and THAT restores cleanly onto every reader
+    layout — while explicitly reading the torn step 2 raises a typed
+    checksum failure, never garbage."""
+    shape = (11, 13, 10)
+    truth = np.random.default_rng(22).standard_normal(shape)
+    pen_w = Pencil(Topology((2, 2), devices=devices[:4]), shape, (1, 2))
+    mgr = CheckpointManager(str(tmp_path), keep=4)
+    mgr.save(1, {"u": PencilArray.from_global(pen_w, truth)})
+    mgr.save(2, {"u": PencilArray.from_global(pen_w, truth + 5.0)})
+    _tear_byte(os.path.join(str(tmp_path), "step-00000002"))
+    assert mgr.latest_valid() == 1
+    for pen_r in _reader_pencils(devices, shape):
+        back = mgr.restore(1).read("u", pen_r, verify=True)
+        np.testing.assert_array_equal(gather(back), truth)
+        with pytest.raises(CorruptCheckpointError):
+            mgr.restore(2, verify=False).read("u", pen_r, verify="local")
+
+
+def test_local_verify_blocks_intersection():
+    """The pure mapping behind ``verify="local"``: only manifest blocks
+    overlapping the reader's local extents are selected."""
+    blocks = [
+        {"start": [0, 0, 0], "shape": [4, 4, 8], "crc": 1},
+        {"start": [0, 4, 0], "shape": [4, 4, 8], "crc": 2},
+        {"start": [4, 0, 0], "shape": [4, 4, 8], "crc": 3},
+        {"start": [4, 4, 0], "shape": [4, 4, 8], "crc": 4},
+    ]
+    # reader rank owning rows 0..3 only: the two row-0 blocks intersect
+    picked = CheckpointManager._blocks_intersecting(
+        [(range(0, 4), range(0, 8), range(0, 8))], 3, blocks)
+    assert [b["crc"] for b in picked] == [1, 2]
+    # a rank owning a column slab crossing both row groups
+    picked = CheckpointManager._blocks_intersecting(
+        [(range(0, 8), range(2, 6), range(0, 8))], 3, blocks)
+    assert [b["crc"] for b in picked] == [1, 2, 3, 4]
+    # empty extents pick nothing
+    assert CheckpointManager._blocks_intersecting(
+        [(range(0, 0), range(0, 8), range(0, 8))], 3, blocks) == []
