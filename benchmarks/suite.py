@@ -706,6 +706,20 @@ def main():
     parser.add_argument("--elastic-only", action="store_true",
                         help="run ONLY the --elastic arm (fast; used to "
                              "commit the BENCH_ELASTIC.json artifact)")
+    parser.add_argument("--throughput", action="store_true",
+                        help="also run the batched many-transform "
+                             "throughput arm (benchmarks/throughput.py): "
+                             "transforms/sec batched vs per-sample-loop vs "
+                             "vmap, slab/pencil auto-decomposition verdicts "
+                             "and the r2c packing ratio; writes "
+                             "BENCH_THROUGHPUT.json")
+    parser.add_argument("--throughput-only", action="store_true",
+                        help="run ONLY the --throughput arm (used to "
+                             "commit the BENCH_THROUGHPUT.json artifact)")
+    parser.add_argument("--throughput-n", type=int, default=32,
+                        help="cube edge of the throughput grid "
+                             "(32^3 x batch<=16 keeps the CPU-mesh arm "
+                             "inside a CI budget)")
     args = parser.parse_args()
 
     import jax
@@ -786,6 +800,31 @@ def main():
             steps=60 if len(devs) > 1 else 200,
             repeats=3 if len(devs) > 1 else 5)
         if args.elastic_only:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+            print(json.dumps(results, indent=1))
+            return
+
+    # -- 12. throughput: batched many-transform mode (opt-in) --------------
+    # The ISSUE 9 headline flip: transforms/sec at fixed mesh, batched
+    # plan (bytes xB, collective count x1) vs per-sample loop vs vmap,
+    # plus the slab/pencil auto-decomposition verdict table and the r2c
+    # packing byte ratio — committed as BENCH_THROUGHPUT.json.
+    if args.throughput or args.throughput_only:
+        from benchmarks.throughput import run_throughput_suite, write_artifact
+
+        n_t = args.throughput_n
+        results["throughput"] = run_throughput_suite(
+            devs, shape=(n_t,) * 3,
+            batches=(1, 4, 16),
+            grids=((n_t,) * 3, (12, 12, 12)),
+            k1=5 if len(devs) > 1 else 9,
+            repeats=3 if len(devs) > 1 else 5)
+        write_artifact({**results["throughput"],
+                        "platform": devs[0].platform,
+                        "n_devices": len(devs)}, "BENCH_THROUGHPUT.json",
+                       devs=devs)
+        if args.throughput_only:
             with open(args.out, "w") as f:
                 json.dump(results, f, indent=1)
             print(json.dumps(results, indent=1))

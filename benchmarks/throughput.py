@@ -1,0 +1,296 @@
+"""Batched many-transform throughput sweep — writes BENCH_THROUGHPUT.json.
+
+The ISSUE 9 headline metric flip: production spectral traffic is
+millions of MEDIUM transforms, not one huge one (AccFFT arXiv:1506.07933,
+advanced-MPI FFT arXiv:1804.09536), so the number that matters is
+**transforms/sec at fixed mesh**, not seconds/transform.  Three arms per
+batch size B, all computing the SAME B independent transform round
+trips (bit-identity is asserted before anything is timed):
+
+* ``batched`` — ``PencilFFTPlan(batch=B).compile()``: ONE jitted
+  program; every hop's single collective carries the whole batch
+  (bytes xB, count x1 — per-collective latency amortized);
+* ``loop`` — the per-sample baseline: B unbatched transform chains,
+  traced into one program (the hardened timing protocol requires a
+  traceable body, and this is the GENEROUS baseline — no per-dispatch
+  Python overhead, so the measured gap is purely the B-collectives-per-
+  hop latency the batched schedule amortizes away);
+* ``vmap`` — ``jax.vmap`` over the unbatched forward/backward pair,
+  jitted: what a user gets without a batch-aware plan layer.
+
+Also captured, per the measured-verdict discipline (artifacts + the
+cost model the tests pin to HLO):
+
+* ``decomposition`` — the slab-vs-pencil auto-decomposition verdict per
+  (grid, mesh family): the pricer's scores for every candidate
+  topology, the winner, and MEASURED round-trip seconds for the best
+  slab and best pencil plan, so the model's verdict can be audited
+  against hardware (on the CPU virtual mesh the measured column is
+  dispatch-dominated — the honest comparison needs real ICI, same
+  caveat as every BENCH_* artifact to date);
+* ``r2c_packing`` — the priced schedule bytes of an r2c plan vs the
+  same-shape c2c plan: post-``rfft`` hops move the Hermitian-half
+  extents, so r2c traffic is ~half the c2c bytes at the same dtype.
+
+Usage: ``python benchmarks/throughput.py [--devices N]`` or via
+``python benchmarks/suite.py --throughput[-only]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Sequence, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _spread():
+    from pencilarrays_tpu.utils.benchtime import last_spread
+
+    sp = last_spread()
+    return {"k1_spread": sp.get("k1_worst_over_best"),
+            "slope_fallback": sp.get("slope_fallback")}
+
+
+def measure_batched_throughput(topo, shape: Tuple[int, ...],
+                               batches: Sequence[int] = (1, 4, 16), *,
+                               real: bool = True, k0: int = 1,
+                               k1: int = 9, repeats: int = 5) -> dict:
+    """Transforms/sec of the three arms per batch size.  The timed body
+    is a forward+backward ROUND TRIP (shape-preserving, as the hardened
+    K-differenced protocol requires); a "transform" below is one such
+    round trip of one sample, so ``transforms_per_s = B / t_dispatch``.
+    Bit-identity across arms is asserted on real data before timing."""
+    import jax
+    import jax.numpy as jnp
+
+    from pencilarrays_tpu import PencilArray
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+    from pencilarrays_tpu.utils.benchtime import device_seconds_per_iter
+
+    plan1 = PencilFFTPlan(topo, shape, real=real)
+    rng = np.random.default_rng(7)
+    out = {"shape": list(shape), "topo": list(topo.dims),
+           "real": bool(real), "batches": {}}
+    for B in batches:
+        planB = PencilFFTPlan(topo, shape, real=real, batch=int(B))
+        xB = planB.allocate_input()
+        host = rng.standard_normal(tuple(xB.data.shape)).astype(
+            np.dtype(planB.dtype_physical))
+        dataB = jnp.asarray(host)
+
+        def batched_rt(d):
+            u = PencilArray(planB.input_pencil, d, planB.batch_dims)
+            return planB.backward(planB.forward(u)).data
+
+        def loop_rt(d):
+            parts = []
+            for b in range(B):
+                u = PencilArray(plan1.input_pencil, d[..., b])
+                parts.append(plan1.backward(plan1.forward(u)).data)
+            return jnp.stack(parts, axis=-1)
+
+        def sample_rt(d):
+            u = PencilArray(plan1.input_pencil, d)
+            return plan1.backward(plan1.forward(u)).data
+
+        vmap_rt = jax.vmap(sample_rt, in_axes=-1, out_axes=-1)
+
+        # bit-identity gate: the three arms are the SAME computation —
+        # a mismatch means the numbers would describe a wrong program,
+        # so it is a hard error, never a buried artifact field
+        got_b = jax.jit(batched_rt)(dataB)
+        got_l = jax.jit(loop_rt)(dataB)
+        bitident = bool(jnp.array_equal(got_b, got_l))
+        if not bitident:
+            raise AssertionError(
+                f"batched != per-sample loop at B={B} on {shape}@"
+                f"{topo.dims}: refusing to time a wrong computation")
+        try:
+            got_v = jax.jit(vmap_rt)(dataB)
+            vmap_bitident = bool(jnp.array_equal(got_b, got_v))
+            vmap_err = None
+        except Exception as e:  # vmap-of-shard_map support is a jax
+            vmap_bitident = None  # version question: record, don't die
+            vmap_err = f"{type(e).__name__}: {e}"
+        if vmap_err is None and not vmap_bitident:
+            raise AssertionError(
+                f"batched != vmap at B={B} on {shape}@{topo.dims}")
+
+        t_b = device_seconds_per_iter(batched_rt, dataB, k0=k0, k1=k1,
+                                      repeats=repeats)
+        sp_b = _spread()
+        t_l = device_seconds_per_iter(loop_rt, dataB, k0=k0, k1=k1,
+                                      repeats=repeats)
+        sp_l = _spread()
+        entry = {
+            "batched": {"dispatch_s": t_b, "transforms_per_s": B / t_b,
+                        **sp_b},
+            "loop": {"dispatch_s": t_l, "transforms_per_s": B / t_l,
+                     **sp_l},
+            "batched_over_loop_speedup": t_l / t_b,
+            "bit_identical_batched_vs_loop": bitident,
+        }
+        if vmap_err is None:
+            t_v = device_seconds_per_iter(vmap_rt, dataB, k0=k0, k1=k1,
+                                          repeats=repeats)
+            entry["vmap"] = {"dispatch_s": t_v,
+                             "transforms_per_s": B / t_v, **_spread()}
+            entry["batched_over_vmap_speedup"] = t_v / t_b
+            entry["bit_identical_batched_vs_vmap"] = vmap_bitident
+        else:
+            entry["vmap"] = {"error": vmap_err}
+        out["batches"][str(int(B))] = entry
+    return out
+
+
+def measure_decomposition_verdicts(devs, grids: Sequence[Tuple[int, ...]],
+                                   *, batch: int = 4, real: bool = True,
+                                   latency_bytes: int = None,
+                                   k0: int = 1, k1: int = 5,
+                                   repeats: int = 3) -> list:
+    """Slab-vs-pencil verdicts per grid on this device set: the pricer's
+    per-candidate scores (r2c shrinkage + batch included) next to the
+    MEASURED compiled round-trip seconds of the best slab and best
+    pencil plan.  ``agree`` reports whether the model's winner was also
+    the measured winner on this backend."""
+    import jax.numpy as jnp
+
+    from pencilarrays_tpu import PencilArray, Topology
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+    from pencilarrays_tpu.parallel.transpositions import Auto
+    from pencilarrays_tpu.utils.benchtime import device_seconds_per_iter
+
+    method = (Auto(latency_bytes=latency_bytes) if latency_bytes
+              else Auto())
+    results = []
+    for shape in grids:
+        topo = Topology((len(devs),), devices=devs)
+        entry = {"shape": list(shape), "devices": len(devs),
+                 "batch": batch}
+        auto = PencilFFTPlan(topo, shape, real=real, batch=batch,
+                             method=method, decomposition="auto")
+        entry["verdict"] = {
+            k: v for k, v in auto.decomposition_verdict.items()}
+        measured = {}
+        for family in ("slab", "pencil"):
+            try:
+                plan = PencilFFTPlan(topo, shape, real=real, batch=batch,
+                                     method=method, decomposition=family)
+            except ValueError:
+                continue  # e.g. no 2-factor pencil grid for this count
+            x = plan.allocate_input()
+            data = jnp.zeros(tuple(x.data.shape),
+                             np.dtype(plan.dtype_physical))
+
+            def rt(d, plan=plan):
+                u = PencilArray(plan.input_pencil, d, plan.batch_dims)
+                return plan.backward(plan.forward(u)).data
+
+            t = device_seconds_per_iter(rt, data, k0=k0, k1=k1,
+                                        repeats=repeats)
+            measured[family] = {"dims": list(plan.topology.dims),
+                                "roundtrip_s": t, **_spread()}
+        entry["measured"] = measured
+        if len(measured) == 2:
+            meas_winner = min(measured, key=lambda f:
+                              measured[f]["roundtrip_s"])
+            entry["measured_winner"] = meas_winner
+            entry["agree"] = (meas_winner
+                              == auto.decomposition_verdict["family"])
+        results.append(entry)
+    return results
+
+
+def measure_r2c_packing(topo, shape: Tuple[int, ...], *,
+                        batch: int = 4) -> dict:
+    """Priced schedule bytes, r2c vs c2c, at the SAME spectral dtype:
+    the r2c plan's post-``rfft`` hops carry the Hermitian-half extents
+    (dim 0 shrinks to ``n//2 + 1``), so its wire traffic is ~half the
+    all-complex plan's.  Both predictions are the HLO-pinned cost model
+    (tests/test_collective_costs.py), so the ratio is exact, not
+    estimated."""
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+
+    c2c = PencilFFTPlan(topo, shape, batch=batch)
+    r2c = PencilFFTPlan(topo, shape, real=True, batch=batch)
+    b_c2c = sum(v["bytes"] for v in c2c.collective_costs().values())
+    b_r2c = sum(v["bytes"] for v in r2c.collective_costs().values())
+    return {
+        "shape": list(shape), "topo": list(topo.dims), "batch": batch,
+        "c2c_priced_bytes": b_c2c,
+        "r2c_priced_bytes": b_r2c,
+        "r2c_over_c2c": b_r2c / b_c2c if b_c2c else None,
+        # the analytic expectation for the hop-dominant shrunken dim
+        "hermitian_half_ratio": (shape[0] // 2 + 1) / shape[0],
+    }
+
+
+def run_throughput_suite(devs, *, shape=(32, 32, 32),
+                         batches=(1, 4, 16),
+                         grids=((32, 32, 32), (12, 12, 12)),
+                         k1: int = 9, repeats: int = 5) -> dict:
+    """The full ``--throughput`` arm (suite.py): batched/loop/vmap
+    transforms/sec on the mesh's natural 2-D (or 1-D) topology, the
+    slab/pencil verdict table, and the r2c byte accounting."""
+    from pencilarrays_tpu import Topology, dims_create
+
+    dims = dims_create(len(devs), 2) if len(devs) > 1 else (1,)
+    topo = (Topology(dims, devices=devs) if len(dims) > 1
+            else Topology((1,), devices=devs))
+    out = {
+        "what": ("transforms/sec at fixed mesh: batched plan (one "
+                 "collective per hop, bytes xB) vs per-sample loop vs "
+                 "vmap, + slab/pencil auto-decomposition verdicts and "
+                 "r2c packing ratio"),
+        "throughput": measure_batched_throughput(
+            topo, shape, batches, k1=k1, repeats=repeats),
+        "r2c_packing": measure_r2c_packing(topo, shape),
+    }
+    if len(devs) > 1:
+        out["decomposition"] = measure_decomposition_verdicts(
+            devs, grids, k1=max(3, k1 // 2), repeats=max(2, repeats - 2))
+    return out
+
+
+def write_artifact(results: dict, path: str = "BENCH_THROUGHPUT.json",
+                   *, devs=None) -> None:
+    doc = dict(results)
+    if devs is not None:
+        doc.setdefault("platform", devs[0].platform)
+        doc.setdefault("n_devices", len(devs))
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--out", default="BENCH_THROUGHPUT.json")
+    parser.add_argument("--n", type=int, default=32,
+                        help="cube edge of the throughput grid")
+    args = parser.parse_args()
+
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+    import jax
+
+    devs = jax.devices()[: args.devices]
+    results = run_throughput_suite(devs, shape=(args.n,) * 3)
+    results["platform"] = devs[0].platform
+    results["n_devices"] = len(devs)
+    write_artifact(results, args.out, devs=devs)
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -56,13 +56,26 @@ class NavierStokesSpectral:
     """
 
     def __init__(self, topology: Topology, n, *, viscosity: float = 1e-2,
-                 dtype=jnp.float32, dealias: bool = True):
+                 dtype=jnp.float32, dealias: bool = True,
+                 decomposition: Optional[str] = None):
         if isinstance(n, int):
             n = (n, n, n)
         self.shape = tuple(n)
         self.nu = float(viscosity)
+        # decomposition="auto" lets the plan's slab/pencil pricer pick
+        # the process grid over the topology's devices (the r2c-aware
+        # schedule score — the model's transforms are rfft x fft x fft,
+        # so spectral hops move the Hermitian-half extents); None keeps
+        # the caller's grid.  batch=3: the model's real traffic is the
+        # (3,)-component state batching through every exchange (the
+        # nonlinear term even rides a 6-component chain), so the
+        # decomposition MUST be priced at that batch — an unbatched
+        # score can pick a grid that is cheaper only for traffic the
+        # model never sends (verdicts provably flip with the batch,
+        # tests/test_throughput.py).
         self.plan = PencilFFTPlan(topology, self.shape, real=True,
-                                  dtype=dtype)
+                                  dtype=dtype, decomposition=decomposition,
+                                  batch=3)
         self.dealias = dealias
 
 

@@ -77,7 +77,11 @@ DEFAULT_DIR = "pa_obs"
 # ``step_idx`` + ``epoch`` (and ``plan_fp`` once a plan exists) — the
 # fields cross-rank timeline joins group by (obs/correlate.py).  v1
 # journals remain lint-clean: the requirement is versioned.
-SCHEMA_VERSION = 2
+# v3 (PR 9): ``plan.build`` additionally carries the batched-throughput
+# fields ``extra_dims`` (the plan's batch) and ``decomposition`` (the
+# slab/pencil verdict) — see obs/schema.py V3_EVENT_FIELDS.  v1/v2
+# journals again stay lint-clean.
+SCHEMA_VERSION = 3
 
 # events whose loss would blind a post-mortem: fsync'd under the default
 # "critical" policy.  High-rate events (per-hop dispatch) only flush.

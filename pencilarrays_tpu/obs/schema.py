@@ -25,6 +25,16 @@ COMMON_FIELDS: Tuple[str, ...] = (
 # present once a plan exists, so it is not required.
 V2_STAMP_FIELDS: Tuple[str, ...] = ("step_idx", "epoch")
 
+# per-event fields required since schema v3 (the batched-throughput
+# mode): a v3 ``plan.build`` record must journal the batch it prices
+# its schedule at (``extra_dims``) and its slab/pencil decomposition
+# verdict (``{"mode": "fixed", ...}`` for plans built on a caller-fixed
+# topology).  v1/v2 journals stay lint-clean — the requirement is
+# versioned, like the v2 correlation stamps.
+V3_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
+    "plan.build": ("extra_dims", "decomposition"),
+}
+
 # ev -> required payload fields (extra fields are allowed; missing ones
 # and unknown event types are lint errors)
 EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
@@ -107,6 +117,12 @@ def lint_event(e: dict) -> List[str]:
     for f in req:
         if f not in e:
             errors.append(f"event {ev!r} missing required field {f!r}: {e!r}")
+    if isinstance(v, (int, float)) and v >= 3:
+        for f in V3_EVENT_FIELDS.get(ev, ()):
+            if f not in e:
+                errors.append(
+                    f"v{v} event {ev!r} missing required field {f!r} "
+                    f"(batched-throughput fields, schema v3): {e!r}")
     return errors
 
 

@@ -346,6 +346,19 @@ def _span_name(e: dict) -> str:
     ev = e.get("ev", "?")
     if ev == "hop":
         return f"hop {e.get('method', '?')}"
+    if ev == "plan.build":
+        # the batched-throughput fields (schema v3): batch + the
+        # slab/pencil decomposition verdict, when the plan carries them
+        name = "plan"
+        extra = e.get("extra_dims") or []
+        if extra:
+            name += f" batch={'x'.join(str(i) for i in extra)}"
+        d = e.get("decomposition")
+        if isinstance(d, dict) and d.get("mode", "fixed") != "fixed":
+            name += (f" decomp={d.get('mode')}:"
+                     f"{d.get('family', '?')}"
+                     f"{tuple(d.get('winner', ()))}")
+        return name
     if ev in ("io.write", "io.read"):
         return f"{ev} {e.get('dataset', '?')}"
     if ev == "ckpt.restore":
@@ -492,6 +505,13 @@ def render(tl: MergedTimeline, *, max_groups: int = 200) -> str:
                           "cluster.straggler", "guard.epoch",
                           "guard.bundle", "retry",
                           "cluster.reform", "cluster.member"):
+                    loud.append(_span_name(e))
+                elif (ev == "plan.build"
+                      and isinstance(e.get("decomposition"), dict)
+                      and e["decomposition"].get("mode",
+                                                 "fixed") != "fixed"):
+                    # an auto-decomposition verdict is a planning
+                    # decision worth spelling out, like a route verdict
                     loud.append(_span_name(e))
                 else:
                     counts[ev] = counts.get(ev, 0) + 1

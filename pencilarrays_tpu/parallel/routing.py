@@ -90,7 +90,34 @@ __all__ = [
     "RouteHop",
     "plan_reshard_route",
     "execute_route",
+    "trusted_drift_hops",
+    "trusted_drift",
 ]
+
+
+def trusted_drift_hops() -> Dict[str, dict]:
+    """The drift tracker's per-hop report, for cost-model correction —
+    or ``{}`` when no samples exist yet, or when running
+    multi-controller (``process_count() > 1``): drift samples are
+    process-local, and every process must plan the same collective
+    program from the same (static) inputs.  Shared by the route
+    planner's edge pricing and the FFT planner's slab/pencil
+    auto-decomposition scoring (``ops/fft.py``), so the two pricers can
+    never disagree about which measurements steer plans."""
+    if jax.process_count() > 1 or not drift_tracker.version():
+        return {}
+    return drift_tracker.report()["hops"]
+
+
+def trusted_drift(drift_hops: Dict[str, dict], label: str) -> float:
+    """Observed drift ratio of one hop (1.0 when unmeasured).  Trusted
+    (device-protocol) samples only: dispatch wall times are lower
+    bounds on wire time (``obs/drift.py``) and host jitter must not
+    flip planning decisions."""
+    e = drift_hops.get(label)
+    if e and e.get("drift") and e.get("source") != "dispatch":
+        return float(e["drift"])
+    return 1.0
 
 
 @dataclass(frozen=True)
@@ -205,13 +232,7 @@ def _plan_cached(pin: Pencil, dest: Pencil, extra_dims: Tuple[int, ...],
     def edge(psrc: Pencil, pdst: Pencil):
         m = resolve_method(psrc, pdst, extra_dims, dtype, method)
         cost = transpose_cost(psrc, pdst, extra_dims, dtype, m)
-        drift = 1.0
-        e = drift_hops.get(_hop_label(psrc, pdst, m, dtype))
-        # trusted (device-protocol) samples only: dispatch wall times are
-        # lower bounds on wire time (drift.py) and host jitter must not
-        # flip routes
-        if e and e.get("drift") and e.get("source") != "dispatch":
-            drift = float(e["drift"])
+        drift = trusted_drift(drift_hops, _hop_label(psrc, pdst, m, dtype))
         R = assert_compatible(psrc, pdst)
         peak = _hop_peak_bytes(psrc, pdst, R, extra_dims, dtype.itemsize)
         return RouteHop(psrc, pdst, m, cost,

@@ -172,6 +172,8 @@ def main():
     elif phase in ("elastic", "elastic_ref"):
         import hashlib
 
+        from pencilarrays_tpu.cluster import elastic
+
         os.environ["PENCILARRAYS_TPU_ELASTIC"] = "1"
         nsteps, kill_step = 4, 3
         if phase == "elastic":
@@ -181,6 +183,17 @@ def main():
             os.environ["PENCILARRAYS_TPU_FAULTS"] = (
                 f"hop.exchange:kill%rank{world - 1}"
                 f"@{2 * (kill_step - 1) + 1}")
+
+        # ISSUE 9 satellite: a BATCHED plan in the elastic registry must
+        # come back from the reformation with its batch intact — the
+        # factory is re-invoked post-reform and rebuilds the same
+        # batch=3 throughput plan (each drill rank has 1 local device,
+        # so the rebuilt topology is (1,) in every generation)
+        def batched_plan_factory(ctx=None):
+            return pa.PencilFFTPlan(pa.Topology((1,)), shape, real=True,
+                                    batch=3)
+
+        elastic.register_plan("batched-fft", batched_plan_factory)
         state = {"u": pa.PencilArray.from_global(pen, truth)}
 
         def evolve(x):
@@ -203,6 +216,18 @@ def main():
                 label=f"estep{k}")
             state["u"] = evolve(out)
             mgr.save(k, {"u": state["u"]})
+        if phase == "elastic":
+            # survivors went through exactly one reformation: the
+            # registry factory must have rebuilt the batched plan with
+            # its batch (and batched execution path) intact
+            bp = elastic.plan("batched-fft")
+            assert bp is not None, \
+                "reformation did not re-invoke the batched plan factory"
+            assert bp.batch == 3 and bp.batch_dims == (3,), \
+                f"rebuilt plan lost its batch: {bp.batch!r}"
+            bout = bp.forward(bp.allocate_input())
+            assert bout.extra_dims == (3,), bout.extra_dims
+            print(f"REPLAN_BATCH={bp.batch}")
         final = np.ascontiguousarray(np.asarray(pa.gather(state["u"])))
         print(f"FINAL={hashlib.sha256(final.tobytes()).hexdigest()}")
     elif phase in ("straggle", "control"):

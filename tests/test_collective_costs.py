@@ -100,6 +100,70 @@ def test_fft_plan_costs_match_compiled(devices):
                 method, extra, measured, plan.collective_costs(extra))
 
 
+def test_extra_dims_scale_bytes_linearly_count_fixed(devices):
+    """ISSUE 9 regression pin: batched hops fold the batch into each
+    hop's SINGLE collective — ``transpose_cost`` must scale bytes
+    linearly in ``extra_dims`` while the collective count stays fixed,
+    for every explicit method (the extra_dims path was previously only
+    exercised as a carrier, never cost-asserted)."""
+    from pencilarrays_tpu.parallel.transpositions import Pipelined
+
+    for dims in [(4,), (2, 2), (8,)]:
+        n = int(np.prod(dims))
+        topo = Topology(dims, devices=jax.devices()[:n])
+        M = len(dims)
+        for shape in [(16, 12, 20), (11, 9, 13)]:
+            pin = Pencil(topo, shape, tuple(range(1, M + 1)))
+            pout = Pencil(topo, shape, (0,) + tuple(range(2, M + 1)))
+            for method in [AllToAll(), Ring(), Pipelined(chunks=2)]:
+                base = transpose_cost(pin, pout, (), jnp.complex64,
+                                      method)
+                for B in (2, 3, 8):
+                    got = transpose_cost(pin, pout, (B,), jnp.complex64,
+                                         method)
+                    assert set(got) == set(base)
+                    for op in base:
+                        assert got[op]["count"] == base[op]["count"], (
+                            dims, shape, method, B, got, base)
+                        assert got[op]["bytes"] == B * base[op]["bytes"], (
+                            dims, shape, method, B, got, base)
+
+
+def test_batched_hop_cost_matches_compiled_hlo(devices):
+    """The batched prediction is HLO-true, not just self-consistent:
+    a ragged batched Pipelined hop (chunk axis chosen over the shape
+    INCLUDING the batch dims) compiles to exactly the predicted
+    collectives."""
+    from pencilarrays_tpu.parallel.transpositions import Pipelined
+
+    topo = Topology((4,), devices=jax.devices()[:4])
+    pin = Pencil(topo, (11, 9, 13), (1,))
+    pout = Pencil(topo, (11, 9, 13), (0,))
+    for method in [AllToAll(), Ring(), Pipelined(chunks=2)]:
+        expect = transpose_cost(pin, pout, (5,), jnp.complex64, method)
+        got = _measured(pin, pout, (5,), jnp.complex64, method)
+        assert got == expect, (method, got, expect)
+
+
+def test_batched_plan_costs_match_compiled(devices):
+    """``PencilFFTPlan(batch=B)``: the default-priced collective_costs
+    (extra_dims = batch_dims) equal the compiled batched program's
+    measured stats, and the per-op counts equal the UNBATCHED program's
+    — the amortization claim, end to end on the whole plan."""
+    topo = Topology((4, 2))
+    plan = PencilFFTPlan(topo, (16, 12, 20), real=True, batch=3)
+    x = plan.allocate_input()
+    hlo = (jax.jit(lambda d: plan.forward(
+        PencilArray(plan.input_pencil, d, (3,))).data)
+        .lower(x.data).compile().as_text())
+    measured = collective_stats(hlo)
+    assert measured == plan.collective_costs()
+    per_sample = plan.collective_costs(())
+    for op, c in measured.items():
+        assert c["count"] == per_sample[op]["count"]
+        assert c["bytes"] == 3 * per_sample[op]["bytes"]
+
+
 def test_backward_costs_equal_forward(devices):
     """Hop shapes are symmetric: the backward program's collectives
     match the same model."""

@@ -539,6 +539,13 @@ def _run_elastic_sequence(tmp_path, world):
         assert _final_digest(out) == ref_final, (
             f"rank {rank}: post-reformation output differs from the "
             f"never-killed reference:\n{out[-2000:]}")
+        # ISSUE 9 satellite: the registered BATCHED plan was rebuilt by
+        # the reformation with its batch intact (worker-side asserts
+        # batch_dims and a batched forward; the marker line proves the
+        # factory actually re-ran on every survivor)
+        assert "REPLAN_BATCH=3" in out, (
+            f"rank {rank}: reformed batched plan marker missing:\n"
+            f"{out[-2000:]}")
     _assert_elastic_timeline(el, world, victim)
 
 
