@@ -720,6 +720,18 @@ def main():
                         help="cube edge of the throughput grid "
                              "(32^3 x batch<=16 keeps the CPU-mesh arm "
                              "inside a CI budget)")
+    parser.add_argument("--serve", action="store_true",
+                        help="also run the multi-tenant serving arm "
+                             "(benchmarks/serve_bench.py): coalesced "
+                             "service vs serialized per-request baseline "
+                             "on mixed-plan traffic, per-tenant p50/p99 "
+                             "latency, HLO-pinned coalesced dispatch; "
+                             "writes BENCH_SERVE.json")
+    parser.add_argument("--serve-only", action="store_true",
+                        help="run ONLY the --serve arm (used to commit "
+                             "the BENCH_SERVE.json artifact)")
+    parser.add_argument("--serve-n", type=int, default=16,
+                        help="requests per tenant in the serving arm")
     args = parser.parse_args()
 
     import jax
@@ -825,6 +837,29 @@ def main():
                         "n_devices": len(devs)}, "BENCH_THROUGHPUT.json",
                        devs=devs)
         if args.throughput_only:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+            print(json.dumps(results, indent=1))
+            return
+
+    # -- 13. serve: multi-tenant plan service (opt-in) ---------------------
+    # The ISSUE 10 headline: mixed-plan request traffic through the
+    # coalescing service vs the serialized per-request baseline —
+    # requests/sec + per-tenant p50/p99, with the coalesced dispatch
+    # HLO-pinned (count x1, bytes xB, prediction == compiled stats) —
+    # committed as BENCH_SERVE.json.
+    if args.serve or args.serve_only:
+        from benchmarks.serve_bench import run_serve_suite, write_artifact
+
+        results["serve"] = run_serve_suite(
+            devs, n_requests=args.serve_n,
+            max_batch=8 if len(devs) == 1 else 4,
+            repeats=3)
+        write_artifact({**results["serve"],
+                        "platform": devs[0].platform,
+                        "n_devices": len(devs)}, "BENCH_SERVE.json",
+                       devs=devs)
+        if args.serve_only:
             with open(args.out, "w") as f:
                 json.dump(results, f, indent=1)
             print(json.dumps(results, indent=1))

@@ -75,6 +75,7 @@ from . import io  # noqa: F401
 from . import obs  # noqa: F401  (telemetry: metrics/journal/spans/drift)
 from . import guard  # noqa: F401  (integrity guard: SDC probes/watchdog)
 from . import cluster  # noqa: F401  (mesh recovery: consensus/leases/epochs)
+from . import serve  # noqa: F401  (multi-tenant plan service: registry/queue)
 from . import resilience  # noqa: F401
 from .resilience import (  # noqa: F401
     CheckpointManager,

@@ -39,12 +39,30 @@ below runs bit-for-bit unchanged.
 
 from __future__ import annotations
 
+import threading
 import time
+from contextlib import contextmanager
 from typing import Callable, Optional
 
 from .errors import HangTimeoutError, IntegrityError
 
 __all__ = ["guarded_step", "elastic_step"]
+
+# caller-supplied attribution fields (guarded_step's ``meta=``) folded
+# into every guard.recover record of the CURRENT step — thread-local so
+# concurrent steps (e.g. a serve dispatch thread next to an app loop)
+# never cross-stamp each other's ladders
+_meta_local = threading.local()
+
+
+@contextmanager
+def _step_meta(meta: Optional[dict]):
+    prev = getattr(_meta_local, "meta", None)
+    _meta_local.meta = meta
+    try:
+        yield
+    finally:
+        _meta_local.meta = prev
 
 
 def _journal(stage: str, label: str, **fields) -> None:
@@ -53,6 +71,16 @@ def _journal(stage: str, label: str, **fields) -> None:
     if not obs.enabled():
         return
     obs.counter("guard.recoveries", stage=stage).inc()
+    meta = getattr(_meta_local, "meta", None)
+    if meta:
+        for k, v in meta.items():
+            # "label"/"stage" are the record's own explicit kwargs and
+            # "ev"/"_fsync" are record_event's positional/keyword
+            # parameters: a caller meta key with any of these names must
+            # not become a duplicate-kwarg crash in the middle of a
+            # recovery ladder (nor silently act as the fsync override)
+            if k not in ("label", "stage", "ev", "_fsync"):
+                fields.setdefault(k, v)
     obs.record_event("guard.recover", label=label, stage=stage, **fields)
 
 
@@ -60,7 +88,7 @@ def guarded_step(fn: Callable, *, ckpt_mgr=None,
                  restore: Optional[Callable] = None, retry=None,
                  label: str = "step",
                  watchdog_timeout: Optional[float] = None,
-                 coordinator=None):
+                 coordinator=None, meta: Optional[dict] = None):
     """Run one unit of work with detect-and-recover semantics.
 
     Parameters
@@ -96,6 +124,12 @@ def guarded_step(fn: Callable, *, ckpt_mgr=None,
         Coordinator` (default: the process-global
         ``cluster.coordinator()``, which is ``None`` — local ladder —
         unless the cluster layer is armed on a multi-process mesh).
+    meta:
+        Optional attribution fields folded into every ``guard.recover``
+        record this step journals (e.g. the serve layer's tenant and
+        request ids), so a post-mortem ties a recovery ladder to the
+        workload that rode it.  Explicit payload fields win on
+        collision.
 
     Returns ``fn()``'s value.  Raises the last :class:`IntegrityError`
     when the full ladder fails, or
@@ -117,11 +151,12 @@ def guarded_step(fn: Callable, *, ckpt_mgr=None,
         from .. import cluster
 
         coordinator = cluster.coordinator()
-    if coordinator is not None:
-        return _mesh_guarded_step(coordinator, fn, ckpt_mgr, restore,
-                                  policy, label, watchdog_timeout)
-    return _local_guarded_step(fn, ckpt_mgr, restore, policy, label,
-                               watchdog_timeout)
+    with _step_meta(meta):
+        if coordinator is not None:
+            return _mesh_guarded_step(coordinator, fn, ckpt_mgr, restore,
+                                      policy, label, watchdog_timeout)
+        return _local_guarded_step(fn, ckpt_mgr, restore, policy, label,
+                                   watchdog_timeout)
 
 
 def _local_guarded_step(fn, ckpt_mgr, restore, policy, label,
@@ -304,7 +339,7 @@ def elastic_step(fn: Callable, *, ckpt_mgr=None,
                  label: str = "step",
                  watchdog_timeout: Optional[float] = None,
                  coordinator=None, rebuild: Optional[Callable] = None,
-                 max_reforms: int = 4):
+                 max_reforms: int = 4, meta: Optional[dict] = None):
     """:func:`guarded_step` plus the elastic rung: retry → restore →
     **reform+restore** → re-raise.
 
@@ -342,12 +377,13 @@ def elastic_step(fn: Callable, *, ckpt_mgr=None,
             out = guarded_step(fn, ckpt_mgr=ckpt_mgr, restore=restore,
                                retry=retry, label=label,
                                watchdog_timeout=watchdog_timeout,
-                               coordinator=coord)
+                               coordinator=coord, meta=meta)
             if reformed is not None:
-                _journal("recovered", label, rank=coord.rank,
-                         via="reform", step=reformed.restored_step,
-                         epoch=reformed.membership.epoch,
-                         gen=reformed.membership.gen)
+                with _step_meta(meta):
+                    _journal("recovered", label, rank=coord.rank,
+                             via="reform", step=reformed.restored_step,
+                             epoch=reformed.membership.epoch,
+                             gen=reformed.membership.gen)
             return out
         except (PeerFailureError, PeerLeftError) as e:
             if not elastic.enabled() or coord is None:

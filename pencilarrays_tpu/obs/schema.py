@@ -80,6 +80,15 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     "cluster.straggler": ("rank", "hop", "excess_s", "baseline_s"),
     "clock.sync": ("ref_rank", "offset_s", "method"),
     "obs.agg": ("status",),
+    # multi-tenant plan service (serve/): the request lifecycle —
+    # admission (serve.request), batch formation (serve.coalesce),
+    # the single coalesced dispatch (serve.dispatch) and the
+    # per-request resolution (serve.complete; non-ok outcomes are
+    # fsync-critical via record_event's per-record override)
+    "serve.request": ("tenant", "req", "kind", "key", "nbytes"),
+    "serve.coalesce": ("key", "n", "reqs", "reason", "wait_s"),
+    "serve.dispatch": ("key", "n", "tenants", "score_bytes", "reason"),
+    "serve.complete": ("tenant", "req", "outcome", "seconds", "key"),
     # profiling / drift
     "profile": ("dir", "status"),
     "drift.sample": ("hop", "predicted_bytes", "measured_s", "source"),

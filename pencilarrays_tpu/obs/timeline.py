@@ -316,7 +316,7 @@ def merge_journals(directory: str, *, correct_skew: bool = True,
 
 # per-rank tracks (Perfetto "threads"): stable ids + display order
 _TRACKS = {"run": 0, "hops": 1, "io": 2, "ckpt": 3, "recovery": 4,
-           "cluster": 5}
+           "cluster": 5, "serve": 6}
 
 _TRACK_OF = {
     "hop": "hops",
@@ -330,6 +330,8 @@ _TRACK_OF = {
     "cluster.straggler": "cluster", "clock.sync": "cluster",
     "obs.agg": "cluster",
     "cluster.reform": "cluster", "cluster.member": "cluster",
+    "serve.request": "serve", "serve.coalesce": "serve",
+    "serve.dispatch": "serve", "serve.complete": "serve",
 }
 
 # events exported as complete ("X") spans: payload field holding the
@@ -339,6 +341,8 @@ _SPAN_DURATION_FIELD = {
     "io.write": "seconds",
     "io.read": "seconds",
     "ckpt.restore": "seconds",
+    # a serve.complete records the request's full submit->done latency
+    "serve.complete": "seconds",
 }
 
 
@@ -379,6 +383,15 @@ def _span_name(e: dict) -> str:
         return f"reform g{e.get('gen', '?')}:{e.get('stage', '?')}"
     if ev == "cluster.member":
         return f"member r{e.get('rank', '?')}:{e.get('change', '?')}"
+    if ev == "serve.request":
+        return f"serve.req {e.get('tenant', '?')}#{e.get('req', '?')}"
+    if ev == "serve.coalesce":
+        return f"coalesce n={e.get('n', '?')} ({e.get('reason', '?')})"
+    if ev == "serve.dispatch":
+        return f"serve.dispatch n={e.get('n', '?')}"
+    if ev == "serve.complete":
+        return (f"serve {e.get('tenant', '?')}#{e.get('req', '?')}:"
+                f"{e.get('outcome', '?')}")
     return ev
 
 
@@ -512,6 +525,11 @@ def render(tl: MergedTimeline, *, max_groups: int = 200) -> str:
                                                  "fixed") != "fixed"):
                     # an auto-decomposition verdict is a planning
                     # decision worth spelling out, like a route verdict
+                    loud.append(_span_name(e))
+                elif (ev == "serve.complete"
+                      and e.get("outcome") != "ok"):
+                    # a failed request is a client-visible anomaly —
+                    # name the tenant and the typed outcome
                     loud.append(_span_name(e))
                 else:
                     counts[ev] = counts.get(ev, 0) + 1

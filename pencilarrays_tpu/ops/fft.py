@@ -1013,15 +1013,36 @@ class PencilFFTPlan:
         return ("ft", src, tgt, hop_dtype, post, tuple(ops), pre_complex,
                 base, c, bounds)
 
-    def _fingerprint(self) -> str:
-        """Short schedule fingerprint for correlation stamping
-        (``plan_fp`` on journal records) — computed lazily so plans
-        built before obs was armed still stamp correctly later."""
+    def plan_key(self) -> str:
+        """Stable fingerprint of this plan's full static configuration
+        — the PUBLIC registry/correlation key (12 hex chars of the
+        sha256 over the canonical schedule summary, sorted-JSON
+        encoded).
+
+        Deterministic across processes and jax restarts: it hashes the
+        *logical* configuration — global shape, per-dim transform
+        kinds, input dtype, topology dims, method, normalization,
+        pipeline chunks, batch, decomposition verdict, the hop-by-hop
+        schedule with per-hop dtypes, and the predicted collective
+        costs — never device ids, object identities or addresses, so
+        two processes (or two tenants) that build the same plan compute
+        the same key (subprocess-pinned in ``tests/test_serve.py``).
+
+        Equal to the ``plan_fp`` stamped on journal records for this
+        plan's dispatches, and a prefix of the crash bundle's
+        ``schedule_sha256`` (both hash the same summary blob) — so the
+        serve registry's keys, the obs timeline's correlation field and
+        the guard's post-mortem fingerprints provably agree."""
         if self._plan_fp is None:
             from ..obs import correlate
 
             self._plan_fp = correlate.plan_fingerprint(self._obs_summary())
         return self._plan_fp
+
+    def _fingerprint(self) -> str:
+        """Correlation-stamp alias of :meth:`plan_key` (``plan_fp`` on
+        journal records)."""
+        return self.plan_key()
 
     def _obs_summary(self) -> dict:
         """The ``plan.build`` journal payload: the static schedule and
@@ -1065,6 +1086,10 @@ class PencilFFTPlan:
         return {
             "shape": list(self.shape_physical),
             "transforms": list(self.transforms),
+            # input dtype: single-device plans have no exchange steps
+            # (whose per-hop dtypes would otherwise distinguish them),
+            # and plan_key() must never collide c64 with c128 plans
+            "dtype": str(jnp.dtype(self.dtype_physical)),
             "topo": list(self.topology.dims),
             "method": _method_label(self.method)
             if not isinstance(self.method, Auto)
@@ -1164,7 +1189,8 @@ class PencilFFTPlan:
                                  self.dtype_spectral)
 
     def compile(self, extra_dims: Optional[Tuple[int, ...]] = None, *,
-                donate: bool = False) -> "CompiledPlan":
+                donate: bool = False, _counters: bool = True
+                ) -> "CompiledPlan":
         """Whole-plan fusion: ONE jitted program each for the full
         forward and the mirrored backward chain (:class:`CompiledPlan`).
 
@@ -1194,7 +1220,11 @@ class PencilFFTPlan:
             cache[key] = CompiledPlan(self, key[0], donate=key[1])
         from .. import obs
 
-        if obs.enabled():
+        # _counters=False: a caller that does its OWN cache accounting
+        # (the serve registry labels the same resolve cache="serve"
+        # with a per-tenant dimension) suppresses the plan-level count
+        # — one resolve must be one counted cache event, never two
+        if _counters and obs.enabled():
             obs.counter(f"compile.cache_{'hits' if hit else 'misses'}",
                         cache="plan").inc()
         return cache[key]

@@ -90,9 +90,41 @@ __all__ = [
     "RouteHop",
     "plan_reshard_route",
     "execute_route",
+    "reshard_key",
     "trusted_drift_hops",
     "trusted_drift",
 ]
+
+
+def reshard_key(pin: Pencil, dest: Pencil, dtype=None, method=None,
+                extra_dims: Tuple[int, ...] = ()) -> str:
+    """Stable fingerprint of one reshard *configuration* — the serve
+    registry/coalescing key for routed-reshard traffic, the sibling of
+    :meth:`~pencilarrays_tpu.ops.fft.PencilFFTPlan.plan_key`.
+
+    Hashes the logical configuration only (global shape, topology dims,
+    src/dest decomposition + memory-order permutations, dtype, method
+    label, extra dims) with the same digest family the obs correlation
+    layer uses — deterministic across processes and jax restarts; never
+    device ids or object identities."""
+    import numpy as np
+
+    from ..obs.correlate import plan_fingerprint
+
+    dt = np.dtype(dtype if dtype is not None else np.float32)
+    summary = {
+        "kind": "reshard",
+        "shape": list(pin.size_global()),
+        "topo": list(pin.topology.dims),
+        "src": [list(pin.decomposition),
+                list(pin.permutation.apply(tuple(range(pin.ndims))))],
+        "dest": [list(dest.decomposition),
+                 list(dest.permutation.apply(tuple(range(dest.ndims))))],
+        "dtype": dt.name,
+        "method": _method_label(method) if method is not None else "Auto",
+        "extra_dims": list(extra_dims),
+    }
+    return plan_fingerprint(summary)
 
 
 def trusted_drift_hops() -> Dict[str, dict]:

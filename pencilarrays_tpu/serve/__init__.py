@@ -1,0 +1,44 @@
+"""serve/ — the multi-tenant plan service (PR 10).
+
+The transpose engine, the batched plan layer, the guard's recovery
+ladder and the obs plane all exist to be *used* — this package is the
+layer that serves them: concurrent FFT/reshard requests from multiple
+logical tenants, executed on one resident mesh.
+
+* :class:`PlanService` — submit/coalesce/dispatch loop with per-tenant
+  quotas and typed isolation (``docs/Serving.md``);
+* :class:`PlanRegistry` — fingerprint-keyed resident executables
+  (keys are :meth:`~pencilarrays_tpu.ops.fft.PencilFFTPlan.plan_key`,
+  deterministic across processes and restarts);
+* :class:`AdmissionQueue` / :class:`TenantQuota` / :class:`Ticket` —
+  the scheduling core and the client-side future;
+* typed errors: :class:`ServeError`, :class:`AdmissionError`,
+  :class:`StaleRequestError`, :class:`ServiceClosedError`.
+
+Everything here is plain Python over the public plan APIs: importing
+the package is cheap (jax is only touched when a request dispatches),
+and a process that never serves pays nothing.
+"""
+
+from .errors import (  # noqa: F401
+    AdmissionError,
+    ServeError,
+    ServiceClosedError,
+    StaleRequestError,
+)
+from .queue import AdmissionQueue, Batch, TenantQuota, Ticket  # noqa: F401
+from .registry import PlanRegistry  # noqa: F401
+from .service import PlanService  # noqa: F401
+
+__all__ = [
+    "PlanService",
+    "PlanRegistry",
+    "AdmissionQueue",
+    "TenantQuota",
+    "Ticket",
+    "Batch",
+    "ServeError",
+    "AdmissionError",
+    "StaleRequestError",
+    "ServiceClosedError",
+]

@@ -1,0 +1,46 @@
+"""Typed errors of the plan-service layer.
+
+The serve contract mirrors the guard's: failures surface as *typed*
+errors scoped to the narrowest unit they poison — an admission decision
+rejects ONE tenant's request, a detected corruption fails ONE batch's
+tickets — never as a torn service or an unattributed exception on some
+other tenant's future.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ServeError", "AdmissionError", "StaleRequestError",
+           "ServiceClosedError"]
+
+
+class ServeError(RuntimeError):
+    """Base class of every serve-layer error."""
+
+
+class AdmissionError(ServeError):
+    """A tenant's request was rejected at admission (quota exceeded).
+
+    Carries ``tenant`` and ``reason`` (``"queue-depth"`` or
+    ``"inflight-bytes"``) so a client can distinguish back-off from a
+    bug.  Admission rejections never enter the queue: they cost the
+    service one counter bump and the caller one typed exception.
+    """
+
+    def __init__(self, msg: str, *, tenant: str, reason: str):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.reason = reason
+
+
+class StaleRequestError(ServeError):
+    """A queued request's device payload is bound to a mesh that no
+    longer backs its plan — e.g. the plan was rebuilt by an elastic
+    reformation while the request sat in the queue.  Host-array
+    payloads submitted against a *named* plan re-bind and survive
+    (see :meth:`~pencilarrays_tpu.serve.PlanService.register_plan`);
+    device arrays cannot, and fail typed instead of dispatching onto
+    dead devices."""
+
+
+class ServiceClosedError(ServeError):
+    """Submit after :meth:`~pencilarrays_tpu.serve.PlanService.close`."""

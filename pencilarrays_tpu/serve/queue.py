@@ -1,0 +1,320 @@
+"""Admission + coalescing queue — the service's scheduling brain.
+
+Three responsibilities, all deterministic (a multi-controller mesh runs
+one service instance per rank, and every rank must make IDENTICAL
+batching and ordering decisions from the same submission sequence —
+wall clocks only gate *when* a batch becomes ready, never how batches
+are formed or ordered relative to each other):
+
+* **admission** — per-tenant quotas (queue depth, in-flight logical
+  bytes) checked at :meth:`offer`; violations raise typed
+  :class:`~pencilarrays_tpu.serve.errors.AdmissionError` and never
+  enter the queue;
+* **coalescing** — same-fingerprint requests (same ``plan_key`` ×
+  direction, or same reshard route) group along ``extra_dims`` into
+  one batched dispatch: bytes ×B, collective count ×1 — the PR 9
+  batched-plan amortization, applied to *traffic* instead of a
+  caller-declared batch.  A group dispatches when it reaches
+  ``max_batch`` or its oldest request has waited ``max_wait_s``
+  (a flush takes everything, ragged final batch included);
+* **cost ordering** — ready batches dispatch cheapest-first in the
+  ``collective_costs`` currency (``count * latency_bytes + bytes``,
+  the Auto/route-planner score), so a small tenant's request is never
+  starved behind a huge plan's traffic.  Anti-starvation: a batch
+  whose oldest request has waited ``starve_after_s`` jumps the cost
+  order (FIFO among the starved), so expensive batches are delayed,
+  never parked forever.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .errors import AdmissionError, ServiceClosedError
+
+__all__ = ["Ticket", "TenantQuota", "Batch", "AdmissionQueue"]
+
+_ids = itertools.count(1)
+
+
+class Ticket:
+    """A submitted request's future: :meth:`result` blocks until the
+    service fulfilled or failed it (typed errors re-raise here — an
+    :class:`~pencilarrays_tpu.guard.IntegrityError` detected inside
+    this request's batch surfaces on THIS ticket, nobody else's)."""
+
+    def __init__(self, tenant: str, kind: str, key: str):
+        self.id = next(_ids)
+        self.tenant = tenant
+        self.kind = kind
+        self.key = key
+        self.t_submit = time.monotonic()
+        self.t_dispatch: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """The request's output array; raises the request's typed error
+        (or ``TimeoutError`` if the service has not resolved it yet)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} (tenant {self.tenant!r}) not done")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def error(self) -> Optional[BaseException]:
+        """The failure, if the request failed (None while pending/ok)."""
+        return self._error
+
+    def _fulfill(self, result) -> None:
+        self.t_done = time.monotonic()
+        self._result = result
+        self._event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self.t_done = time.monotonic()
+        self._error = error
+        self._event.set()
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """Admission limits of one tenant: pending+executing request count
+    and pending+executing logical payload bytes (global, unpadded —
+    what the tenant asked to move, not what the mesh pads it to)."""
+
+    max_requests: int = 1024
+    max_bytes: int = 1 << 34    # 16 GiB of queued traffic per tenant
+
+
+@dataclass
+class _Entry:
+    """One queued request (internal)."""
+
+    ticket: Ticket
+    plan: object                  # PencilFFTPlan, or None for reshard
+    direction: str                # "forward" | "backward" (fft)
+    payload: object               # PencilArray | host array
+    nbytes: int
+    plan_name: Optional[str]      # named (elastic-rebindable) plans
+    dest: object = None           # reshard destination Pencil
+    method: object = None         # reshard method
+    seq: int = 0                  # admission order (deterministic ties)
+
+
+@dataclass
+class Batch:
+    """A ready-to-dispatch coalesced group."""
+
+    key: str
+    kind: str                     # "fft" | "reshard"
+    entries: List[_Entry]
+    reason: str                   # "full" | "deadline" | "flush"
+    cost: int = 0                 # bytes-equivalent score (set by queue)
+    seq: int = 0                  # first entry's admission order
+
+    @property
+    def tickets(self) -> List[Ticket]:
+        return [e.ticket for e in self.entries]
+
+
+class AdmissionQueue:
+    """The deterministic admission/coalescing/ordering core (see module
+    docstring).  Thread-safe; scheduling state never leaves the lock."""
+
+    def __init__(self, *, max_batch: int = 8, max_wait_s: float = 0.002,
+                 starve_after_s: float = 1.0,
+                 default_quota: Optional[TenantQuota] = None,
+                 quotas: Optional[Dict[str, TenantQuota]] = None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.starve_after_s = float(starve_after_s)
+        self.default_quota = default_quota or TenantQuota()
+        self.quotas = dict(quotas or {})
+        self._lock = threading.Lock()
+        self._closed = False
+        self._seq = itertools.count(1)
+        # coalesce key -> entries in admission order
+        self._pending: Dict[str, List[_Entry]] = {}
+        # per-tenant accounting: requests/bytes admitted and not yet
+        # completed (queued + executing)
+        self._tenant_requests: Dict[str, int] = {}
+        self._tenant_bytes: Dict[str, int] = {}
+
+    # -- admission ---------------------------------------------------------
+    def quota_for(self, tenant: str) -> TenantQuota:
+        return self.quotas.get(tenant, self.default_quota)
+
+    def offer(self, entry: _Entry) -> None:
+        """Admit one request or raise typed
+        :class:`~pencilarrays_tpu.serve.errors.AdmissionError`."""
+        t = entry.ticket.tenant
+        q = self.quota_for(t)
+        with self._lock:
+            if self._closed:
+                # checked under the SAME lock close_gate() takes, so a
+                # submit racing close() is rejected typed — it can
+                # never land after the service's final drain pass
+                raise ServiceClosedError("service is closed")
+            n = self._tenant_requests.get(t, 0)
+            b = self._tenant_bytes.get(t, 0)
+            if n + 1 > q.max_requests:
+                raise AdmissionError(
+                    f"tenant {t!r}: queue depth {n} at quota "
+                    f"({q.max_requests} requests)", tenant=t,
+                    reason="queue-depth")
+            if b + entry.nbytes > q.max_bytes:
+                raise AdmissionError(
+                    f"tenant {t!r}: {b + entry.nbytes} in-flight bytes "
+                    f"would exceed quota ({q.max_bytes})", tenant=t,
+                    reason="inflight-bytes")
+            entry.seq = next(self._seq)
+            self._tenant_requests[t] = n + 1
+            self._tenant_bytes[t] = b + entry.nbytes
+            self._pending.setdefault(entry.ticket.key, []).append(entry)
+
+    def close_gate(self) -> None:
+        """Refuse all future :meth:`offer` calls (atomic with the offer
+        path's lock — nothing can slip in after this returns)."""
+        with self._lock:
+            self._closed = True
+
+    def release(self, entry: _Entry) -> None:
+        """Return one request's quota (called at completion, ok or
+        failed — the quota covers queued *and* executing work)."""
+        t = entry.ticket.tenant
+        with self._lock:
+            self._tenant_requests[t] = max(
+                0, self._tenant_requests.get(t, 0) - 1)
+            self._tenant_bytes[t] = max(
+                0, self._tenant_bytes.get(t, 0) - entry.nbytes)
+
+    # -- batching ----------------------------------------------------------
+    def take_ready(self, *, flush: bool = False,
+                   now: Optional[float] = None) -> List[Batch]:
+        """Pop every ready batch, ordered for dispatch.
+
+        Readiness: a full ``max_batch`` group is always ready; a
+        partial group is ready once its oldest member waited
+        ``max_wait_s`` (or immediately under ``flush`` — the ragged
+        final batch of a drain).  Ordering: starved batches first (in
+        admission order), then ascending priced cost, admission order
+        breaking ties — deterministic for identical submission
+        sequences regardless of wall clocks."""
+        now = time.monotonic() if now is None else now
+        out: List[Batch] = []
+        with self._lock:
+            for key in list(self._pending):
+                entries = self._pending[key]
+                while len(entries) >= self.max_batch:
+                    take, entries = (entries[: self.max_batch],
+                                     entries[self.max_batch:])
+                    self._pending[key] = entries
+                    out.append(self._mk_batch(key, take, "full"))
+                if entries and (flush or now - entries[0].ticket.t_submit
+                                >= self.max_wait_s):
+                    del self._pending[key]
+                    out.append(self._mk_batch(
+                        key, entries, "flush" if flush else "deadline"))
+                elif not entries:
+                    del self._pending[key]
+        for b in out:
+            b.cost = self._batch_cost(b)
+
+        def order(b: Batch):
+            starved = (now - b.entries[0].ticket.t_submit
+                       >= self.starve_after_s)
+            return (0, b.seq) if starved else (1, b.cost, b.seq)
+
+        out.sort(key=order)
+        return out
+
+    @staticmethod
+    def _mk_batch(key: str, entries: List[_Entry], reason: str) -> Batch:
+        e0 = entries[0]
+        kind = "reshard" if e0.plan is None else "fft"
+        return Batch(key=key, kind=kind, entries=list(entries),
+                     reason=reason, seq=e0.seq)
+
+    # -- pricing -----------------------------------------------------------
+    @staticmethod
+    def _batch_cost(batch: Batch) -> int:
+        """Bytes-equivalent dispatch cost of the whole batch — the
+        mixed-traffic ordering currency (the route-planner score at the
+        coalesced ``extra_dims``: ``count * latency_bytes +
+        drift-corrected bytes``, for fft and reshard alike).  NEVER
+        raises: unpriceable
+        batches (Gspmd hops, any pricing failure) cost 0 and dispatch
+        first — the model cannot rank what it cannot see, head-of-line
+        is the safe default, and a pricing bug must not wedge the
+        dispatch loop (``take_ready`` is on the service's only
+        scheduling path)."""
+        try:
+            return AdmissionQueue._batch_cost_inner(batch)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def _batch_cost_inner(batch: Batch) -> int:
+        from ..parallel.transpositions import Auto
+
+        B = len(batch.entries)
+        extra = (B,) if B > 1 else ()
+        e0 = batch.entries[0]
+        if batch.kind == "fft":
+            # price with the decomposition scorer — the SAME
+            # drift-corrected route-planner currency the reshard branch
+            # gets from plan_reshard_route, at the plan's own configured
+            # method latency; fft and reshard batches must sort in one
+            # currency or cheapest-first inverts on mixed traffic
+            from ..ops.fft import _schedule_score
+            from ..parallel.routing import trusted_drift_hops
+
+            method = e0.plan.method
+            latency = (method.latency_bytes if isinstance(method, Auto)
+                       else Auto().latency_bytes)
+            entry = _schedule_score(e0.plan, extra, latency,
+                                    trusted_drift_hops())
+            return int(entry["score_bytes"])
+        # reshard: the route planner's own score (drift-corrected,
+        # HBM-bounded), or the priced GSPMD baseline on fallback
+        from ..parallel.routing import plan_reshard_route
+
+        route = plan_reshard_route(e0.payload.pencil, e0.dest, extra,
+                                   e0.payload.dtype, method=e0.method)
+        if route.use_route and route.score_bytes is not None:
+            return int(route.score_bytes)
+        return int(route.gspmd_score_bytes or 0)
+
+    # -- introspection -----------------------------------------------------
+    def depth(self, tenant: Optional[str] = None) -> int:
+        with self._lock:
+            if tenant is None:
+                return sum(len(v) for v in self._pending.values())
+            return sum(1 for v in self._pending.values()
+                       for e in v if e.ticket.tenant == tenant)
+
+    def tenants(self) -> Dict[str, dict]:
+        """Per-tenant accounting snapshot (admitted, not yet done)."""
+        with self._lock:
+            names = set(self._tenant_requests) | set(self._tenant_bytes)
+            return {t: {"requests": self._tenant_requests.get(t, 0),
+                        "bytes": self._tenant_bytes.get(t, 0)}
+                    for t in sorted(names)}
+
+    def pending_entries(self) -> List[_Entry]:
+        """Snapshot of queued entries (rebind support)."""
+        with self._lock:
+            return [e for v in self._pending.values() for e in v]

@@ -1,0 +1,132 @@
+"""Plan registry — one resident executable per plan fingerprint.
+
+The service's tenants describe *what* they want transformed; the
+registry makes sure equivalent descriptions share ONE compiled
+executable.  Keys are :meth:`~pencilarrays_tpu.ops.fft.PencilFFTPlan.
+plan_key` fingerprints — deterministic across processes and jax
+restarts (the same digest family the obs journal stamps as ``plan_fp``
+and the crash bundle records as ``schedule_sha256``), so two tenants
+that each built their own ``PencilFFTPlan`` over the same
+``(global_shape, dtype, topology, schedule)`` configuration resolve to
+the same registry entry and the same ``CompiledPlan``.
+
+Cache accounting rides the existing ``compile.cache_hits|misses``
+counters with a ``cache="serve"`` label and a per-tenant dimension.
+A registry hit short-circuits :meth:`PencilFFTPlan.compile` entirely,
+and the miss path calls it with its own plan-level counter suppressed
+(``_counters=False``) — one resolve, one count, never the
+double-count a naive delegation would produce (plan-level ``cache=
+"plan"`` counters keep counting direct ``plan.compile()`` callers
+only).
+
+Rebind semantics (the elastic-reformation contract): ``register(plan)``
+dedups on the fingerprint — first registration wins and callers use the
+returned *canonical* plan — while ``register(plan, replace=True)``
+swaps the stored plan object AND drops every compiled executable under
+that key: a rebuilt plan has the same fingerprint (same static
+configuration) but lives on a NEW mesh, and a cached executable from
+the dead mesh must never be dispatched again.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ["PlanRegistry"]
+
+
+class PlanRegistry:
+    """Fingerprint-keyed store of plans and their compiled executables."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # key -> plan (the canonical object for that fingerprint)
+        self._plans: Dict[str, object] = {}
+        # (key, extra_dims, donate) -> CompiledPlan
+        self._compiled: Dict[tuple, object] = {}
+        self._hits = 0
+        self._misses = 0
+
+    # -- plans -------------------------------------------------------------
+    def register(self, plan, *, replace: bool = False):
+        """Register ``plan`` under its :meth:`plan_key` and return the
+        canonical plan for that key (the first-registered object, unless
+        ``replace=True`` swaps it and invalidates the key's compiled
+        executables — the elastic rebuild path)."""
+        key = plan.plan_key()
+        with self._lock:
+            cur = self._plans.get(key)
+            if cur is not None and not replace:
+                return cur
+            if cur is not None and cur is not plan:
+                self._drop_compiled_locked(key)
+            self._plans[key] = plan
+            return plan
+
+    def plan(self, key: str):
+        """The canonical plan registered under ``key`` (None if absent)."""
+        return self._plans.get(key)
+
+    def keys(self) -> Tuple[str, ...]:
+        return tuple(self._plans)
+
+    def _drop_compiled_locked(self, key: str) -> int:
+        stale = [k for k in self._compiled if k[0] == key]
+        for k in stale:
+            del self._compiled[k]
+        return len(stale)
+
+    def drop_executables(self, key: Optional[str] = None) -> int:
+        """Drop compiled executables (all of them, or one key's) —
+        refilled on demand.  Returns how many were discarded."""
+        with self._lock:
+            if key is not None:
+                return self._drop_compiled_locked(key)
+            n = len(self._compiled)
+            self._compiled.clear()
+            return n
+
+    # -- executables -------------------------------------------------------
+    def compiled(self, plan, extra_dims: Tuple[int, ...] = (), *,
+                 donate: bool = False,
+                 tenants: Sequence[str] = ()) -> object:
+        """Resolve the ``CompiledPlan`` for ``(plan_key, extra_dims,
+        donate)``, compiling on first use.  ``tenants`` attributes the
+        hit/miss counters: one ``compile.cache_{hits|misses}{cache=
+        "serve", tenant=...}`` bump per requesting tenant (a coalesced
+        batch spans tenants; each of them experienced the hit)."""
+        key = plan.plan_key()
+        sub = (key, tuple(int(e) for e in extra_dims), bool(donate))
+        with self._lock:
+            self._plans.setdefault(key, plan)
+            cp = self._compiled.get(sub)
+        hit = cp is not None
+        if not hit:
+            # compile OUTSIDE the registry lock (an XLA trace+compile
+            # can take seconds — another tenant's cache hit must not
+            # queue behind it) and with the plan-level counter
+            # suppressed: THIS resolve is the one cache event
+            # (satellite fix — a serve miss used to count under
+            # cache="plan" too).  A racing miss double-compiles once
+            # (plan.compile's own per-plan cache dedups the executable)
+            # and the first insert wins.
+            new = plan.compile(sub[1], donate=donate, _counters=False)
+            with self._lock:
+                cp = self._compiled.setdefault(sub, new)
+        with self._lock:
+            self._hits += hit
+            self._misses += not hit
+        from .. import obs
+
+        if obs.enabled():
+            name = f"compile.cache_{'hits' if hit else 'misses'}"
+            for t in (tenants or ("-",)):
+                obs.counter(name, cache="serve", tenant=str(t)).inc()
+        return cp
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"plans": len(self._plans),
+                    "executables": len(self._compiled),
+                    "hits": self._hits, "misses": self._misses}

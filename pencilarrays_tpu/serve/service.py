@@ -1,0 +1,624 @@
+"""The multi-tenant plan service — concurrent FFT/reshard workloads on
+one resident mesh.
+
+Every ingredient exists in the layers below; this module is the thin,
+deterministic loop that composes them into a *service*:
+
+* the **registry** (:mod:`~pencilarrays_tpu.serve.registry`) resolves
+  each request's plan fingerprint to ONE resident
+  :class:`~pencilarrays_tpu.ops.fft.CompiledPlan` executable, shared
+  across tenants (``compile.cache_*{cache="serve"}`` counters);
+* the **admission queue** (:mod:`~pencilarrays_tpu.serve.queue`)
+  enforces per-tenant quotas, coalesces same-fingerprint requests
+  along ``extra_dims`` into one batched dispatch (bytes ×B, collective
+  count ×1 — the PR 9 amortization applied to live traffic), and
+  orders mixed-plan batches by their ``collective_costs`` price so
+  small requests are not starved behind huge ones;
+* every batch dispatch runs under
+  :func:`~pencilarrays_tpu.guard.recover.guarded_step` — the
+  **isolation path**: a detected corruption (SDC probe mismatch, hang
+  watchdog) inside one batch surfaces as a typed
+  :class:`~pencilarrays_tpu.guard.IntegrityError` on THAT batch's
+  tickets, after the ladder's retries; queued batches — other
+  tenants' or the same tenant's later traffic — dispatch next,
+  unpoisoned.  With the integrity guard armed
+  (``PENCILARRAYS_TPU_GUARD``), dispatch takes the *eager* schedule
+  (per-hop invariant probes, the instrumented path); with it off, the
+  registry's single-dispatch compiled executable (the fast path).
+
+Determinism contract (multi-controller meshes): one service instance
+runs per rank; batching and ordering decisions are pure functions of
+the submission sequence (see :class:`~pencilarrays_tpu.serve.queue.
+AdmissionQueue`), so ranks that submit identically and drain at the
+same points dispatch identical collective programs in identical order.
+
+Elastic interop: plans registered by *name* via :meth:`PlanService.
+register_plan` re-register their factory with
+:func:`~pencilarrays_tpu.cluster.elastic.register_plan` — after a mesh
+reformation the factory re-runs, the registry entry is swapped (stale
+executables dropped), queued host-payload requests re-bind to the
+rebuilt plan, and the service resumes draining its queue.  Queued
+*device* payloads bound to the dead mesh fail typed
+(:class:`~pencilarrays_tpu.serve.errors.StaleRequestError`).
+
+The full request lifecycle is journaled (``serve.request`` →
+``serve.coalesce`` → ``serve.dispatch`` → ``serve.complete``,
+schema-registered in ``obs/schema.py``) and metered per tenant
+(``serve.*`` counters/histograms/gauges), so ``pa-obs timeline``
+renders a served run end to end.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from functools import lru_cache
+
+from .errors import ServeError, ServiceClosedError, StaleRequestError
+from .queue import AdmissionQueue, Batch, TenantQuota, Ticket, _Entry
+from .registry import PlanRegistry
+
+__all__ = ["PlanService"]
+
+
+@lru_cache(maxsize=64)
+def _split_fn(B: int):
+    """Jitted B-way trailing-dim splitter (jit's own cache specializes
+    per shape/dtype/sharding)."""
+    import jax
+
+    return jax.jit(lambda d: tuple(d[..., i] for i in range(B)))
+
+
+class PlanService:
+    """Accept concurrent FFT/reshard requests from logical tenants and
+    execute them on the resident mesh (module docstring).
+
+    Parameters
+    ----------
+    max_batch, max_wait_s, starve_after_s, quota, quotas:
+        Queue knobs (:class:`~pencilarrays_tpu.serve.queue.
+        AdmissionQueue`): coalescing width, partial-batch deadline,
+        anti-starvation age, default and per-tenant admission quotas.
+        ``max_batch=1`` is the serialized per-request baseline (the
+        benchmark's control arm).
+    retry:
+        :class:`~pencilarrays_tpu.resilience.retry.RetryPolicy` for the
+        per-batch ``guarded_step`` ladder (default: env-tuned
+        ``from_env()`` — ``PENCILARRAYS_TPU_RETRIES`` etc.).
+    registry:
+        Share a :class:`~pencilarrays_tpu.serve.registry.PlanRegistry`
+        across services (default: a private one).
+    """
+
+    def __init__(self, *, max_batch: int = 8, max_wait_s: float = 0.002,
+                 starve_after_s: float = 1.0,
+                 quota: Optional[TenantQuota] = None,
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 retry=None, registry: Optional[PlanRegistry] = None):
+        self.registry = registry or PlanRegistry()
+        self.queue = AdmissionQueue(
+            max_batch=max_batch, max_wait_s=max_wait_s,
+            starve_after_s=starve_after_s, default_quota=quota,
+            quotas=quotas)
+        self.retry = retry
+        self._lock = threading.Lock()
+        self._named: Dict[str, object] = {}
+        self._elastic_names: set = set()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._dispatches = 0
+        self._completed: Dict[str, int] = {}
+
+    # -- named (elastic-rebindable) plans ----------------------------------
+    def register_plan(self, name: str, factory: Callable):
+        """Build and register a named plan: ``factory(ctx)`` must return
+        a :class:`~pencilarrays_tpu.ops.fft.PencilFFTPlan` (``ctx`` is
+        ``None`` now, and the
+        :class:`~pencilarrays_tpu.cluster.elastic.ReformContext` when a
+        reformation re-invokes it).  The factory is re-registered with
+        the elastic layer as ``serve:<name>`` so a reformed mesh
+        rebuilds the plan, swaps the registry entry (stale executables
+        dropped) and re-binds queued host-payload requests — the
+        service then resumes draining its queue.  Returns the built
+        plan."""
+        plan = factory(None)
+        with self._lock:
+            self._named[name] = plan
+        self.registry.register(plan, replace=True)
+
+        from ..cluster import elastic
+
+        def _rebuild(ctx=None):
+            p = factory(ctx)
+            self._rebind(name, p)
+            return p
+
+        elastic.register_plan(f"serve:{name}", _rebuild)
+        with self._lock:
+            self._elastic_names.add(f"serve:{name}")
+        return plan
+
+    def _rebind(self, name: str, plan) -> None:
+        with self._lock:
+            self._named[name] = plan
+        self.registry.register(plan, replace=True)
+        # re-point EVERY queued entry of this fingerprint, not just the
+        # name= submissions: a plan= submission resolves to the same
+        # canonical object (registry dedupe) and shares the coalesce
+        # key, so leaving it on the dead-mesh plan would poison the
+        # whole post-reform batch
+        key = plan.plan_key()
+        for e in self.queue.pending_entries():
+            if e.plan is not None and (
+                    e.plan_name == name or e.plan.plan_key() == key):
+                e.plan = plan
+
+    def plan(self, name: str):
+        """The current plan registered under ``name`` (post-reform this
+        is the rebuilt one)."""
+        return self._named.get(name)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, tenant: str, u, *, plan=None, name: Optional[str] = None,
+               direction: str = "forward") -> Ticket:
+        """Submit one single-sample FFT request.
+
+        ``u`` is the sample: a host array in the plan's *global logical*
+        shape (scattered onto the mesh at dispatch — the rebind-safe
+        form), or a :class:`~pencilarrays_tpu.parallel.arrays.
+        PencilArray` already living on the plan's input (forward) /
+        output (backward) pencil with ``extra_dims == ()``.  Pass the
+        plan directly or by registered ``name``.  Returns a
+        :class:`~pencilarrays_tpu.serve.queue.Ticket`; same-fingerprint
+        submissions coalesce into one batched dispatch, bit-identical
+        to sequential per-request execution (test-pinned)."""
+        if direction not in ("forward", "backward"):
+            raise ValueError(
+                f"direction must be 'forward' or 'backward', "
+                f"got {direction!r}")
+        plan_name = None
+        if name is not None:
+            if plan is not None:
+                raise ValueError("pass plan= or name=, not both")
+            plan = self._named.get(name)
+            if plan is None:
+                raise ServeError(f"no plan registered under {name!r}")
+            plan_name = name
+        if plan is None:
+            raise ValueError("submit needs plan= or name=")
+        plan = self.registry.register(plan)
+        self._check_payload(u)
+        self._check_fft_shape(plan, direction, u)
+        key = f"fft:{plan.plan_key()}:{direction}"
+        nbytes = self._fft_nbytes(plan, direction)
+        ticket = Ticket(tenant, "fft", key)
+        entry = _Entry(ticket=ticket, plan=plan, direction=direction,
+                       payload=u, nbytes=nbytes, plan_name=plan_name)
+        self._admit(entry, direction=direction)
+        return ticket
+
+    def submit_reshard(self, tenant: str, u, dest, *,
+                       method=None) -> Ticket:
+        """Submit one reshard request: redistribute ``u`` (a
+        :class:`PencilArray`, ``extra_dims == ()``) onto pencil
+        ``dest`` via the cost-driven route planner (``method`` defaults
+        to :class:`~pencilarrays_tpu.parallel.transpositions.Auto`).
+        Same-route submissions coalesce like FFT traffic."""
+        from ..parallel.arrays import PencilArray
+        from ..parallel.routing import reshard_key
+        from ..parallel.transpositions import Auto
+
+        if not isinstance(u, PencilArray):
+            raise ServeError(
+                "submit_reshard needs a PencilArray payload (a reshard "
+                "is defined by where the data currently lives)")
+        self._check_payload(u)
+        method = method if method is not None else Auto()
+        key = f"reshard:{reshard_key(u.pencil, dest, u.dtype, method)}"
+        nbytes = (math.prod(u.pencil.size_global())
+                  * u.dtype.itemsize)
+        ticket = Ticket(tenant, "reshard", key)
+        entry = _Entry(ticket=ticket, plan=None, direction="forward",
+                       payload=u, nbytes=nbytes, plan_name=None,
+                       dest=dest, method=method)
+        self._admit(entry)
+        return ticket
+
+    @staticmethod
+    def _check_payload(u) -> None:
+        from ..parallel.arrays import PencilArray
+
+        if isinstance(u, PencilArray) and u.extra_dims != ():
+            raise ServeError(
+                f"serve requests are single-sample (extra_dims=(), got "
+                f"{u.extra_dims}); coalescing owns the batch dimension — "
+                f"declare caller-side batches with PencilFFTPlan(batch=B) "
+                f"instead")
+
+    @staticmethod
+    def _check_fft_shape(plan, direction: str, u) -> None:
+        """Host payloads are shape-checked AT SUBMIT: a malformed
+        sample must be a typed error on its own submitter, never a
+        stack failure inside a coalesced batch that poisons other
+        tenants' tickets."""
+        import numpy as np
+
+        from ..parallel.arrays import PencilArray
+
+        if isinstance(u, PencilArray):
+            return      # device payloads are validated per entry at
+            # dispatch (the pencil may legitimately rebind by then)
+        expected = tuple(plan.shape_physical if direction == "forward"
+                         else plan.shape_spectral)
+        got = tuple(np.shape(u))
+        if got != expected:
+            raise ServeError(
+                f"payload shape {got} does not match the plan's "
+                f"{'physical' if direction == 'forward' else 'spectral'} "
+                f"global shape {expected}")
+        dt = (plan.dtype_physical if direction == "forward"
+              else plan.dtype_spectral)
+        if np.iscomplexobj(u) and np.dtype(dt).kind != "c":
+            raise ServeError(
+                f"complex payload submitted where the plan expects "
+                f"{np.dtype(dt).name} — the coalesced cast would "
+                f"silently discard the imaginary part")
+
+    @staticmethod
+    def _fft_nbytes(plan, direction: str) -> int:
+        if direction == "forward":
+            return (math.prod(plan.shape_physical)
+                    * plan.dtype_physical.itemsize)
+        return (math.prod(plan.shape_spectral)
+                * plan.dtype_spectral.itemsize)
+
+    def _admit(self, entry: _Entry, *, direction: Optional[str] = None
+               ) -> None:
+        from .. import obs
+
+        if self._closed:
+            raise ServiceClosedError("service is closed")
+        t = entry.ticket.tenant
+        try:
+            self.queue.offer(entry)
+        except ServeError as e:
+            if obs.enabled():
+                obs.counter("serve.rejected", tenant=t,
+                            reason=getattr(e, "reason", "error")).inc()
+            raise
+        if obs.enabled():
+            obs.counter("serve.requests", tenant=t,
+                        kind=entry.ticket.kind).inc()
+            obs.gauge("serve.queue_depth", tenant=t).set(
+                self.queue.depth(t))
+            fields = dict(tenant=t, req=entry.ticket.id,
+                          kind=entry.ticket.kind, key=entry.ticket.key,
+                          nbytes=entry.nbytes)
+            if direction is not None:
+                fields["direction"] = direction
+            obs.record_event("serve.request", **fields)
+
+    # -- dispatch ----------------------------------------------------------
+    def step(self, *, flush: bool = False) -> int:
+        """Dispatch every ready batch (coalescing deadlines honored;
+        ``flush=True`` takes partial groups too — the ragged final
+        batch).  Returns the number of batches dispatched."""
+        batches = self.queue.take_ready(flush=flush)
+        for b in batches:
+            self._dispatch(b)
+        return len(batches)
+
+    def drain(self) -> int:
+        """Flush-dispatch until the queue is empty; returns batches
+        dispatched.  The deterministic entry point: tests and
+        multi-controller meshes submit, then drain."""
+        n = 0
+        while self.queue.depth():
+            n += self.step(flush=True)
+        return n
+
+    def start(self, poll_s: float = 0.001) -> None:
+        """Run the dispatch loop on a daemon thread (streaming mode —
+        single-controller meshes only; multi-controller ranks must
+        drain at agreed points, see the determinism contract)."""
+        if self._thread is not None:
+            return
+
+        def loop():
+            from .. import obs
+
+            while not self._stop.is_set():
+                try:
+                    n = self.step()
+                except Exception:
+                    # the daemon must survive a scheduling bug: a dead
+                    # dispatch thread strands every future ticket with
+                    # no symptom (per-batch errors already fail their
+                    # own tickets inside _dispatch — only unexpected
+                    # scheduling-path errors reach here)
+                    if obs.enabled():
+                        obs.counter("serve.loop_errors").inc()
+                    n = 0
+                if not n:
+                    time.sleep(poll_s)
+
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=loop, name="pa-serve-dispatch", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join()
+        self._thread = None
+
+    def close(self, *, drain: bool = True) -> None:
+        """Stop accepting work; by default drain what is queued.  The
+        admission gate closes BEFORE the final drain and atomically
+        with the queue's own offer lock, so a submit racing close() is
+        a typed rejection — never a ticket stranded in a service nobody
+        will ever drain again.  Elastic factories registered through
+        :meth:`register_plan` are unregistered so a later reformation
+        does not rebuild plans for (and keep alive) a dead service."""
+        self.stop()
+        self._closed = True             # fast-path rejection
+        self.queue.close_gate()         # the airtight one
+        if drain:
+            self.drain()
+        from ..cluster import elastic
+        with self._lock:
+            names, self._elastic_names = self._elastic_names, set()
+        for n in names:
+            elastic.unregister_plan(n)
+
+    # -- the batch executor ------------------------------------------------
+    def _dispatch(self, batch: Batch) -> None:
+        from .. import obs
+        from ..guard.recover import guarded_step
+
+        B = len(batch.entries)
+        t_dispatch = time.monotonic()
+        for e in batch.entries:
+            e.ticket.t_dispatch = t_dispatch
+        wait_s = t_dispatch - batch.entries[0].ticket.t_submit
+        if obs.enabled():
+            # the formation record: what the queue coalesced (validation
+            # losses below journal their own non-ok serve.complete)
+            obs.record_event(
+                "serve.coalesce", key=batch.key, n=B,
+                reqs=[e.ticket.id for e in batch.entries],
+                reason=batch.reason, wait_s=wait_s)
+            obs.histogram("serve.batch_size", kind=batch.kind).observe(B)
+        # per-entry payload validation BEFORE the shared dispatch: a
+        # problem only one request can be blamed for (a stale device
+        # payload after an elastic rebuild) fails THAT ticket typed and
+        # the rest of the batch proceeds — the isolation contract holds
+        # inside a batch too, for every blame-one failure we can detect
+        # up front (host payload shapes were already checked at submit)
+        survivors = []
+        for e in batch.entries:
+            err = self._validate_entry(batch, e)
+            if err is None:
+                survivors.append(e)
+            else:
+                self._finish_one(batch, e, error=err)
+        if not survivors:
+            return          # nothing actually dispatches: no
+            # serve.dispatch record, no dispatch count
+        batch.entries = survivors
+        tenants = sorted({e.ticket.tenant for e in survivors})
+        if obs.enabled():
+            obs.record_event(
+                "serve.dispatch", key=batch.key, n=len(survivors),
+                tenants=tenants, score_bytes=batch.cost,
+                reason=batch.reason)
+        self._dispatches += 1
+        t0 = time.perf_counter()
+        try:
+            outs = guarded_step(
+                lambda: self._run_batch(batch),
+                retry=self.retry, label=f"serve:{batch.key}",
+                meta={"tenants": tenants,
+                      "reqs": [e.ticket.id for e in batch.entries]})
+        except BaseException as e:
+            self._finish(batch, None, e, time.perf_counter() - t0)
+            if not isinstance(e, Exception):
+                # KeyboardInterrupt / SystemExit: the tickets are
+                # failed (nobody waits on a dead future) but the
+                # interrupt itself must reach the caller
+                raise
+            return
+        self._finish(batch, outs, None, time.perf_counter() - t0)
+
+    def _validate_entry(self, batch: Batch, entry: _Entry
+                        ) -> Optional[BaseException]:
+        from ..parallel.arrays import PencilArray
+
+        u = entry.payload
+        if not isinstance(u, PencilArray):
+            return None
+        if batch.kind == "fft":
+            e0 = batch.entries[0]
+            pen = (e0.plan.input_pencil if e0.direction == "forward"
+                   else e0.plan.output_pencil)
+            if u.pencil != pen:
+                return StaleRequestError(
+                    f"request {entry.ticket.id}: payload lives on "
+                    f"{u.pencil!r}, plan expects {pen!r} (a device "
+                    f"payload cannot follow a rebuilt plan; submit "
+                    f"host arrays against a named plan to survive "
+                    f"reformation)")
+        elif u.pencil != batch.entries[0].payload.pencil:
+            # reshard coalescing stacks payloads: every member must
+            # live on the SAME pencil (same mesh incarnation)
+            return StaleRequestError(
+                f"request {entry.ticket.id}: reshard payload pencil "
+                f"differs from its coalesce group's")
+        return None
+
+    def _run_batch(self, batch: Batch) -> List[object]:
+        """Build the coalesced operand, execute ONE dispatch, split the
+        results per request.  Runs inside ``guarded_step`` — re-runnable
+        by construction (inputs are never donated on the serve path)."""
+        from .. import guard
+
+        entries = batch.entries
+        B = len(entries)
+        if batch.kind == "reshard":
+            from ..parallel.transpositions import reshard
+
+            xs = [self._materialize_reshard(e) for e in entries]
+            arr = xs[0] if B == 1 else self._stack(xs)
+            out = reshard(arr, entries[0].dest, method=entries[0].method)
+            return self._split(out, B)
+        e0 = entries[0]
+        plan, direction = e0.plan, e0.direction
+        arr = self._coalesce_fft(plan, direction, entries)
+        if guard.enabled():
+            # isolation path: the EAGER schedule — per-hop invariant
+            # probes inside each exchange program, hang watchdog per
+            # dispatch; a corrupted hop raises typed IntegrityError
+            # scoped to this batch (the fast path below runs the whole
+            # chain as one opaque program the probes cannot see into)
+            out = (plan.forward(arr) if direction == "forward"
+                   else plan.backward(arr))
+        else:
+            cp = self.registry.compiled(
+                plan, arr.extra_dims,
+                tenants=[e.ticket.tenant for e in entries])
+            out = (cp.forward(arr) if direction == "forward"
+                   else cp.backward(arr))
+        return self._split(out, B)
+
+    @staticmethod
+    def _stack(xs) -> object:
+        """Coalesce B single-sample arrays along one trailing batch dim
+        (``extra_dims == (B,)``) — each hop's single collective then
+        carries the whole batch (bytes ×B, count ×1)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel.arrays import PencilArray
+
+        pen = xs[0].pencil
+        data = jnp.stack([x.data for x in xs], axis=-1)
+        data = jax.device_put(data, pen.sharding(1))
+        return PencilArray(pen, data, (len(xs),))
+
+    @staticmethod
+    def _split(out, B: int) -> List[object]:
+        from ..parallel.arrays import PencilArray
+
+        if B == 1:
+            return [out]
+        # ONE jitted dispatch slices all B samples (an eager sharded
+        # getitem per sample costs ~10x the whole batched transform on
+        # the virtual mesh — measured; the jitted splitter keeps every
+        # slice sharded in place)
+        parts = _split_fn(B)(out.data)
+        return [PencilArray(out.pencil, p, ()) for p in parts]
+
+    def _coalesce_fft(self, plan, direction: str, entries: List[_Entry]):
+        """The batch operand: an all-host batch is stacked ON THE HOST
+        and scattered in ONE ``from_global`` (one pad/permute/
+        device_put for the whole batch — B per-sample scatters plus a
+        device-side restack would eat the coalescing win); any device
+        payload in the batch falls back to per-sample materialize +
+        device stack."""
+        import numpy as np
+
+        from ..parallel.arrays import PencilArray
+
+        pen = (plan.input_pencil if direction == "forward"
+               else plan.output_pencil)
+        dt = (plan.dtype_physical if direction == "forward"
+              else plan.dtype_spectral)
+        B = len(entries)
+        if not any(isinstance(e.payload, PencilArray) for e in entries):
+            if B == 1:
+                return PencilArray.from_global(
+                    pen, np.asarray(entries[0].payload, dtype=dt))
+            host = np.stack(
+                [np.asarray(e.payload, dtype=dt) for e in entries],
+                axis=-1)
+            return PencilArray.from_global(pen, host, extra_ndims=1)
+        xs = [self._materialize_fft(plan, pen, dt, e) for e in entries]
+        return xs[0] if B == 1 else self._stack(xs)
+
+    def _materialize_fft(self, plan, pen, dt, entry: _Entry):
+        # stale-pencil detection lives in _validate_entry (the
+        # per-entry pre-dispatch check) — by here every device payload
+        # was validated against this batch's pencil
+        import jax.numpy as jnp
+
+        from ..parallel.arrays import PencilArray
+
+        u = entry.payload
+        if isinstance(u, PencilArray):
+            if u.dtype != dt:
+                u = PencilArray(u.pencil, u.data.astype(dt), u.extra_dims)
+            return u
+        return PencilArray.from_global(pen, jnp.asarray(u, dtype=dt))
+
+    @staticmethod
+    def _materialize_reshard(entry: _Entry):
+        return entry.payload
+
+    def _finish(self, batch: Batch, outs: Optional[List[object]],
+                err: Optional[BaseException], execute_s: float) -> None:
+        from .. import obs
+
+        for i, e in enumerate(batch.entries):
+            self._finish_one(batch, e,
+                             result=None if err is not None else outs[i],
+                             error=err)
+        if obs.enabled():
+            obs.histogram("serve.execute_seconds",
+                          kind=batch.kind).observe(execute_s)
+
+    def _finish_one(self, batch: Batch, e: _Entry, *, result=None,
+                    error: Optional[BaseException] = None) -> None:
+        from .. import obs
+
+        outcome = "ok" if error is None else type(error).__name__
+        self.queue.release(e)
+        t = e.ticket
+        if error is None:
+            t._fulfill(result)
+        else:
+            t._fail(error)
+        if obs.enabled():
+            obs.counter("serve.completed", tenant=t.tenant,
+                        outcome=outcome).inc()
+            obs.histogram("serve.wait_seconds", tenant=t.tenant).observe(
+                max(0.0, (t.t_dispatch or t.t_submit) - t.t_submit))
+            obs.gauge("serve.queue_depth", tenant=t.tenant).set(
+                self.queue.depth(t.tenant))
+            # a non-ok completion gates a client-visible failure:
+            # fsync-critical via the per-record override
+            obs.record_event(
+                "serve.complete", _fsync=(error is not None),
+                tenant=t.tenant, req=t.id, outcome=outcome,
+                seconds=t.t_done - t.t_submit, key=batch.key,
+                **({"error": str(error)} if error is not None else {}))
+        with self._lock:
+            self._completed[outcome] = self._completed.get(outcome, 0) + 1
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        """Service snapshot: registry hit/miss, per-tenant accounting,
+        queue depth, dispatch/completion counts."""
+        with self._lock:
+            completed = dict(self._completed)
+        return {"registry": self.registry.stats(),
+                "tenants": self.queue.tenants(),
+                "queue_depth": self.queue.depth(),
+                "dispatches": self._dispatches,
+                "completed": completed}

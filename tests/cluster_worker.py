@@ -40,7 +40,11 @@ KV namespace — a KV root is one job incarnation):
   restore the agreed step-2 checkpoint through the cross-decomposition
   read path, rerun the killed step and FINISH — printing a
   ``FINAL=<sha256>`` digest that must be bit-identical to the
-  never-killed ``elastic_ref`` run's.
+  never-killed ``elastic_ref`` run's.  A ``serve.PlanService`` with a
+  named plan and two pre-kill queued host-payload requests rides
+  along: the reformation re-invokes the service's registered factory,
+  the queue re-binds, and the post-loop drain must complete both
+  requests bit-identically (``SERVE_RESUMED=2``).
 * ``straggle`` / ``control`` — the PR 7 straggler drill: every rank
   runs the same guarded transpose steps, with rank 1 dragged by the
   deterministic ``hop.exchange:delay%rank1`` fault (``straggle``) or
@@ -194,6 +198,28 @@ def main():
                                     batch=3)
 
         elastic.register_plan("batched-fft", batched_plan_factory)
+
+        # ISSUE 10 satellite: a SERVED plan registered by name must ride
+        # the reformation too — the service re-registers its factory as
+        # serve:<name>, the reform re-invokes it, queued host-payload
+        # requests re-bind to the rebuilt plan, and the service resumes
+        # draining its queue.  Requests are submitted BEFORE the kill
+        # step and drained only after the loop (post-reform on the
+        # elastic phase), so they provably cross the reformation.
+        from pencilarrays_tpu.serve import PlanService
+
+        def served_plan_factory(ctx=None):
+            return pa.PencilFFTPlan(pa.Topology((1,)), shape, real=True)
+
+        svc = PlanService(max_batch=4, max_wait_s=60.0)
+        svc.register_plan("served-fft", served_plan_factory)
+        serve_rng = np.random.default_rng(23)
+        serve_payloads = [
+            serve_rng.standard_normal(shape).astype(np.float32)
+            for _ in range(2)]
+        serve_tickets = [svc.submit("client", u, name="served-fft")
+                         for u in serve_payloads]
+
         state = {"u": pa.PencilArray.from_global(pen, truth)}
 
         def evolve(x):
@@ -228,6 +254,27 @@ def main():
             bout = bp.forward(bp.allocate_input())
             assert bout.extra_dims == (3,), bout.extra_dims
             print(f"REPLAN_BATCH={bp.batch}")
+            # the served plan was rebuilt through the SAME registry pass
+            sp = elastic.plan("serve:served-fft")
+            assert sp is not None, \
+                "reformation did not re-invoke the served plan factory"
+            assert svc.plan("served-fft") is sp, \
+                "service did not re-bind to the rebuilt served plan"
+        # resume draining: the pre-kill queue completes on the (possibly
+        # rebuilt) plan, bit-identical to direct compiled execution
+        assert svc.drain() >= 1, "service had nothing queued to drain"
+        cur = svc.plan("served-fft")
+        scp = cur.compile(())
+        ok = 0
+        for u, t in zip(serve_payloads, serve_tickets):
+            ref = scp.forward(pa.PencilArray.from_global(
+                cur.input_pencil, u))
+            got = t.result(5)
+            assert np.array_equal(np.asarray(pa.gather(got)),
+                                  np.asarray(pa.gather(ref))), \
+                "served request not bit-identical after reformation"
+            ok += 1
+        print(f"SERVE_RESUMED={ok}")
         final = np.ascontiguousarray(np.asarray(pa.gather(state["u"])))
         print(f"FINAL={hashlib.sha256(final.tobytes()).hexdigest()}")
     elif phase in ("straggle", "control"):

@@ -546,6 +546,15 @@ def _run_elastic_sequence(tmp_path, world):
         assert "REPLAN_BATCH=3" in out, (
             f"rank {rank}: reformed batched plan marker missing:\n"
             f"{out[-2000:]}")
+        # ISSUE 10 satellite: the SERVED plan (registered through
+        # serve.PlanService.register_plan -> elastic.register_plan)
+        # rebuilt through the reformation and the service resumed
+        # draining its pre-kill queue — both host-payload requests
+        # re-bound to the rebuilt plan and completed bit-identically
+        # (worker-side asserts; the marker proves the drain happened)
+        assert "SERVE_RESUMED=2" in out, (
+            f"rank {rank}: served-plan resume marker missing:\n"
+            f"{out[-2000:]}")
     _assert_elastic_timeline(el, world, victim)
 
 

@@ -1,0 +1,651 @@
+"""Multi-tenant plan service (serve/): registry, coalescing, quotas,
+cost ordering, tenant isolation, elastic rebind.
+
+The contracts under test (ISSUE 10 acceptance):
+
+* ``plan_key()`` is deterministic across processes (subprocess-pinned)
+  and provably agrees with the obs journal's ``plan_fp``;
+* the registry shares ONE executable per fingerprint across tenants,
+  counts hits/misses under ``cache="serve"`` with a per-tenant
+  dimension, and never double-counts against the plan-level
+  ``cache="plan"`` counters;
+* N concurrent same-plan requests coalesce into batched dispatches
+  (ragged final batch included) answered BIT-IDENTICALLY to N
+  sequential ``plan.compile()(x)`` calls, across c2c/r2c × fwd/bwd;
+* per-tenant quotas reject at admission with typed
+  ``AdmissionError``; mixed-plan traffic dispatches cheapest-first
+  (``collective_costs``-priced) with an anti-starvation override;
+* the tenant-isolation drill: an injected SDC on one tenant's hop
+  (``hop.exchange:corrupt``) raises typed ``IntegrityError`` on THAT
+  tenant's tickets while the other tenant's queued requests complete
+  bit-identically to an unfaulted run — full lifecycle journaled,
+  lint-clean, rendered by the real ``pa-obs`` CLI;
+* a named plan's elastic rebuild swaps the registry entry and the
+  queued host-payload requests re-bind and drain.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu import guard, obs
+from pencilarrays_tpu.guard import IntegrityError
+from pencilarrays_tpu.obs import events as obs_events
+from pencilarrays_tpu.obs import metrics as obs_metrics
+from pencilarrays_tpu.ops.fft import PencilFFTPlan
+from pencilarrays_tpu.resilience import RetryPolicy, faults
+from pencilarrays_tpu.serve import (
+    AdmissionError,
+    PlanRegistry,
+    PlanService,
+    ServeError,
+    ServiceClosedError,
+    StaleRequestError,
+    TenantQuota,
+)
+
+pytestmark = pytest.mark.usefixtures("devices")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Serve tests touch obs, guard and faults: start (and leave)
+    everything disabled and reset."""
+    for var in (obs.ENV_VAR, guard.ENV_VAR, faults.ENV_VAR,
+                "PENCILARRAYS_TPU_RETRIES"):
+        monkeypatch.delenv(var, raising=False)
+    guard._reset_for_tests()
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    yield
+    guard._reset_for_tests()
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+
+
+def _topo2(devices):
+    return pa.Topology((2,), devices=devices[:2])
+
+
+def _host(rng, shape, real=False):
+    if real:
+        return rng.standard_normal(shape).astype(np.float32)
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape)).astype(np.complex64)
+
+
+def _np(x):
+    return np.asarray(pa.gather(x))
+
+
+# ---------------------------------------------------------------------------
+# plan_key: the public stable fingerprint
+# ---------------------------------------------------------------------------
+
+
+def test_plan_key_stable_and_dtype_sensitive(devices):
+    topo = _topo2(devices)
+    a = PencilFFTPlan(topo, (8, 6, 4), transforms=("rfft", "fft", "fft"))
+    b = PencilFFTPlan(topo, (8, 6, 4), transforms=("rfft", "fft", "fft"))
+    assert a.plan_key() == b.plan_key()
+    assert len(a.plan_key()) == 12
+    assert a.plan_key() == a._fingerprint()
+    # every configuration knob must feed the key
+    c = PencilFFTPlan(topo, (8, 6, 4), transform="fft")
+    assert c.plan_key() != a.plan_key()
+    # single-device plans have no exchange steps: the explicit dtype
+    # field is what keeps f32 and f64 inputs distinct
+    t1 = pa.Topology((1,), devices=devices[:1])
+    d32 = PencilFFTPlan(t1, (8, 6), transform="dct", dtype=np.float32)
+    d64 = PencilFFTPlan(t1, (8, 6), transform="dct", dtype=np.float64)
+    assert d32.plan_key() != d64.plan_key()
+
+
+def test_plan_key_deterministic_in_subprocess(devices):
+    """Same inputs -> same key in a FRESH process (registry keys must
+    survive jax restarts; nothing identity- or device-bound may leak
+    into the hash)."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4),
+                         transforms=("rfft", "fft", "fft"), pipeline=2)
+    script = (
+        "import jax\n"
+        "import pencilarrays_tpu as pa\n"
+        "from pencilarrays_tpu.ops.fft import PencilFFTPlan\n"
+        "topo = pa.Topology((2,), devices=jax.devices()[:2])\n"
+        "p = PencilFFTPlan(topo, (8, 6, 4),\n"
+        "                  transforms=('rfft', 'fft', 'fft'), pipeline=2)\n"
+        "print('KEY=' + p.plan_key())\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert f"KEY={plan.plan_key()}" in out.stdout, (out.stdout,
+                                                    plan.plan_key())
+
+
+def test_plan_key_agrees_with_journal_plan_fp(devices, tmp_path):
+    """The registry key IS the obs correlation fingerprint: a plan's
+    ``plan.build`` record carries plan_fp == plan_key()."""
+    obs.enable(str(tmp_path / "obs"))
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    events = obs_events.read_journal(str(tmp_path / "obs"))
+    builds = [e for e in events if e["ev"] == "plan.build"]
+    assert builds and builds[-1]["plan_fp"] == plan.plan_key()
+    obs.disable()
+
+
+def test_reshard_key_stable(devices):
+    from pencilarrays_tpu.parallel.routing import reshard_key
+
+    topo = pa.Topology((2, 2), devices=devices[:4])
+    src = pa.Pencil(topo, (8, 6, 4), (1, 2))
+    dst = pa.Pencil(topo, (8, 6, 4), (0, 2))
+    k1 = reshard_key(src, dst, np.float32)
+    src2 = pa.Pencil(topo, (8, 6, 4), (1, 2))
+    assert reshard_key(src2, dst, np.float32) == k1
+    assert reshard_key(src, dst, np.complex64) != k1
+    assert reshard_key(dst, src, np.float32) != k1
+
+
+# ---------------------------------------------------------------------------
+# registry: shared executables + serve-labeled cache counters
+# ---------------------------------------------------------------------------
+
+
+def test_registry_dedupes_plans_and_counts_per_tenant(devices, tmp_path):
+    obs.enable(str(tmp_path / "obs"))
+    topo = _topo2(devices)
+    p1 = PencilFFTPlan(topo, (8, 6, 4))
+    p2 = PencilFFTPlan(topo, (8, 6, 4))   # a second tenant's equal plan
+    reg = PlanRegistry()
+    assert reg.register(p1) is p1
+    assert reg.register(p2) is p1         # fingerprint dedupe
+    cp = reg.compiled(p1, (), tenants=["alice"])
+    assert reg.compiled(p2, (), tenants=["alice", "bob"]) is cp
+    st = reg.stats()
+    assert (st["hits"], st["misses"]) == (1, 1)
+    counters = obs_metrics.snapshot()["counters"]
+    assert counters[
+        "compile.cache_misses{cache=serve,tenant=alice}"] == 1
+    assert counters["compile.cache_hits{cache=serve,tenant=alice}"] == 1
+    assert counters["compile.cache_hits{cache=serve,tenant=bob}"] == 1
+    # the double-count fix: the registry's resolve must NOT also tick
+    # the plan-level counters...
+    assert not any("cache=plan" in k for k in counters)
+    # ...which keep counting DIRECT plan.compile() callers
+    p1.compile(())
+    counters = obs_metrics.snapshot()["counters"]
+    assert counters["compile.cache_hits{cache=plan}"] == 1
+    obs.disable()
+
+
+def test_registry_replace_drops_stale_executables(devices):
+    topo = _topo2(devices)
+    p1 = PencilFFTPlan(topo, (8, 6, 4))
+    reg = PlanRegistry()
+    reg.register(p1)
+    reg.compiled(p1, ())
+    assert reg.stats()["executables"] == 1
+    p2 = PencilFFTPlan(topo, (8, 6, 4))   # rebuilt (same fingerprint)
+    assert reg.register(p2, replace=True) is p2
+    assert reg.stats()["executables"] == 0, \
+        "a rebuilt plan's key must not serve the dead plan's executable"
+
+
+# ---------------------------------------------------------------------------
+# coalescing correctness: batched == sequential, bit for bit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("real", [False, True], ids=["c2c", "r2c"])
+@pytest.mark.parametrize("direction", ["forward", "backward"])
+def test_coalesced_equals_sequential(devices, real, direction):
+    """5 concurrent same-plan requests through a max_batch=4 service
+    (one full + one RAGGED batch) are answered bit-identically to 5
+    sequential ``plan.compile()(x)`` calls."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4), real=real)
+    rng = np.random.default_rng(7)
+    if direction == "forward":
+        us = [_host(rng, plan.shape_physical, real=real) for _ in range(5)]
+    else:
+        # physical spectra: forward images of random fields (a backward
+        # request's payload lives on the output pencil / spectral dtype)
+        cp0 = plan.compile(())
+        us = [_np(cp0.forward(pa.PencilArray.from_global(
+            plan.input_pencil, _host(rng, plan.shape_physical, real=real))))
+            for _ in range(5)]
+    svc = PlanService(max_batch=4, max_wait_s=0.0)
+    tickets = [svc.submit("t0" if i % 2 else "t1", u, plan=plan,
+                          direction=direction)
+               for i, u in enumerate(us)]
+    assert svc.drain() == 2     # one full batch of 4 + the ragged 1
+    cp = plan.compile(())
+    pen = plan.input_pencil if direction == "forward" else plan.output_pencil
+    dt = (plan.dtype_physical if direction == "forward"
+          else plan.dtype_spectral)
+    for u, t in zip(us, tickets):
+        x = pa.PencilArray.from_global(pen, np.asarray(u, dt))
+        ref = cp.forward(x) if direction == "forward" else cp.backward(x)
+        assert np.array_equal(_np(t.result(5)), _np(ref)), \
+            "coalesced dispatch is not bit-identical to sequential"
+    st = svc.stats()
+    assert st["completed"] == {"ok": 5}
+    assert st["dispatches"] == 2
+
+
+def test_pencilarray_payloads_and_cache_reuse(devices):
+    """Device-array payloads work; a second wave of traffic reuses the
+    resident executable (registry hit, no recompile)."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(3)
+    svc = PlanService(max_batch=2, max_wait_s=0.0)
+    for wave in range(2):
+        us = [pa.PencilArray.from_global(
+            plan.input_pencil, _host(rng, plan.shape_physical))
+            for _ in range(2)]
+        ts = [svc.submit("t", u, plan=plan) for u in us]
+        svc.drain()
+        cp = plan.compile(())
+        for u, t in zip(us, ts):
+            assert np.array_equal(_np(t.result(5)), _np(cp.forward(u)))
+    st = svc.stats()["registry"]
+    assert st["misses"] == 1 and st["hits"] == 1
+
+
+def test_reshard_requests_coalesce_bit_identically(devices):
+    topo = pa.Topology((2, 2), devices=devices[:4])
+    src = pa.Pencil(topo, (8, 6, 4), (1, 2))
+    dst = pa.Pencil(topo, (8, 6, 4), (0, 2))
+    rng = np.random.default_rng(5)
+    us = [pa.PencilArray.from_global(src, _host(rng, (8, 6, 4)))
+          for _ in range(3)]
+    svc = PlanService(max_batch=4, max_wait_s=0.0)
+    ts = [svc.submit_reshard("t", u, dst) for u in us]
+    assert svc.drain() == 1     # ONE coalesced reshard dispatch
+    for u, t in zip(us, ts):
+        out = t.result(5)
+        assert out.pencil == dst
+        assert np.array_equal(_np(out), _np(pa.reshard(u, dst)))
+
+
+# ---------------------------------------------------------------------------
+# admission + ordering
+# ---------------------------------------------------------------------------
+
+
+def test_admission_quotas_typed_and_released(devices, tmp_path):
+    obs.enable(str(tmp_path / "obs"))
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(0)
+    u = _host(rng, (8, 6, 4))
+    svc = PlanService(max_batch=8, max_wait_s=60.0,
+                      quotas={"small": TenantQuota(max_requests=2),
+                              "thin": TenantQuota(max_bytes=100)})
+    svc.submit("small", u, plan=plan)
+    svc.submit("small", u, plan=plan)
+    with pytest.raises(AdmissionError) as ei:
+        svc.submit("small", u, plan=plan)
+    assert ei.value.tenant == "small"
+    assert ei.value.reason == "queue-depth"
+    with pytest.raises(AdmissionError) as ei:
+        svc.submit("thin", u, plan=plan)
+    assert ei.value.reason == "inflight-bytes"
+    # other tenants are untouched by one tenant's quota pressure
+    svc.submit("big", u, plan=plan)
+    svc.drain()
+    # completion releases the quota: the tenant can submit again
+    svc.submit("small", u, plan=plan)
+    svc.drain()
+    counters = obs_metrics.snapshot()["counters"]
+    assert counters["serve.rejected{reason=queue-depth,tenant=small}"] == 1
+    assert counters["serve.rejected{reason=inflight-bytes,tenant=thin}"] == 1
+    obs.disable()
+
+
+def test_cost_ordering_small_before_big(devices, tmp_path):
+    """Mixed-plan traffic dispatches cheapest-first: a small tenant's
+    request submitted AFTER a huge plan's batch still dispatches first
+    (collective_costs pricing), and the anti-starvation override flips
+    the order back to FIFO once the big batch is old enough."""
+    obs.enable(str(tmp_path / "obs"))
+    topo = _topo2(devices)
+    big = PencilFFTPlan(topo, (24, 16, 12))
+    small = PencilFFTPlan(topo, (6, 4, 4))
+    rng = np.random.default_rng(1)
+    svc = PlanService(max_batch=4, max_wait_s=0.0)
+    tb = svc.submit("heavy", _host(rng, (24, 16, 12)), plan=big)
+    ts = svc.submit("light", _host(rng, (6, 4, 4)), plan=small)
+    svc.drain()
+    assert ts.t_done is not None and tb.t_done is not None
+    dispatches = [e for e in obs_events.read_journal(str(tmp_path / "obs"))
+                  if e["ev"] == "serve.dispatch"]
+    assert [d["key"] for d in dispatches] == [ts.key, tb.key]
+    assert dispatches[0]["score_bytes"] < dispatches[1]["score_bytes"]
+    obs.disable()
+    # starve_after_s=0: every batch counts as starved -> admission order
+    svc2 = PlanService(max_batch=4, max_wait_s=0.0, starve_after_s=0.0)
+    b2 = svc2.queue
+    tb2 = svc2.submit("heavy", _host(rng, (24, 16, 12)), plan=big)
+    ts2 = svc2.submit("light", _host(rng, (6, 4, 4)), plan=small)
+    ready = b2.take_ready(flush=True)
+    assert [b.key for b in ready] == [tb2.key, ts2.key]
+    for b in ready:
+        svc2._dispatch(b)
+
+
+def test_single_sample_contract_and_close(devices):
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    svc = PlanService()
+    with pytest.raises(ServeError, match="single-sample"):
+        svc.submit("t", pa.PencilArray.zeros(plan.input_pencil, (2,),
+                                             plan.dtype_physical),
+                   plan=plan)
+    svc.close()
+    with pytest.raises(ServiceClosedError):
+        svc.submit("t", np.zeros((8, 6, 4), np.complex64), plan=plan)
+
+
+def test_wrong_pencil_payload_fails_typed(devices):
+    """A device payload that does not live where the plan expects fails
+    THAT ticket with typed StaleRequestError — the batch's error never
+    escapes the service."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    svc = PlanService(max_batch=4, max_wait_s=0.0)
+    bad = pa.PencilArray.zeros(plan.output_pencil, (),
+                               plan.dtype_spectral)
+    t = svc.submit("t", bad, plan=plan, direction="forward")
+    svc.drain()
+    assert isinstance(t.error(), StaleRequestError)
+
+
+def test_bad_payload_in_batch_fails_only_its_ticket(devices):
+    """Blame-one-request payload problems stay one request's problem
+    even INSIDE a coalesced batch: a stale device payload fails typed
+    while the other tenant's request in the SAME batch completes."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(6)
+    svc = PlanService(max_batch=4, max_wait_s=0.0)
+    stale = pa.PencilArray.zeros(plan.output_pencil, (),
+                                 plan.dtype_spectral)
+    good = _host(rng, (8, 6, 4))
+    t_bad = svc.submit("alice", stale, plan=plan, direction="forward")
+    t_good = svc.submit("bob", good, plan=plan, direction="forward")
+    svc.drain()
+    assert isinstance(t_bad.error(), StaleRequestError)
+    ref = plan.compile(()).forward(
+        pa.PencilArray.from_global(plan.input_pencil, good))
+    assert np.array_equal(_np(t_good.result(5)), _np(ref)), \
+        "a batch-mate's stale payload poisoned another tenant's ticket"
+    assert svc.stats()["completed"] == {"ok": 1,
+                                        "StaleRequestError": 1}
+
+
+def test_malformed_host_shape_rejected_at_submit(devices):
+    """A wrong-shape host payload is a typed error ON ITS SUBMITTER at
+    submit time — it never enters the queue, so it can never break a
+    coalesced stack under other tenants' requests."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    svc = PlanService(max_batch=4, max_wait_s=0.0)
+    with pytest.raises(ServeError, match="shape"):
+        svc.submit("t", np.zeros((9, 6, 4), np.complex64), plan=plan)
+    assert svc.queue.depth() == 0
+
+
+def test_complex_payload_to_r2c_plan_rejected_at_submit(devices):
+    """A complex host payload against an r2c plan's real input is a
+    typed error at submit — the coalesced ``np.asarray(dtype=float32)``
+    cast would otherwise silently discard the imaginary part and return
+    a numerically wrong transform marked ok."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4), real=True)
+    svc = PlanService(max_batch=4, max_wait_s=0.0)
+    with pytest.raises(ServeError, match="imaginary"):
+        svc.submit("t", np.zeros((8, 6, 4), np.complex64), plan=plan)
+    assert svc.queue.depth() == 0
+
+
+# ---------------------------------------------------------------------------
+# tenant isolation: the ISSUE 10 acceptance drill
+# ---------------------------------------------------------------------------
+
+
+def _pa_obs_check(obs_dir):
+    """Run the REAL post-mortem CLI over the drill's journal (the same
+    path an operator types) and return the merged events."""
+    from pencilarrays_tpu.obs.__main__ import main
+    from pencilarrays_tpu.obs.timeline import merge_journals
+
+    assert main(["lint", obs_dir]) == 0, "pa-obs lint failed"
+    assert main(["timeline", obs_dir]) == 0, "pa-obs timeline failed"
+    return merge_journals(obs_dir).events
+
+
+@pytest.mark.chaos
+def test_tenant_isolation_sdc_drill(devices, tmp_path):
+    """``hop.exchange:corrupt`` poisoning one tenant's hop: that
+    tenant's request raises typed ``IntegrityError`` while the other
+    tenant's concurrently queued requests complete bit-identically to
+    an unfaulted run — lifecycle journaled, ``pa-obs timeline``
+    rendered."""
+    obs_dir = str(tmp_path / "obs")
+    obs.enable(obs_dir)
+    guard.enable(str(tmp_path / "bundles"))
+    topo = _topo2(devices)
+    plan_a = PencilFFTPlan(topo, (6, 4, 4))     # cheap: dispatches first
+    plan_b = PencilFFTPlan(topo, (12, 8, 6))
+    rng = np.random.default_rng(11)
+    ua = _host(rng, (6, 4, 4))
+    ubs = [_host(rng, (12, 8, 6)) for _ in range(2)]
+    svc = PlanService(max_batch=4, max_wait_s=0.0,
+                      retry=RetryPolicy(max_attempts=1))
+    # alice's batch dispatches first (cheapest); its FIRST exchange is
+    # the poisoned hit — bob's batch is queued behind it throughout
+    with faults.active("hop.exchange:corrupt*1@1"):
+        ta = svc.submit("alice", ua, plan=plan_a)
+        tbs = [svc.submit("bob", u, plan=plan_b) for u in ubs]
+        svc.drain()
+    err = ta.error()
+    assert isinstance(err, IntegrityError), err
+    with pytest.raises(IntegrityError):
+        ta.result(1)
+    # bob: bit-identical to the unfaulted run (guard armed, no faults:
+    # the same eager isolation path the service dispatched through)
+    for u, t in zip(ubs, tbs):
+        ref = plan_b.forward(pa.PencilArray.from_global(
+            plan_b.input_pencil, u))
+        assert np.array_equal(_np(t.result(5)), _np(ref)), \
+            "another tenant's request was poisoned"
+    st = svc.stats()
+    assert st["completed"] == {"ok": 2, "IntegrityError": 1}
+    obs.disable()
+    guard.disable()
+    # the full lifecycle, through the real pa-obs CLI
+    events = _pa_obs_check(obs_dir)
+    reqs = [e for e in events if e["ev"] == "serve.request"]
+    assert {e["tenant"] for e in reqs} == {"alice", "bob"}
+    assert len([e for e in events if e["ev"] == "serve.coalesce"]) == 2
+    assert len([e for e in events if e["ev"] == "serve.dispatch"]) == 2
+    comp = {e["req"]: e for e in events if e["ev"] == "serve.complete"}
+    assert comp[ta.id]["outcome"] == "IntegrityError"
+    assert comp[ta.id]["tenant"] == "alice"
+    assert all(comp[t.id]["outcome"] == "ok" for t in tbs)
+    # the SDC detection + the recover ladder are attributed to alice's
+    # dispatch (guarded_step meta= threading)
+    sdc = [e for e in events if e["ev"] == "guard.sdc"]
+    assert sdc, "no SDC detection journaled"
+    rec = [e for e in events if e["ev"] == "guard.recover"]
+    assert any(e.get("tenants") == ["alice"] for e in rec), rec
+    # ...and the timeline text names the failure loudly
+    from pencilarrays_tpu.obs.timeline import merge_journals, render
+
+    txt = render(merge_journals(obs_dir))
+    assert f"serve alice#{ta.id}:IntegrityError" in txt
+    assert "serve.dispatch" in txt and "serve.coalesce" in txt
+
+
+@pytest.mark.chaos
+def test_isolation_same_tenant_later_traffic_unpoisoned(devices, tmp_path):
+    """The poison is scoped to the BATCH, not the tenant or the
+    service: the same tenant's next request (after the faulted batch)
+    completes cleanly."""
+    guard.enable(str(tmp_path / "bundles"))
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(2)
+    svc = PlanService(max_batch=4, max_wait_s=0.0,
+                      retry=RetryPolicy(max_attempts=1))
+    u1, u2 = _host(rng, (8, 6, 4)), _host(rng, (8, 6, 4))
+    with faults.active("hop.exchange:corrupt*1@1"):
+        t1 = svc.submit("alice", u1, plan=plan)
+        svc.drain()
+        t2 = svc.submit("alice", u2, plan=plan)
+        svc.drain()
+    assert isinstance(t1.error(), IntegrityError)
+    ref = plan.forward(pa.PencilArray.from_global(plan.input_pencil, u2))
+    assert np.array_equal(_np(t2.result(5)), _np(ref))
+    guard.disable()
+
+
+@pytest.mark.chaos
+def test_guarded_retry_recovers_transient_sdc(devices, tmp_path):
+    """With retries allowed (the default ladder), a one-shot corrupt is
+    TRANSIENT: guarded_step reruns the batch and the tickets resolve
+    ok — serving inherits the guard's detect-and-recover semantics."""
+    guard.enable(str(tmp_path / "bundles"))
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(4)
+    u = _host(rng, (8, 6, 4))
+    svc = PlanService(max_batch=4, max_wait_s=0.0,
+                      retry=RetryPolicy(max_attempts=2, base_delay=0.01))
+    with faults.active("hop.exchange:corrupt*1@1"):
+        t = svc.submit("alice", u, plan=plan)
+        svc.drain()
+    ref = plan.forward(pa.PencilArray.from_global(plan.input_pencil, u))
+    assert np.array_equal(_np(t.result(5)), _np(ref))
+    guard.disable()
+
+
+def test_guarded_step_meta_survives_reserved_key_names(tmp_path):
+    """A ``meta=`` key named like one of guard.recover's own record
+    fields (``label``/``stage``) — or like ``record_event``'s own
+    parameters (``ev``/``_fsync``) — must not crash the ladder
+    mid-recovery with a duplicate-kwarg error, nor silently act as the
+    fsync override — the record's explicit fields win."""
+    from pencilarrays_tpu.guard.recover import guarded_step
+
+    obs.enable(str(tmp_path / "obs"))
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise IntegrityError("injected", hop="t")
+        return "ok"
+
+    out = guarded_step(
+        fn, retry=RetryPolicy(max_attempts=2, base_delay=0.0),
+        label="meta-step",
+        meta={"label": "sneaky", "stage": "sneaky", "ev": "sneaky",
+              "_fsync": "sneaky", "tenant": "alice"})
+    assert out == "ok"
+    recs = [e for e in obs_events.read_journal(str(tmp_path / "obs"))
+            if e["ev"] == "guard.recover"]
+    assert recs and all(e["label"] == "meta-step" for e in recs)
+    assert all(e.get("tenant") == "alice" for e in recs)
+    assert all("_fsync" not in e for e in recs)
+    obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# elastic rebind: named plans survive reformation
+# ---------------------------------------------------------------------------
+
+
+def test_named_plan_rebuild_rebinds_queue(devices):
+    """The elastic-registered factory rebuilds the plan; queued
+    host-payload requests re-bind and drain on the NEW plan object
+    (the in-process half of the 2-rank drill in test_multiprocess)."""
+    from pencilarrays_tpu.cluster import elastic
+
+    topo = _topo2(devices)
+    rng = np.random.default_rng(9)
+    svc = PlanService(max_batch=4, max_wait_s=60.0)
+
+    def factory(ctx=None):
+        return PencilFFTPlan(_topo2(devices), (8, 6, 4), real=True)
+
+    try:
+        p0 = svc.register_plan("served", factory)
+        assert svc.plan("served") is p0
+        us = [_host(rng, (8, 6, 4), real=True) for _ in range(3)]
+        ts = [svc.submit("t", u, name="served") for u in us[:2]]
+        # a plan= submission that dedupes onto the same fingerprint
+        # must re-bind too — it shares the coalesce key with the named
+        # ones, and one dead-mesh straggler would poison the batch
+        ts.append(svc.submit("t2", us[2], plan=p0))
+        # simulate the reformation's registry pass: the serve factory
+        # was registered as serve:<name> and re-invoking it must swap
+        # the service's binding and re-bind the queued requests
+        rebuilt = elastic._registry["serve:served"](None)
+        assert svc.plan("served") is rebuilt and rebuilt is not p0
+        assert rebuilt.plan_key() == p0.plan_key()
+        assert all(e.plan is rebuilt
+                   for e in svc.queue.pending_entries()), \
+            "a queued entry kept the pre-reform plan object"
+        svc.drain()
+        cp = rebuilt.compile(())
+        for u, t in zip(us, ts):
+            ref = cp.forward(pa.PencilArray.from_global(
+                rebuilt.input_pencil, u))
+            assert np.array_equal(_np(t.result(5)), _np(ref))
+        # close() must unregister the elastic factory: a dead service
+        # must not be rebuilt into (and kept alive) by a later reform
+        svc.close()
+        assert "serve:served" not in elastic._registry
+    finally:
+        elastic.unregister_plan("serve:served")
+
+
+# ---------------------------------------------------------------------------
+# bench smoke (slow-marked: the sweep the suite's --serve arm commits)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_bench_smoke(devices, tmp_path):
+    from benchmarks.serve_bench import run_serve_suite
+
+    res = run_serve_suite(devices[:2], shapes=((8, 6, 4), (12, 8, 6)),
+                          n_requests=8, max_batch=4, repeats=2)
+    assert res["coalesced"]["requests_per_s"] > 0
+    assert res["serialized"]["requests_per_s"] > 0
+    assert res["speedup"] == pytest.approx(
+        res["coalesced"]["requests_per_s"]
+        / res["serialized"]["requests_per_s"])
+    for arm in ("coalesced", "serialized"):
+        for tstats in res[arm]["tenants"].values():
+            assert tstats["p50_ms"] > 0 and tstats["p99_ms"] >= \
+                tstats["p50_ms"]
+    hlo = res["hlo_pin"]
+    assert hlo["counts_equal_unbatched"], hlo
+    assert hlo["predicted_equals_hlo"], hlo
+    assert res["coalesced"]["dispatches"] < res["serialized"]["dispatches"]
