@@ -1,0 +1,153 @@
+"""Static-analysis cost benchmark — writes ``BENCH_ANALYSIS.json``.
+
+The ISSUE 11 acceptance question is a *cost* question: certification
+must be cheap enough to run pre-flight, at plan-registration time, for
+every resident executable of a full serve registry.  This arm
+measures:
+
+* ``certify_sweep`` — wall time of ``PlanService.certify()`` over a
+  registry populated like the serve bench's mixed-traffic setup
+  (c2c + r2c + batched plans, some with resident compiled
+  executables), best-of-``repeats``, with the per-target average;
+* ``single_plan`` — one ``certify_plan()`` call (the
+  plan-registration-time unit cost), against the plan's own XLA
+  compile time for scale;
+* ``lint`` — pillar 2 (the AST linter) over the whole repo: pure
+  source analysis, no jax, milliseconds.
+
+Usage: ``python benchmarks/analysis_bench.py [--devices N]`` or via
+``python benchmarks/suite.py --analysis[-only]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_analysis_suite(devs, *, repeats: int = 3) -> dict:
+    import numpy as np
+
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.analysis.spmd import certify_plan
+    from pencilarrays_tpu.cluster import elastic
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+    from pencilarrays_tpu.serve.service import PlanService
+
+    n = len(devs)
+    dims = (2, n // 2) if n >= 4 else (n,)
+    topo = pa.Topology(dims, devices=devs)
+    shapes = ((16, 12, 8), (32, 24, 16))
+
+    svc = PlanService(max_batch=4)
+    names = []
+    try:
+        for shape in shapes:
+            for real in (False, True):
+                name = f"{'r2c' if real else 'c2c'}-{shape[0]}"
+                svc.register_plan(
+                    name, lambda ctx, s=shape, r=real: PencilFFTPlan(
+                        topo, s, real=r,
+                        **({} if r else {"dtype": np.complex64})))
+                names.append(name)
+        # resident executables: an unbatched and a coalesced-batch
+        # variant of the first plan, unbatched for the second — the
+        # mixed-residency shape a live service has
+        svc.registry.compiled(svc.plan(names[0]), ())
+        svc.registry.compiled(svc.plan(names[0]), (4,))
+        svc.registry.compiled(svc.plan(names[1]), ())
+
+        # warm-up (first sweep pays one-time tracing setup), then time
+        svc.certify()
+        sweep_s = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            report = svc.certify()
+            sweep_s.append(time.perf_counter() - t0)
+        best = min(sweep_s)
+        certified = report["certified"]
+
+        # the unit cost at plan-registration time, vs the plan's own
+        # compile cost for scale.  CompiledPlan compiles lazily, so the
+        # honest baseline forces the first forward dispatch (trace +
+        # XLA compile + run), the price registration already pays.
+        plan = PencilFFTPlan(topo, shapes[0], dtype=np.complex64)
+        t0 = time.perf_counter()
+        certify_plan(plan, (), target="bench", _journal=False)
+        single_s = time.perf_counter() - t0
+        u = plan.allocate_input(())
+        t0 = time.perf_counter()
+        cp = plan.compile(())
+        cp.forward(u).data.block_until_ready()
+        compile_s = time.perf_counter() - t0
+    finally:
+        svc.close()
+        for name in names:
+            elastic.unregister_plan(f"serve:{name}")
+
+    # pillar 2 over the real repo
+    from pencilarrays_tpu.analysis.lint import run_lint
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.perf_counter()
+    findings, _ = run_lint(root)
+    lint_s = time.perf_counter() - t0
+
+    return {
+        "certify_sweep": {
+            "plans": len(names),
+            "resident_executables": 3,
+            "certified_targets": certified,
+            "total_s": best,
+            "per_target_ms": best / max(1, certified) * 1e3,
+            "repeats": repeats,
+            "all_runs_s": sweep_s,
+        },
+        "single_plan": {
+            "certify_s": single_s,
+            "plan_compile_s": compile_s,
+            "certify_over_compile": (single_s / compile_s
+                                     if compile_s else None),
+        },
+        "lint": {"seconds": lint_s, "findings": len(findings)},
+    }
+
+
+def write_artifact(results: dict, path: str = "BENCH_ANALYSIS.json",
+                   *, devs=None) -> None:
+    doc = dict(results)
+    if devs is not None:
+        doc.setdefault("platform", devs[0].platform)
+        doc.setdefault("n_devices", len(devs))
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--out", default="BENCH_ANALYSIS.json")
+    parser.add_argument("--repeats", type=int, default=3)
+    args = parser.parse_args()
+
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+    import jax
+
+    devs = jax.devices()[: args.devices]
+    results = run_analysis_suite(devs, repeats=args.repeats)
+    write_artifact(results, args.out, devs=devs)
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -107,20 +107,12 @@ def _run_arm(plans, payloads, tenants, *, max_batch: int,
 def _hlo_pin(plan, B: int) -> dict:
     """The coalesced dispatch's measured-verdict pin: compiled batched
     HLO collective stats == analytic prediction, per-op counts == the
-    unbatched program's (count ×1), bytes ×B."""
-    import jax
+    unbatched program's (count ×1), bytes ×B — through the shared
+    ``analysis.spmd`` extractor."""
+    from pencilarrays_tpu.analysis import spmd
 
-    import pencilarrays_tpu as pa
-    from pencilarrays_tpu.utils.hlo import collective_stats
-
-    def stats_for(extra):
-        u = plan.allocate_input(extra)
-        fn = jax.jit(lambda d: plan.forward(
-            pa.PencilArray(plan.input_pencil, d, extra)).data)
-        return collective_stats(fn.lower(u.data).compile().as_text())
-
-    batched = stats_for((B,))
-    unbatched = stats_for(())
+    batched = spmd.trace_plan(plan, (B,)).stats()
+    unbatched = spmd.trace_plan(plan, ()).stats()
     predicted = plan.collective_costs((B,))
     counts_equal = (
         set(batched) == set(unbatched)

@@ -730,6 +730,15 @@ def main():
     parser.add_argument("--serve-only", action="store_true",
                         help="run ONLY the --serve arm (used to commit "
                              "the BENCH_SERVE.json artifact)")
+    parser.add_argument("--analysis", action="store_true",
+                        help="measure the static-analysis cost: "
+                             "PlanService.certify() sweep over a full "
+                             "serve registry + the single-plan "
+                             "registration-time unit cost + the AST "
+                             "lint pillar; writes BENCH_ANALYSIS.json")
+    parser.add_argument("--analysis-only", action="store_true",
+                        help="run ONLY the --analysis arm (used to "
+                             "commit the BENCH_ANALYSIS.json artifact)")
     parser.add_argument("--serve-n", type=int, default=16,
                         help="requests per tenant in the serving arm")
     args = parser.parse_args()
@@ -860,6 +869,27 @@ def main():
                         "n_devices": len(devs)}, "BENCH_SERVE.json",
                        devs=devs)
         if args.serve_only:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+            print(json.dumps(results, indent=1))
+            return
+
+    # -- 14. analysis: pre-flight certification cost (opt-in) --------------
+    # The ISSUE 11 acceptance question: certify() must be cheap enough
+    # to run at plan-registration time for the full serve registry —
+    # measured sweep wall time + per-target cost + the lint pillar.
+    if args.analysis or args.analysis_only:
+        from benchmarks.analysis_bench import (
+            run_analysis_suite,
+            write_artifact,
+        )
+
+        results["analysis"] = run_analysis_suite(devs, repeats=3)
+        write_artifact({**results["analysis"],
+                        "platform": devs[0].platform,
+                        "n_devices": len(devs)}, "BENCH_ANALYSIS.json",
+                       devs=devs)
+        if args.analysis_only:
             with open(args.out, "w") as f:
                 json.dump(results, f, indent=1)
             print(json.dumps(results, indent=1))

@@ -1,0 +1,72 @@
+"""Static analysis: SPMD program verification + repo invariant linting.
+
+Two pillars, one CLI (``pa-lint``, or ``python -m
+pencilarrays_tpu.analysis``):
+
+* :mod:`~pencilarrays_tpu.analysis.spmd` — extract a typed
+  :class:`~pencilarrays_tpu.analysis.spmd.CollectiveTrace` from any
+  compiled program (``CompiledPlan``, routed reshard chain, raw
+  transpose executable) and *prove* static properties about it: the
+  trace matches the ``collective_costs`` prediction op-for-op, sibling
+  configurations compile consistently, peak HBM stays in bound,
+  donation actually elided the buffer.  The shared analyzer behind the
+  test suite's HLO pins and ``PlanService.certify()``'s pre-flight
+  registry sweep.
+* :mod:`~pencilarrays_tpu.analysis.lint` — AST-based cross-file
+  invariant checks over the repo itself (journal-event registration,
+  env-knob documentation, plan-cache registration, fault-point docs,
+  lock-guarded daemon state), gated on a committed, commented
+  allowlist.
+
+See ``docs/StaticAnalysis.md``.
+"""
+
+from .errors import (
+    AnalysisError,
+    DonationError,
+    HbmBoundError,
+    ScheduleMismatchError,
+    TraceDivergenceError,
+)
+from .spmd import (
+    CollectiveOp,
+    CollectiveTrace,
+    EXCHANGE_KINDS,
+    certify_plan,
+    predicted_peak_hbm,
+    trace_compiled_plan,
+    trace_fn,
+    trace_hlo,
+    trace_plan,
+    trace_route,
+    trace_transpose,
+    verify_consistent,
+    verify_donation,
+    verify_hbm,
+    verify_plan,
+    verify_route,
+)
+
+__all__ = [
+    "AnalysisError",
+    "ScheduleMismatchError",
+    "TraceDivergenceError",
+    "HbmBoundError",
+    "DonationError",
+    "CollectiveOp",
+    "CollectiveTrace",
+    "EXCHANGE_KINDS",
+    "trace_hlo",
+    "trace_fn",
+    "trace_transpose",
+    "trace_plan",
+    "trace_compiled_plan",
+    "trace_route",
+    "verify_plan",
+    "verify_route",
+    "verify_consistent",
+    "verify_hbm",
+    "verify_donation",
+    "certify_plan",
+    "predicted_peak_hbm",
+]

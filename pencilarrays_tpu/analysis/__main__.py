@@ -1,0 +1,218 @@
+"""``pa-lint`` — static verification gate over the repo and its programs.
+
+::
+
+    python -m pencilarrays_tpu.analysis [ROOT] [options]   # or: pa-lint
+
+    ROOT                repo root to lint (default: auto-detect from
+                        CWD, falling back to the installed package's
+                        parent)
+    --allowlist FILE    allowlist path (default: ROOT/pa-lint.allow)
+    --no-spmd           skip pillar 1 (the compiled-program
+                        verification sweep; pillar 2's AST lint is
+                        pure source analysis and always runs)
+    --devices N         virtual CPU mesh width for the sweep when no
+                        backend is initialized yet (default 8)
+    --json              machine-readable findings + sweep report
+
+Exit status: 0 when the AST lint has no findings outside the
+allowlist AND every SPMD sweep check verifies; 1 otherwise.
+
+Pillar 1 sweeps the plan-type matrix (slab/pencil x c2c/r2c x
+unbatched/batched, plus a routed reshard with donation + HBM bounds
+and a guard-on-vs-off consistency pin) on a virtual CPU mesh —
+proving the compiled collective schedule equals the
+``collective_costs`` prediction for every program family the library
+dispatches.  Pillar 2 is :mod:`pencilarrays_tpu.analysis.lint`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+__all__ = ["main"]
+
+
+def _find_root(start: Optional[str]) -> str:
+    """The repo root: an explicit argument, else the first ancestor of
+    CWD containing ``pencilarrays_tpu/``, else the installed package's
+    parent directory."""
+    if start:
+        return os.path.abspath(start)
+    d = os.getcwd()
+    while True:
+        if os.path.isdir(os.path.join(d, "pencilarrays_tpu")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    import pencilarrays_tpu
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(pencilarrays_tpu.__file__)))
+
+
+def _run_spmd_sweep(n_devices: int) -> List[dict]:
+    """Pillar 1: the plan-type verification matrix.  Each entry is a
+    check record (``{"target", "outcome", ...}``); outcomes other than
+    ``ok``/``skipped`` fail the gate."""
+    # a fresh CLI process has no backend yet: ask for a virtual CPU
+    # mesh BEFORE jax initializes (no-op when the caller already set
+    # platform/flags or initialized jax)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}")
+
+    import jax
+    import numpy as np
+
+    from pencilarrays_tpu import Pencil, PencilFFTPlan, Topology
+    from pencilarrays_tpu.analysis import spmd
+    from pencilarrays_tpu.analysis.errors import AnalysisError
+
+    devs = jax.devices()
+    results: List[dict] = []
+    if len(devs) < 4:
+        results.append({
+            "target": "spmd-sweep", "outcome": "skipped",
+            "reason": f"{len(devs)} device(s) available; the sweep "
+                      f"needs a >=4-wide mesh (run under "
+                      f"XLA_FLAGS=--xla_force_host_platform_device_"
+                      f"count=8)"})
+        return results
+
+    def run(target, fn):
+        try:
+            rec = fn()
+            rec = {"target": target, "outcome": "ok", **(rec or {})}
+        except AnalysisError as e:
+            rec = {"target": target, "outcome": type(e).__name__,
+                   "error": str(e)}
+        results.append(rec)
+
+    shape = (8, 8, 4)
+    # slab/pencil x c2c/r2c x unbatched/batched — forward AND backward
+    for dims, real in (((4,), False), ((4,), True),
+                       ((2, 2), False), ((2, 2), True)):
+        topo = Topology(dims, devices=devs[: int(np.prod(dims))])
+        kind = f"{'slab' if len(dims) == 1 else 'pencil'}/" \
+               f"{'r2c' if real else 'c2c'}"
+        plan = PencilFFTPlan(topo, shape, real=real)
+        for extra in ((), (3,)):
+            run(f"plan {kind} batch={extra}", lambda p=plan, e=extra: {
+                "ops": len(spmd.verify_plan(p, e, "forward")),
+                "bwd_ops": len(spmd.verify_plan(p, e, "backward"))})
+    # batched-vs-unbatched amortization: count x1, bytes xB
+    topo = Topology((2, 2), devices=devs[:4])
+    plan = PencilFFTPlan(topo, shape, dtype=np.complex64)
+    run("consistency batched-vs-unbatched", lambda: spmd.verify_consistent(
+        spmd.trace_plan(plan, ()), spmd.trace_plan(plan, (3,)),
+        bytes_ratio=3))
+    # routed reshard: schedule + HBM bound + donation elision
+    from pencilarrays_tpu.parallel.routing import plan_reshard_route
+
+    topo8 = Topology((2, 4), devices=devs[:8]) if len(devs) >= 8 else topo
+    rshape = (16, 12, 8)
+    pin = Pencil(topo8, rshape, (1, 2))
+    dest = Pencil(topo8, rshape, (0, 1))
+    route = plan_reshard_route(pin, dest, (), np.float32)
+    if route.hops:
+        run("route schedule", lambda: {
+            "ops": len(spmd.verify_route(route))})
+        run("route hbm-bound", lambda: {
+            "peak_hbm_bytes": spmd.verify_hbm(
+                route, 1 << 30, source="route")})
+        run("route donation", lambda: spmd.verify_donation(
+            spmd.trace_route(route, donate=True)))
+    # guard-on vs guard-off hop bodies: same exchange collectives
+    from pencilarrays_tpu.ops.pallas_kernels import pallas_enabled
+    from pencilarrays_tpu.parallel import transpositions as tr
+
+    p1 = Pencil(topo8, rshape, (1, 2))
+    p2 = Pencil(topo8, rshape, (0, 2))
+    R = tr.assert_compatible(p1, p2)
+    m = tr.AllToAll()
+
+    def _guard_consistency():
+        off = tr._compiled_transpose(p1, p2, R, 0, m, False,
+                                     pallas_enabled())
+        on = tr._compiled_guarded_transpose(p1, p2, R, 0, m, False,
+                                            pallas_enabled(), False)
+        aval = spmd._input_aval(p1, (), np.dtype(np.float32))
+        spmd.verify_consistent(
+            spmd.trace_fn(off, aval, source="guard-off hop"),
+            spmd.trace_fn(on, aval, source="guard-on hop"))
+
+    run("consistency guard-on-vs-off", _guard_consistency)
+    return results
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pa-lint",
+        description="static SPMD program verifier + repo invariant "
+                    "linter (see docs/StaticAnalysis.md)")
+    ap.add_argument("root", nargs="?", default=None,
+                    help="repo root (default: auto-detect)")
+    ap.add_argument("--allowlist", default=None,
+                    help="allowlist file (default: ROOT/pa-lint.allow)")
+    ap.add_argument("--no-spmd", action="store_true",
+                    help="skip the compiled-program verification sweep")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU mesh width for the sweep")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    from .lint import Allowlist, run_lint
+
+    root = _find_root(args.root)
+    allowlist = (Allowlist.load(args.allowlist)
+                 if args.allowlist else None)
+    findings, allowlist = run_lint(root, allowlist)
+
+    sweep: List[dict] = []
+    if not args.no_spmd:
+        sweep = _run_spmd_sweep(args.devices)
+    sweep_failures = [r for r in sweep
+                      if r["outcome"] not in ("ok", "skipped")]
+
+    if args.json:
+        print(json.dumps({
+            "root": root,
+            "findings": [{"check": f.check, "path": f.path,
+                          "line": f.line, "ident": f.ident,
+                          "message": f.message} for f in findings],
+            "allowlisted": sorted(allowlist.entries),
+            "unused_allowlist": allowlist.unused(),
+            "spmd": sweep,
+        }, indent=1))
+    else:
+        for f in findings:
+            print(str(f))
+        for key in allowlist.unused():
+            print(f"pa-lint: WARNING: unused allowlist entry: {key}",
+                  file=sys.stderr)
+        for r in sweep:
+            status = r["outcome"].upper() if r["outcome"] not in (
+                "ok", "skipped") else r["outcome"]
+            detail = r.get("error") or r.get("reason") or ""
+            print(f"spmd: {status:8s} {r['target']}"
+                  + (f" — {detail}" if detail else ""))
+        nf, ns = len(findings), len(sweep_failures)
+        ok = "clean" if not (nf or ns) else "FAILED"
+        print(f"pa-lint: {ok}: {nf} lint finding(s), "
+              f"{ns} sweep failure(s), "
+              f"{len(allowlist.entries)} allowlisted")
+    return 1 if (findings or sweep_failures) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""Typed errors of the static-analysis layer (``analysis/``).
+
+Every check failure names *what* diverged — the offending collective
+op, the hop that blows the HBM bound, the donation that silently did
+not happen — so a pre-flight gate (``PlanService.certify()``, CI) can
+fail with an actionable message instead of a diff dump.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = [
+    "AnalysisError",
+    "ScheduleMismatchError",
+    "TraceDivergenceError",
+    "HbmBoundError",
+    "DonationError",
+]
+
+
+class AnalysisError(Exception):
+    """Base of every static-analysis check failure."""
+
+
+class ScheduleMismatchError(AnalysisError):
+    """A compiled program's collective trace does not match the plan's
+    ``collective_costs`` prediction.  ``op`` names the first diverging
+    collective kind; ``predicted``/``observed`` are its
+    ``{"count", "bytes"}`` entries (``None`` = the op is absent on that
+    side)."""
+
+    def __init__(self, source: str, op: str,
+                 predicted: Optional[dict], observed: Optional[dict]):
+        self.source = source
+        self.op = op
+        self.predicted = predicted
+        self.observed = observed
+        super().__init__(
+            f"{source}: collective {op!r} diverges from prediction: "
+            f"predicted {predicted!r}, compiled program has {observed!r}")
+
+
+class TraceDivergenceError(AnalysisError):
+    """Two programs that must agree (guard-on vs guard-off hop bodies,
+    batched vs unbatched, probe plan vs built plan) compiled to
+    inconsistent collective traces.  ``op`` names the first diverging
+    collective kind."""
+
+    def __init__(self, a: str, b: str, op: str, what: str,
+                 left, right):
+        self.sources = (a, b)
+        self.op = op
+        self.what = what
+        super().__init__(
+            f"traces diverge on {op!r} ({what}): {a} has {left!r}, "
+            f"{b} has {right!r}")
+
+
+class HbmBoundError(AnalysisError):
+    """A program's static per-chip peak-HBM prediction exceeds the
+    caller's ``hbm_limit``.  ``hop`` names the offending exchange."""
+
+    def __init__(self, source: str, hop: str, peak_bytes: int,
+                 limit_bytes: int):
+        self.source = source
+        self.hop = hop
+        self.peak_bytes = int(peak_bytes)
+        self.limit_bytes = int(limit_bytes)
+        super().__init__(
+            f"{source}: hop {hop} needs {peak_bytes} peak HBM bytes "
+            f"per chip, over the {limit_bytes}-byte limit")
+
+
+class DonationError(AnalysisError):
+    """A program priced with buffer donation compiled WITHOUT the
+    input/output alias — the buffer the router's pricing assumed would
+    be elided is still resident."""
+
+    def __init__(self, source: str, detail: str):
+        self.source = source
+        super().__init__(f"{source}: {detail}")

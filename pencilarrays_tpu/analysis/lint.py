@@ -1,0 +1,573 @@
+"""Repo invariant linter — AST-based, zero imports of the checked code.
+
+The tree carries several cross-file invariants that no single module
+can enforce at runtime:
+
+``journal-event``
+    every ``record_event("<name>", ...)`` call site's event name is
+    registered in ``obs/schema.py`` ``EVENT_TYPES`` (an unregistered
+    event only fails when a test happens to lint a journal containing
+    it — this check fails at commit time instead);
+``env-knob``
+    every ``PENCILARRAYS_TPU_*`` environment knob mentioned in package
+    code is documented somewhere under ``docs/`` or ``README.md``;
+``plan-cache``
+    every ``lru_cache``-decorated compiled-executable factory (a cached
+    function whose body builds a ``jax.jit`` program) is registered
+    with ``cluster/elastic.py`` ``clear_plan_caches()`` — PR 8
+    hand-maintained that list; this check makes the count impossible
+    to silently break;
+``fault-point``
+    every injection point registered in ``resilience/faults.py``
+    ``POINTS`` (and every literal consulted via ``faults.fire``/
+    ``faults.armed``) appears in the ``docs/Resilience.md`` point
+    table;
+``unlocked-state``
+    mutable module-level state that is actually *mutated* inside the
+    daemon-bearing packages (``obs/``, ``cluster/``, ``serve/`` — the
+    ones that run threads) lives in a module that also defines a
+    module-level lock, or is explicitly allowlisted.
+
+Everything is parsed from source with :mod:`ast` — the linter never
+imports the modules it checks, so it runs in milliseconds, cannot be
+fooled by import-time side effects, and works on a tree that does not
+even import (no jax needed).
+
+Findings outside the committed allowlist (``pa-lint.allow`` at the
+repo root — one ``check-id identifier  # justification`` per line)
+fail ``pa-lint`` and the CI gate test.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "Allowlist",
+    "CHECKS",
+    "run_lint",
+    "lint_tree",
+]
+
+PACKAGE = "pencilarrays_tpu"
+DEFAULT_ALLOWLIST = "pa-lint.allow"
+
+# the daemon-bearing packages whose module-level mutable state the
+# unlocked-state check audits
+DAEMON_PACKAGES = ("obs", "cluster", "serve")
+
+_ENV_KNOB_RE = re.compile(r"^PENCILARRAYS_TPU_[A-Z0-9]+(?:_[A-Z0-9]+)*$")
+
+_MUTATING_METHODS = frozenset({
+    "append", "add", "setdefault", "pop", "update", "clear", "extend",
+    "remove", "discard", "popitem", "insert", "appendleft",
+})
+
+CHECKS = ("journal-event", "env-knob", "plan-cache", "fault-point",
+          "unlocked-state")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One invariant violation.  ``ident`` is the stable identifier an
+    allowlist entry names (never a line number — entries must survive
+    unrelated edits)."""
+
+    check: str
+    path: str          # repo-relative
+    line: int
+    ident: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.check} {self.ident}"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+
+@dataclass
+class Allowlist:
+    """The committed escape hatch: ``check-id identifier`` lines, each
+    REQUIRING a ``# justification`` comment (an unjustified entry is
+    itself a finding — the list documents debt, it does not hide it).
+    ``#``-only and blank lines are comments."""
+
+    path: Optional[str] = None
+    entries: Dict[str, str] = field(default_factory=dict)  # key -> why
+    bad_lines: List[Tuple[int, str]] = field(default_factory=list)
+    _hits: Set[str] = field(default_factory=set)
+
+    @classmethod
+    def load(cls, path: str) -> "Allowlist":
+        al = cls(path=path)
+        if not os.path.exists(path):
+            return al
+        with open(path, encoding="utf-8") as f:
+            for n, raw in enumerate(f, 1):
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                body, _, why = line.partition("#")
+                parts = body.split()
+                if len(parts) != 2 or parts[0] not in CHECKS:
+                    al.bad_lines.append((n, raw.rstrip()))
+                    continue
+                if not why.strip():
+                    al.bad_lines.append((n, raw.rstrip()))
+                    continue
+                al.entries[f"{parts[0]} {parts[1]}"] = why.strip()
+        return al
+
+    def allows(self, finding: Finding) -> bool:
+        if finding.key in self.entries:
+            self._hits.add(finding.key)
+            return True
+        return False
+
+    def unused(self) -> List[str]:
+        """Entries that suppressed nothing — stale debt to delete."""
+        return sorted(set(self.entries) - self._hits)
+
+
+# ---------------------------------------------------------------------------
+# source loading
+# ---------------------------------------------------------------------------
+
+
+def _iter_py_files(pkg_root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _parse(path: str) -> Optional[ast.Module]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+
+
+def _docs_corpus(root: str) -> str:
+    """README.md + every docs/**/*.md, concatenated — the text the
+    env-knob and fault-point checks search."""
+    chunks = []
+    readme = os.path.join(root, "README.md")
+    if os.path.exists(readme):
+        with open(readme, encoding="utf-8") as f:
+            chunks.append(f.read())
+    docs = os.path.join(root, "docs")
+    for dirpath, _dirnames, filenames in os.walk(docs):
+        for fn in sorted(filenames):
+            if fn.endswith(".md"):
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8") as f:
+                    chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def _rel(root: str, path: str) -> str:
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def _module_dotted(root: str, path: str) -> str:
+    """``/root/repo/pencilarrays_tpu/ops/fft.py`` -> ``ops.fft``
+    (relative to the package)."""
+    rel = _rel(root, path)
+    parts = rel.split("/")
+    if parts and parts[0] == PACKAGE:
+        parts = parts[1:]
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# registry extraction (AST reads of the source-of-truth modules)
+# ---------------------------------------------------------------------------
+
+
+def _dict_str_keys(node: ast.AST) -> Set[str]:
+    keys: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Dict):
+            for k in n.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+    return keys
+
+
+def registered_events(root: str) -> Set[str]:
+    """``EVENT_TYPES`` keys, parsed from ``obs/schema.py``."""
+    tree = _parse(os.path.join(root, PACKAGE, "obs", "schema.py"))
+    if tree is None:
+        return set()
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name):
+            targets = [node.target.id]
+        if "EVENT_TYPES" in targets and node.value is not None:
+            return _dict_str_keys(node.value)
+    return set()
+
+
+def registered_points(root: str) -> Set[str]:
+    """``POINTS`` entries, parsed from ``resilience/faults.py``."""
+    tree = _parse(os.path.join(root, PACKAGE, "resilience", "faults.py"))
+    if tree is None:
+        return set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "POINTS"
+                for t in node.targets):
+            return {n.value for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)}
+    return set()
+
+
+def registered_plan_caches(root: str) -> Set[Tuple[str, str]]:
+    """``(dotted_module, factory_name)`` pairs registered with
+    ``clear_plan_caches()`` — parsed from ``cluster/elastic.py``: the
+    function-local ``from .. import X as _alias`` imports map aliases
+    to modules, and the ``for mod, names in ((alias, (names...)), ...)``
+    tuple literal lists the registered factory names."""
+    path = os.path.join(root, PACKAGE, "cluster", "elastic.py")
+    tree = _parse(path)
+    if tree is None:
+        return set()
+    fn = next((n for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)
+               and n.name == "clear_plan_caches"), None)
+    if fn is None:
+        return set()
+    # alias -> dotted module relative to the package.  elastic.py lives
+    # one package level down, so a level-2 relative import resolves to
+    # the package root.
+    aliases: Dict[str, str] = {}
+    for n in ast.walk(fn):
+        if isinstance(n, ast.ImportFrom):
+            base = n.module or ""
+            for a in n.names:
+                dotted = f"{base}.{a.name}" if base else a.name
+                aliases[a.asname or a.name] = dotted
+    out: Set[Tuple[str, str]] = set()
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Tuple):
+            continue
+        # looking for 2-tuples (alias_name, ("name", ...))
+        if len(n.elts) != 2 or not isinstance(n.elts[0], ast.Name):
+            continue
+        mod = aliases.get(n.elts[0].id)
+        if mod is None:
+            continue
+        for c in ast.walk(n.elts[1]):
+            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                out.add((mod, c.value))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-check scanners
+# ---------------------------------------------------------------------------
+
+
+def _is_record_event_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in ("record_event", "_record_event")
+    if isinstance(f, ast.Attribute):
+        return f.attr == "record_event"
+    return False
+
+
+def _check_journal_events(root: str, trees: Dict[str, ast.Module],
+                          findings: List[Finding]) -> None:
+    events = registered_events(root)
+    if not events:
+        findings.append(Finding(
+            "journal-event", f"{PACKAGE}/obs/schema.py", 1,
+            "EVENT_TYPES",
+            "could not parse EVENT_TYPES from obs/schema.py"))
+        return
+    for path, tree in trees.items():
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_record_event_call(node) and node.args):
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue  # dynamic name: runtime schema lint owns it
+            if arg.value not in events:
+                findings.append(Finding(
+                    "journal-event", _rel(root, path), node.lineno,
+                    arg.value,
+                    f"record_event({arg.value!r}, ...) is not "
+                    f"registered in obs/schema.py EVENT_TYPES"))
+
+
+def _check_env_knobs(root: str, trees: Dict[str, ast.Module],
+                     docs: str, findings: List[Finding]) -> None:
+    seen: Dict[str, Tuple[str, int]] = {}
+    for path, tree in trees.items():
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _ENV_KNOB_RE.match(node.value)):
+                seen.setdefault(node.value, (path, node.lineno))
+    for knob in sorted(seen):
+        path, line = seen[knob]
+        if knob not in docs:
+            findings.append(Finding(
+                "env-knob", _rel(root, path), line, knob,
+                f"env knob {knob} is read in code but documented "
+                f"nowhere under docs/ or README.md"))
+
+
+def _has_lru_cache(fn: ast.FunctionDef) -> bool:
+    for d in fn.decorator_list:
+        target = d.func if isinstance(d, ast.Call) else d
+        if isinstance(target, ast.Name) and target.id == "lru_cache":
+            return True
+        if isinstance(target, ast.Attribute) and target.attr == "lru_cache":
+            return True
+    return False
+
+
+def _builds_jit(fn: ast.FunctionDef) -> bool:
+    """Does the function body construct a jitted executable?"""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr == "jit" and \
+                    isinstance(f.value, ast.Name) and f.value.id == "jax":
+                return True
+    return False
+
+
+def _check_plan_caches(root: str, trees: Dict[str, ast.Module],
+                       findings: List[Finding]) -> None:
+    registered = registered_plan_caches(root)
+    if not registered:
+        findings.append(Finding(
+            "plan-cache", f"{PACKAGE}/cluster/elastic.py", 1,
+            "clear_plan_caches",
+            "could not parse the clear_plan_caches registration table "
+            "from cluster/elastic.py"))
+        return
+    reg_names = {(m, n) for m, n in registered}
+    for path, tree in trees.items():
+        dotted = _module_dotted(root, path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.FunctionDef)
+                    and _has_lru_cache(node) and _builds_jit(node)):
+                continue
+            ident = f"{dotted}.{node.name}"
+            if (dotted, node.name) not in reg_names:
+                findings.append(Finding(
+                    "plan-cache", _rel(root, path), node.lineno, ident,
+                    f"lru_cache'd executable factory {ident} is not "
+                    f"registered with elastic.clear_plan_caches() — a "
+                    f"reformation would redispatch its stale "
+                    f"executables"))
+
+
+def _check_fault_points(root: str, trees: Dict[str, ast.Module],
+                        docs_resilience: str,
+                        findings: List[Finding]) -> None:
+    points = registered_points(root)
+    if not points:
+        findings.append(Finding(
+            "fault-point", f"{PACKAGE}/resilience/faults.py", 1,
+            "POINTS",
+            "could not parse POINTS from resilience/faults.py"))
+        return
+    # literals consulted at call sites (faults.fire / faults.armed)
+    consulted: Dict[str, Tuple[str, int]] = {}
+    for path, tree in trees.items():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in ("fire", "armed")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "faults"):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                consulted.setdefault(arg.value, (path, node.lineno))
+    for pt, (path, line) in sorted(consulted.items()):
+        if pt not in points:
+            findings.append(Finding(
+                "fault-point", _rel(root, path), line, pt,
+                f"faults call site consults unregistered injection "
+                f"point {pt!r} (register it in faults.POINTS)"))
+    for pt in sorted(points):
+        if f"`{pt}`" not in docs_resilience:
+            where = consulted.get(pt)
+            findings.append(Finding(
+                "fault-point",
+                _rel(root, where[0]) if where
+                else f"{PACKAGE}/resilience/faults.py",
+                where[1] if where else 1, pt,
+                f"injection point {pt!r} is missing from the "
+                f"docs/Resilience.md point table"))
+
+
+def _module_has_lock(tree: ast.Module) -> bool:
+    """A module-level ``<name> = threading.Lock()/RLock()`` (or bare
+    ``Lock()``) assignment."""
+    for node in tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        f = value.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name in ("Lock", "RLock"):
+            return True
+    return False
+
+
+def _is_mutable_value(value: ast.AST) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        f = value.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        return name in ("dict", "list", "set", "defaultdict", "deque",
+                        "OrderedDict", "Counter")
+    return False
+
+
+def _mutated_names(tree: ast.Module) -> Set[str]:
+    """Names that are mutated (method call, subscript store/delete, or
+    ``global`` rebinding) anywhere in the module."""
+    out: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and isinstance(n.func.value, ast.Name) \
+                and n.func.attr in _MUTATING_METHODS:
+            out.add(n.func.value.id)
+        elif isinstance(n, (ast.Assign, ast.AugAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and isinstance(
+                        t.value, ast.Name):
+                    out.add(t.value.id)
+        elif isinstance(n, ast.Delete):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript) and isinstance(
+                        t.value, ast.Name):
+                    out.add(t.value.id)
+        elif isinstance(n, ast.Global):
+            out.update(n.names)
+    return out
+
+
+def _check_unlocked_state(root: str, trees: Dict[str, ast.Module],
+                          findings: List[Finding]) -> None:
+    prefixes = tuple(os.path.join(root, PACKAGE, p) + os.sep
+                     for p in DAEMON_PACKAGES)
+    for path, tree in trees.items():
+        if not path.startswith(prefixes):
+            continue
+        has_lock = _module_has_lock(tree)
+        if has_lock:
+            continue
+        mutated = _mutated_names(tree)
+        dotted = _module_dotted(root, path)
+        for node in tree.body:
+            targets: List[ast.Name] = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets
+                           if isinstance(t, ast.Name)]
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name) and node.value is not None:
+                targets = [node.target]
+            if not targets or node.value is None:
+                continue
+            if not _is_mutable_value(node.value):
+                continue
+            for t in targets:
+                if t.id.startswith("__") or t.id not in mutated:
+                    continue  # read-only table, or never mutated
+                ident = f"{dotted}.{t.id}"
+                findings.append(Finding(
+                    "unlocked-state", _rel(root, path), node.lineno,
+                    ident,
+                    f"module-level mutable state {ident} is mutated in "
+                    f"a daemon-bearing package but the module defines "
+                    f"no module-level lock"))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def lint_tree(root: str) -> List[Finding]:
+    """Run every check over the package at ``root`` (the repo root
+    containing ``pencilarrays_tpu/``).  Returns raw findings — the
+    caller applies the allowlist."""
+    pkg_root = os.path.join(root, PACKAGE)
+    trees: Dict[str, ast.Module] = {}
+    for path in _iter_py_files(pkg_root):
+        tree = _parse(path)
+        if tree is not None:
+            trees[path] = tree
+    docs = _docs_corpus(root)
+    resilience_path = os.path.join(root, "docs", "Resilience.md")
+    docs_resilience = ""
+    if os.path.exists(resilience_path):
+        with open(resilience_path, encoding="utf-8") as f:
+            docs_resilience = f.read()
+    findings: List[Finding] = []
+    _check_journal_events(root, trees, findings)
+    _check_env_knobs(root, trees, docs, findings)
+    _check_plan_caches(root, trees, findings)
+    _check_fault_points(root, trees, docs_resilience, findings)
+    _check_unlocked_state(root, trees, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.check, f.ident))
+    return findings
+
+
+def run_lint(root: str, allowlist: Optional[Allowlist] = None
+             ) -> Tuple[List[Finding], Allowlist]:
+    """Lint + allowlist filtering: returns ``(reportable findings,
+    the loaded allowlist)``.  Malformed or unjustified allowlist lines
+    are themselves findings (the list must stay honest)."""
+    if allowlist is None:
+        allowlist = Allowlist.load(os.path.join(root, DEFAULT_ALLOWLIST))
+    findings = [f for f in lint_tree(root) if not allowlist.allows(f)]
+    for n, raw in allowlist.bad_lines:
+        # "allowlist" is deliberately NOT in CHECKS: a malformed or
+        # unjustified entry cannot be allowlisted away
+        findings.append(Finding(
+            "allowlist",
+            _rel(root, allowlist.path or DEFAULT_ALLOWLIST), n,
+            f"line:{n}",
+            f"malformed or unjustified allowlist line: {raw!r} "
+            f"(format: '<check-id> <identifier>  # justification')"))
+    return findings, allowlist

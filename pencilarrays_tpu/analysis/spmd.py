@@ -1,0 +1,494 @@
+"""Static SPMD program verifier — prove the compiled collective schedule.
+
+The library's correctness on a mesh hinges on every rank compiling the
+*same ordered sequence of collectives* (the global-transpose schedule;
+PAPER.md L2-L4).  Until now that property was only checked dynamically:
+per-test HLO pins, runtime guard probes, the hang watchdog catching a
+divergence after it deadlocks.  This module checks it *statically*, the
+way AccFFT (arXiv:1506.07933) reasons about exchange schedules
+analytically: extract a typed :class:`CollectiveTrace` from any
+compiled program — a :class:`~pencilarrays_tpu.ops.fft.CompiledPlan`,
+a routed reshard chain, a raw transpose executable — and compare it
+op-for-op against the plan's ``collective_costs`` prediction, a sibling
+configuration that must agree, or a static HBM bound.
+
+The extractor is the ONE shared analyzer the test suite's former
+ad-hoc HLO-pin helpers (``test_routing`` / ``test_collective_costs`` /
+``test_throughput`` / ``test_serve``) now call, and the substrate the
+async task-graph executor (ROADMAP, DaggerFFT 2601.12209) will verify
+its reordered dispatch queue against: "collective order guaranteed by
+construction" becomes a provable property, pre-flight
+(:meth:`~pencilarrays_tpu.serve.service.PlanService.certify`), not an
+empirical one.
+
+Byte accounting is identical to :mod:`pencilarrays_tpu.utils.hlo`
+(``CollectiveTrace.stats()`` reproduces ``collective_stats`` exactly —
+same regexes, same per-application result-shape pricing), so every
+existing ``prediction == compiled HLO`` pin carries over unchanged.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+from ..utils.hlo import COLLECTIVE_OPS, _APP_RE, shape_bytes
+from .errors import (
+    DonationError,
+    HbmBoundError,
+    ScheduleMismatchError,
+    TraceDivergenceError,
+)
+
+__all__ = [
+    "CollectiveOp",
+    "CollectiveTrace",
+    "EXCHANGE_KINDS",
+    "trace_hlo",
+    "trace_fn",
+    "trace_transpose",
+    "trace_plan",
+    "trace_compiled_plan",
+    "trace_route",
+    "verify_plan",
+    "verify_route",
+    "verify_consistent",
+    "verify_hbm",
+    "verify_donation",
+    "certify_plan",
+    "predicted_peak_hbm",
+]
+
+# The data-movement collectives a transpose schedule owns.  Guard
+# probes (content sums inside the guarded program) legitimately add
+# ``all-reduce`` ops, so consistency checks between guard-on and
+# guard-off programs compare this subset.
+EXCHANGE_KINDS: Tuple[str, ...] = (
+    "all-to-all", "collective-permute", "all-gather", "reduce-scatter")
+
+# parameter indices inside ``input_output_alias={ {}: (0, {}, ...) }``
+_ALIAS_BLOCK_RE = re.compile(r"input_output_alias=\{(.*?)\}\s*,\s*\w+=",
+                             re.DOTALL)
+_ALIAS_PARAM_RE = re.compile(r"\(\s*(\d+)\s*,")
+# group structure of one application: all-to-all/all-gather/... carry
+# replica_groups, collective-permute carries source_target_pairs —
+# either one is THE op's participation spec
+_REPLICA_RE = re.compile(
+    r"(?:replica_groups|source_target_pairs)="
+    r"(\{\{[^}]*(?:\},\{[^}]*)*\}\})")
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    """One collective *application* in program order.
+
+    ``bytes`` prices the application's result shape per chip (the
+    ``utils.hlo`` accounting — partitioned-HLO shapes are per-shard;
+    async ``-start`` tuples include the operand alias, so async bytes
+    are an upper bound while counts stay exact)."""
+
+    index: int                      # position among the collectives
+    kind: str                       # "all-to-all" | "all-gather" | ...
+    bytes: int                      # per-chip result bytes
+    shape: str                      # raw HLO result shape string
+    replica_groups: Optional[str]   # raw {{...}} text (None if absent)
+    async_start: bool               # the `-start` half of an async pair
+
+    @property
+    def label(self) -> str:
+        return f"[{self.index}] {self.kind} {self.shape}"
+
+
+@dataclass(frozen=True)
+class CollectiveTrace:
+    """The ordered collective schedule of ONE compiled program, plus
+    its donation facts — everything the static checks consume."""
+
+    source: str                     # human label ("plan fwd", "route", ...)
+    ops: Tuple[CollectiveOp, ...]
+    donated_params: Tuple[int, ...]  # entry params aliased to outputs
+
+    def stats(self, kinds: Optional[Sequence[str]] = None) -> dict:
+        """Aggregate ``{op: {"count", "bytes"}}`` — byte-for-byte the
+        ``utils.hlo.collective_stats`` schema, optionally restricted to
+        ``kinds`` (e.g. :data:`EXCHANGE_KINDS`)."""
+        out: dict = {}
+        for op in self.ops:
+            if kinds is not None and op.kind not in kinds:
+                continue
+            e = out.setdefault(op.kind, {"count": 0, "bytes": 0})
+            e["count"] += 1
+            e["bytes"] += op.bytes
+        return out
+
+    def counts(self, kinds: Optional[Sequence[str]] = None
+               ) -> Dict[str, int]:
+        return {k: v["count"] for k, v in self.stats(kinds).items()}
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(op.bytes for op in self.ops)
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+
+# ---------------------------------------------------------------------------
+# extractors
+# ---------------------------------------------------------------------------
+
+
+def trace_hlo(hlo: str, source: str = "hlo") -> CollectiveTrace:
+    """Extract the ordered collective trace from compiled HLO text —
+    the core extractor every other ``trace_*`` entry point funnels
+    through.  Counts each collective application once (async ``-start``
+    forms count, their ``-done`` halves do not), prices its result
+    shape in per-chip bytes, and records the entry computation's
+    donated (input/output-aliased) parameter indices."""
+    ops = []
+    for i, m in enumerate(_APP_RE.finditer(hlo)):
+        line_start = hlo.rfind("\n", 0, m.start()) + 1
+        line_end = hlo.find("\n", m.end())
+        line = hlo[line_start: line_end if line_end != -1 else len(hlo)]
+        rg = _REPLICA_RE.search(line)
+        ops.append(CollectiveOp(
+            index=i, kind=m.group("op"),
+            bytes=shape_bytes(m.group("shape")),
+            shape=m.group("shape").strip(),
+            replica_groups=rg.group(1) if rg else None,
+            async_start=hlo[m.end("op"): m.end("op") + 6] == "-start"))
+    donated: Tuple[int, ...] = ()
+    am = _ALIAS_BLOCK_RE.search(hlo)
+    if am:
+        donated = tuple(sorted({int(p) for p in
+                                _ALIAS_PARAM_RE.findall(am.group(1))}))
+    return CollectiveTrace(source=source, ops=tuple(ops),
+                           donated_params=donated)
+
+
+def trace_fn(fn, *args, source: str = "fn", donate_argnums=()
+             ) -> CollectiveTrace:
+    """Trace a callable: jit, lower on ``args`` (arrays or
+    ``ShapeDtypeStruct`` avals — lowering never executes), compile,
+    and extract.  ``fn`` may already be jitted (then it is lowered
+    as-is and ``donate_argnums`` must be ())."""
+    import jax
+
+    if hasattr(fn, "lower"):
+        lowered = fn.lower(*args)
+    else:
+        lowered = jax.jit(fn, donate_argnums=donate_argnums).lower(*args)
+    return trace_hlo(lowered.compile().as_text(), source=source)
+
+
+def _input_aval(pencil, extra_dims: Tuple[int, ...], dtype):
+    """Zero-allocation lowering aval for a pencil-sharded operand."""
+    import jax
+
+    from ..parallel.pencil import MemoryOrder
+
+    shape = pencil.padded_size_global(MemoryOrder) + tuple(extra_dims)
+    return jax.ShapeDtypeStruct(shape, dtype,
+                                sharding=pencil.sharding(len(extra_dims)))
+
+
+def trace_transpose(pin, pout, extra_dims: Tuple[int, ...] = (),
+                    dtype=None, method=None, *, donate: bool = False
+                    ) -> CollectiveTrace:
+    """Trace one compiled transpose hop ``pin -> pout`` — the shared
+    extractor behind the former per-test ``_measured`` helpers
+    (``tests/test_collective_costs.py`` et al.):
+    ``trace_transpose(...).stats()`` is pin-compatible with
+    ``transpose_cost(...)``."""
+    import numpy as np
+
+    from ..parallel.arrays import PencilArray
+    from ..parallel.transpositions import transpose
+
+    dt = np.dtype(dtype if dtype is not None else np.float32)
+
+    def hop(d):
+        return transpose(PencilArray(pin, d, tuple(extra_dims)), pout,
+                         method=method).data
+
+    return trace_fn(hop, _input_aval(pin, tuple(extra_dims), dt),
+                    source=f"transpose {pin.decomposition}->"
+                           f"{pout.decomposition}",
+                    donate_argnums=(0,) if donate else ())
+
+
+def trace_plan(plan, extra_dims: Optional[Tuple[int, ...]] = None,
+               direction: str = "forward", *, donate: bool = False
+               ) -> CollectiveTrace:
+    """Trace a :class:`~pencilarrays_tpu.ops.fft.PencilFFTPlan`'s full
+    compiled chain in ``direction`` (``extra_dims`` defaults to the
+    plan's ``batch_dims``, like every plan method)."""
+    from ..parallel.arrays import PencilArray
+
+    if direction not in ("forward", "backward"):
+        raise ValueError(f"direction must be 'forward' or 'backward', "
+                         f"got {direction!r}")
+    if extra_dims is None:
+        extra_dims = plan.batch_dims
+    extra = tuple(int(e) for e in extra_dims)
+    fwd = direction == "forward"
+    pen = plan.input_pencil if fwd else plan.output_pencil
+    dt = plan.dtype_physical if fwd else plan.dtype_spectral
+    run = plan.forward if fwd else plan.backward
+
+    def chain(d):
+        return run(PencilArray(pen, d, extra)).data
+
+    return trace_fn(chain, _input_aval(pen, extra, dt),
+                    source=f"plan.{direction} extra={extra}",
+                    donate_argnums=(0,) if donate else ())
+
+
+def trace_compiled_plan(cp, direction: str = "forward"
+                        ) -> CollectiveTrace:
+    """Trace a resident :class:`~pencilarrays_tpu.ops.fft.CompiledPlan`
+    executable — the registry-sweep entry point: the trace comes from
+    the SAME jitted callable the plan dispatches (``cp._fwd``/
+    ``cp._bwd``), so certification covers the executable that will
+    actually run, not a re-trace."""
+    if direction not in ("forward", "backward"):
+        raise ValueError(f"direction must be 'forward' or 'backward', "
+                         f"got {direction!r}")
+    fwd = direction == "forward"
+    plan = cp.plan
+    pen = plan.input_pencil if fwd else plan.output_pencil
+    dt = plan.dtype_physical if fwd else plan.dtype_spectral
+    fn = cp._fwd if fwd else cp._bwd
+    return trace_fn(fn, _input_aval(pen, cp.extra_dims, dt),
+                    source=f"compiled.{direction} "
+                           f"extra={cp.extra_dims}")
+
+
+def trace_route(route, extra_dims: Tuple[int, ...] = (), dtype=None, *,
+                donate: bool = False) -> CollectiveTrace:
+    """Trace a planned reshard route's fused chain (the exact
+    ``_compiled_route`` executable ``execute_route`` dispatches)."""
+    import numpy as np
+
+    from ..ops.pallas_kernels import pallas_enabled
+    from ..parallel import routing as _routing
+
+    if not route.hops:
+        raise ValueError("route has no hops (planner fell back to Gspmd)")
+    dt = np.dtype(dtype if dtype is not None else np.float32)
+    extra = tuple(int(e) for e in extra_dims)
+    fn = _routing._compiled_route(
+        route.pencils, tuple(h.method for h in route.hops), len(extra),
+        donate, pallas_enabled())
+    return trace_fn(fn, _input_aval(route.src, extra, dt),
+                    source=f"route {route.src.decomposition}->"
+                           f"{route.dest.decomposition}")
+
+
+# ---------------------------------------------------------------------------
+# checks
+# ---------------------------------------------------------------------------
+
+
+def _check_stats(source: str, predicted: dict, observed: dict) -> None:
+    """Op-for-op comparison; raises :class:`ScheduleMismatchError`
+    naming the first diverging collective kind."""
+    for op in COLLECTIVE_OPS:
+        p, o = predicted.get(op), observed.get(op)
+        if p != o:
+            raise ScheduleMismatchError(source, op, p, o)
+    # non-standard kinds can only come from the prediction side
+    for op in sorted(set(predicted) | set(observed)):
+        if predicted.get(op) != observed.get(op):
+            raise ScheduleMismatchError(source, op, predicted.get(op),
+                                        observed.get(op))
+
+
+def verify_plan(plan, extra_dims: Optional[Tuple[int, ...]] = None,
+                direction: str = "forward",
+                trace: Optional[CollectiveTrace] = None
+                ) -> CollectiveTrace:
+    """Check (a): the compiled program's trace matches the plan's
+    ``collective_costs`` prediction op-for-op (count AND bytes).
+    Returns the verified trace; raises
+    :class:`~pencilarrays_tpu.analysis.errors.ScheduleMismatchError`
+    naming the offending op.  Pass ``trace`` to verify an
+    already-extracted program (e.g. a ``trace_compiled_plan`` of the
+    resident executable)."""
+    if extra_dims is None:
+        extra_dims = plan.batch_dims
+    extra = tuple(int(e) for e in extra_dims)
+    if trace is None:
+        trace = trace_plan(plan, extra, direction)
+    predicted = plan.collective_costs(extra)
+    _check_stats(trace.source, predicted, trace.stats())
+    return trace
+
+
+def verify_route(route, extra_dims: Tuple[int, ...] = (), dtype=None,
+                 trace: Optional[CollectiveTrace] = None
+                 ) -> CollectiveTrace:
+    """Check (a) for a routed reshard: the fused chain's compiled trace
+    equals the sum of the planner's per-hop priced costs."""
+    if trace is None:
+        trace = trace_route(route, extra_dims, dtype)
+    predicted: dict = {}
+    for h in route.hops:
+        for op, c in h.cost.items():
+            e = predicted.setdefault(op, {"count": 0, "bytes": 0})
+            e["count"] += c["count"]
+            e["bytes"] += c["bytes"]
+    _check_stats(trace.source, predicted, trace.stats())
+    return trace
+
+
+def verify_consistent(a: CollectiveTrace, b: CollectiveTrace, *,
+                      kinds: Optional[Sequence[str]] = EXCHANGE_KINDS,
+                      bytes_ratio: Optional[float] = 1.0) -> None:
+    """Check (b): two programs that must agree compile to consistent
+    traces — per-kind collective COUNTS equal, and per-kind bytes of
+    ``b`` equal to ``bytes_ratio x a`` (``None`` skips the byte check;
+    ``B`` proves batched-vs-unbatched amortization: count x1, bytes
+    xB).  ``kinds`` restricts the comparison (default
+    :data:`EXCHANGE_KINDS`, so guard probes' ``all-reduce`` additions
+    do not fail a guard-on-vs-off check).  Raises
+    :class:`TraceDivergenceError` naming the first diverging op."""
+    sa, sb = a.stats(kinds), b.stats(kinds)
+    for op in sorted(set(sa) | set(sb)):
+        ca = sa.get(op, {}).get("count")
+        cb = sb.get(op, {}).get("count")
+        if ca != cb:
+            raise TraceDivergenceError(a.source, b.source, op, "count",
+                                       ca, cb)
+        if bytes_ratio is not None:
+            ba = sa.get(op, {}).get("bytes", 0)
+            bb = sb.get(op, {}).get("bytes", 0)
+            if int(round(ba * bytes_ratio)) != bb:
+                raise TraceDivergenceError(
+                    a.source, b.source, op,
+                    f"bytes (expected x{bytes_ratio:g})", ba, bb)
+
+
+def predicted_peak_hbm(plan_or_route,
+                       extra_dims: Optional[Tuple[int, ...]] = None,
+                       dtype=None) -> Tuple[int, str]:
+    """Static per-chip peak-HBM prediction of a plan's or route's worst
+    exchange: ``(peak_bytes, hop_label)``.  The same operand+result
+    accounting the route planner's ``hbm_limit`` pruning uses
+    (``routing._hop_peak_bytes``), applied to every hop of the
+    schedule."""
+    import numpy as np
+
+    from ..parallel.routing import _hop_peak_bytes
+    from ..parallel.transpositions import assert_compatible
+
+    peak, label = 0, "<empty>"
+    if hasattr(plan_or_route, "hops"):          # ReshardRoute
+        route = plan_or_route
+        extra = tuple(int(e) for e in (extra_dims or ()))
+        dt = np.dtype(dtype if dtype is not None else np.float32)
+        for k, h in enumerate(route.hops):
+            R = assert_compatible(h.src, h.dest)
+            p = _hop_peak_bytes(h.src, h.dest, R, extra, dt.itemsize)
+            if p > peak:
+                peak, label = p, f"route[{k}] {h.src.decomposition}->" \
+                                 f"{h.dest.decomposition}"
+        return peak, label
+    plan = plan_or_route
+    from ..ops.fft import _iter_priced_hops
+
+    if extra_dims is None:
+        extra_dims = plan.batch_dims
+    extra = tuple(int(e) for e in extra_dims)
+    for k, (src, dst, hop_dtype, _base, _k_mult) in enumerate(
+            _iter_priced_hops(plan._steps)):
+        R = assert_compatible(src, dst)
+        p = _hop_peak_bytes(src, dst, R, extra,
+                            np.dtype(hop_dtype).itemsize)
+        if p > peak:
+            peak, label = p, f"hop[{k}] {src.decomposition}->" \
+                             f"{dst.decomposition}"
+    return peak, label
+
+
+def verify_hbm(plan_or_route, hbm_limit: int,
+               extra_dims: Optional[Tuple[int, ...]] = None,
+               dtype=None, *, source: str = "program") -> int:
+    """Check (c): the program's static peak-HBM prediction is within
+    ``hbm_limit`` bytes per chip.  Returns the predicted peak; raises
+    :class:`HbmBoundError` naming the offending hop."""
+    peak, label = predicted_peak_hbm(plan_or_route, extra_dims, dtype)
+    if peak > int(hbm_limit):
+        raise HbmBoundError(source, label, peak, int(hbm_limit))
+    return peak
+
+
+def verify_donation(trace: CollectiveTrace, *,
+                    expected_params: Sequence[int] = (0,)) -> None:
+    """Check (c), donation half: a program priced with buffer donation
+    must carry the input/output alias for ``expected_params`` — the
+    compiler fact that the router's ``donate=`` pricing assumed the
+    operand buffer is elided.  Raises :class:`DonationError`."""
+    missing = [p for p in expected_params
+               if p not in trace.donated_params]
+    if missing:
+        raise DonationError(
+            trace.source,
+            f"parameter(s) {missing} not input/output-aliased "
+            f"(donated_params={list(trace.donated_params)}): donation "
+            f"did not elide the buffer the pricing assumed")
+
+
+# ---------------------------------------------------------------------------
+# certification (the pre-flight sweep unit)
+# ---------------------------------------------------------------------------
+
+
+def certify_plan(plan, extra_dims: Optional[Tuple[int, ...]] = None, *,
+                 compiled=None, hbm_limit: Optional[int] = None,
+                 target: str = "plan", _journal: bool = True) -> dict:
+    """Certify ONE plan: forward AND backward compiled traces match the
+    ``collective_costs`` prediction (on the resident executable when
+    ``compiled`` is passed), optionally bounded by ``hbm_limit``.
+    Journals one ``analysis.check`` event (outcome ``ok`` or the typed
+    error's class name — non-ok is fsync-critical) and returns the
+    check record; raises the typed error after journaling."""
+    from .. import obs
+
+    if extra_dims is None:
+        extra_dims = plan.batch_dims
+    extra = tuple(int(e) for e in extra_dims)
+    t0 = time.perf_counter()
+    record = {"target": target, "extra_dims": list(extra),
+              "plan_fp": plan.plan_key()}
+    try:
+        traces = {}
+        for direction in ("forward", "backward"):
+            if compiled is not None:
+                tr = trace_compiled_plan(compiled, direction)
+            else:
+                tr = trace_plan(plan, extra, direction)
+            traces[direction] = verify_plan(plan, extra, direction,
+                                            trace=tr)
+        if hbm_limit is not None:
+            record["peak_hbm_bytes"] = verify_hbm(
+                plan, hbm_limit, extra, source=target)
+        record.update(
+            outcome="ok",
+            ops=len(traces["forward"]),
+            predicted_bytes=traces["forward"].total_bytes,
+            seconds=time.perf_counter() - t0)
+        if _journal and obs.enabled():
+            obs.record_event("analysis.check", **record)
+            obs.counter("analysis.checks", outcome="ok").inc()
+        return record
+    except Exception as e:
+        record.update(outcome=type(e).__name__, error=str(e),
+                      seconds=time.perf_counter() - t0)
+        if _journal and obs.enabled():
+            obs.record_event("analysis.check", _fsync=True, **record)
+            obs.counter("analysis.checks",
+                        outcome=type(e).__name__).inc()
+        raise

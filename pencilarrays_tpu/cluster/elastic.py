@@ -254,11 +254,22 @@ def clear_plan_caches() -> int:
     """Drop every compiled hop/route/FFT-stage executable cache (they
     are keyed by pencils whose topology died with the old mesh) and
     return how many cached executables were discarded.  Safe to call
-    any time — the caches refill on demand."""
+    any time — the caches refill on demand.
+
+    This registration table is the source of truth ``pa-lint``'s
+    ``plan-cache`` check parses (``analysis/lint.py``): every
+    ``lru_cache``'d factory that builds a ``jax.jit`` executable must
+    be listed here, so the set can never silently drift from the code
+    again (it was hand-maintained before).  The guard/serve entries are
+    shape-keyed jit *wrappers* rather than pencil-keyed executables —
+    retracing makes them mesh-safe — but clearing them is free and
+    keeps the invariant uniform: cached jit = registered here."""
     cleared = 0
+    from ..guard import integrity as _gi
     from ..ops import fft as _fft
     from ..parallel import routing as _routing
     from ..parallel import transpositions as _tr
+    from ..serve import service as _serve
 
     for mod, names in (
             (_tr, ("_compiled_transpose", "_compiled_guarded_transpose",
@@ -266,7 +277,9 @@ def clear_plan_caches() -> int:
                    "_measured_choice", "_gspmd_collective_cost")),
             (_routing, ("_plan_cached", "_compiled_route",
                         "_compiled_guarded_route")),
-            (_fft, ("_stage_fn", "_fused_hop_fn"))):
+            (_fft, ("_stage_fn", "_fused_hop_fn")),
+            (_gi, ("_corrupt_jit", "_nonfinite_jit")),
+            (_serve, ("_split_fn",))):
         for name in names:
             fn = getattr(mod, name, None)
             if fn is None or not hasattr(fn, "cache_clear"):

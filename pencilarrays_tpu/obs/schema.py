@@ -89,6 +89,11 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     "serve.coalesce": ("key", "n", "reqs", "reason", "wait_s"),
     "serve.dispatch": ("key", "n", "tenants", "score_bytes", "reason"),
     "serve.complete": ("tenant", "req", "outcome", "seconds", "key"),
+    # static analysis (analysis/): one record per certification —
+    # ``PlanService.certify()`` registry sweeps, pa-lint SPMD runs and
+    # direct ``certify_plan`` calls; non-ok outcomes are fsync-critical
+    # via record_event's per-record override
+    "analysis.check": ("target", "outcome", "seconds"),
     # profiling / drift
     "profile": ("dir", "status"),
     "drift.sample": ("hop", "predicted_bytes", "measured_s", "source"),

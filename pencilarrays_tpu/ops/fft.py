@@ -1138,7 +1138,10 @@ class PencilFFTPlan:
         the per-sample price.  :meth:`backward` costs the same (the hop
         shapes are symmetric).  Tests and the multichip dryrun pin this
         EQUAL to the compiled HLO's measured stats — the validated ICI
-        byte model."""
+        byte model.  ``analysis.spmd.verify_plan`` proves the equality
+        statically for any program (typed ``ScheduleMismatchError``
+        naming the diverging op), and ``PlanService.certify()`` sweeps
+        it over every resident executable pre-flight."""
         from ..parallel.transpositions import transpose_cost
 
         if extra_dims is None:

@@ -354,6 +354,12 @@ def plan_reshard_route(pin: Pencil, dest: Pencil,
     Auto plans with the estimate rule — planning must stay cheap and
     deterministic).  ``hbm_limit`` bounds each hop's per-chip
     operand+result bytes; routes needing more are pruned.
+
+    ``analysis.spmd.verify_route`` statically proves a planned route's
+    fused executable compiles to EXACTLY the per-hop priced
+    collectives, and ``analysis.spmd.verify_hbm``/``verify_donation``
+    check the same peak-HBM accounting and the donation elision the
+    pricing assumes — the pre-flight sibling of this planner.
     """
     import numpy as np
 

@@ -125,6 +125,14 @@ class PlanRegistry:
                 obs.counter(name, cache="serve", tenant=str(t)).inc()
         return cp
 
+    def executables(self, key: Optional[str] = None) -> Tuple[object, ...]:
+        """The resident :class:`~pencilarrays_tpu.ops.fft.CompiledPlan`
+        executables (one key's, or all) — what a pre-flight
+        certification sweep (``PlanService.certify()``) walks."""
+        with self._lock:
+            return tuple(cp for k, cp in self._compiled.items()
+                         if key is None or k[0] == key)
+
     def stats(self) -> dict:
         with self._lock:
             return {"plans": len(self._plans),

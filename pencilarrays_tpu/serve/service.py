@@ -611,6 +611,61 @@ class PlanService:
         with self._lock:
             self._completed[outcome] = self._completed.get(outcome, 0) + 1
 
+    # -- pre-flight certification ------------------------------------------
+    def certify(self, *, hbm_limit: Optional[int] = None,
+                raise_on_error: bool = True) -> dict:
+        """Statically certify every resident plan BEFORE it serves
+        traffic: each registered fingerprint's compiled executables
+        (forward AND backward, every resident ``extra_dims``/donate
+        variant — or a fresh default-batch trace when nothing has
+        compiled yet) are extracted with
+        :mod:`pencilarrays_tpu.analysis.spmd` and proved equal,
+        op-for-op, to the plan's ``collective_costs`` prediction;
+        ``hbm_limit`` additionally bounds each certified variant's
+        static peak-HBM at that variant's OWN ``extra_dims`` (a
+        coalesced-batch executable is priced at its batch).
+
+        One ``analysis.check`` journal record per certified target
+        (non-ok fsync-critical).  Returns the sweep report; with
+        ``raise_on_error`` the first divergence re-raises its typed
+        error (:class:`~pencilarrays_tpu.analysis.errors.
+        ScheduleMismatchError` naming the offending op, ...) after the
+        report entry is journaled — the pre-flight gate."""
+        from ..analysis.errors import AnalysisError
+        from ..analysis.spmd import certify_plan
+
+        t0 = time.perf_counter()
+        report: dict = {"plans": [], "ok": True}
+        for key in self.registry.keys():
+            plan = self.registry.plan(key)
+            if plan is None:
+                continue
+            compiled = self.registry.executables(key)
+            targets = ([(cp, cp.extra_dims) for cp in compiled]
+                       or [(None, None)])
+            for cp, extra in targets:
+                # hbm_limit rides each variant's certification: a
+                # resident coalesced-batch executable is bounded at ITS
+                # extra_dims, not the plan's default batch
+                try:
+                    rec = certify_plan(plan, extra, compiled=cp,
+                                       hbm_limit=hbm_limit,
+                                       target=f"serve:{key}")
+                except AnalysisError as e:
+                    if raise_on_error:
+                        raise
+                    rec = {"target": f"serve:{key}",
+                           "outcome": type(e).__name__,
+                           "error": str(e),
+                           "extra_dims": list(
+                               extra if extra is not None
+                               else plan.batch_dims)}
+                    report["ok"] = False
+                report["plans"].append(rec)
+        report["seconds"] = time.perf_counter() - t0
+        report["certified"] = len(report["plans"])
+        return report
+
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:
         """Service snapshot: registry hit/miss, per-tenant accounting,

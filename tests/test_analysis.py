@@ -1,0 +1,565 @@
+"""Static-analysis layer (``pencilarrays_tpu/analysis/``, ISSUE 11).
+
+Pillar 1 — SPMD program verifier: ``CollectiveTrace`` extraction across
+methods x transforms x batch, typed rejection of corrupted schedules
+(naming the offending op), HBM bounds, donation elision, guard-on/off
+consistency, and the ``PlanService.certify()`` registry sweep with its
+``analysis.check`` journal records.
+
+Pillar 2 — AST repo linter: each check proven to FIRE on a
+deliberately-broken fixture tree and to stay quiet on a clean one,
+plus the allowlist round-trip (suppression, stale-entry detection,
+unjustified entries are findings) and the real repo linting clean.
+
+The ``pa-lint`` CLI is shelled over the repo and must exit 0 — the CI
+gate of both pillars.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu import obs
+from pencilarrays_tpu.analysis import spmd
+from pencilarrays_tpu.analysis.errors import (
+    DonationError,
+    HbmBoundError,
+    ScheduleMismatchError,
+    TraceDivergenceError,
+)
+from pencilarrays_tpu.analysis.lint import (
+    Allowlist,
+    Finding,
+    lint_tree,
+    run_lint,
+)
+from pencilarrays_tpu.ops.fft import PencilFFTPlan
+from pencilarrays_tpu.parallel.routing import plan_reshard_route
+from pencilarrays_tpu.parallel.transpositions import (
+    AllToAll,
+    Pipelined,
+    Ring,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# pillar 1: trace extraction
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("method", [AllToAll(), Ring(),
+                                    Pipelined(chunks=2)],
+                         ids=["alltoall", "ring", "pipelined"])
+@pytest.mark.parametrize("extra", [(), (3,)], ids=["plain", "batched"])
+def test_trace_transpose_matches_cost_model(devices, method, extra):
+    """The extractor reproduces the validated byte model for every
+    explicit method, batched and plain — the pin every refactored test
+    file now routes through."""
+    topo = pa.Topology((4,), devices=devices[:4])
+    pin = pa.Pencil(topo, (16, 12, 20), (1,))
+    pout = pa.Pencil(topo, (16, 12, 20), (0,))
+    tr = spmd.trace_transpose(pin, pout, extra, np.complex64, method)
+    assert tr.stats() == pa.transpose_cost(pin, pout, extra,
+                                           np.complex64, method)
+    # ordered, typed ops with replica groups and positive bytes
+    assert all(o.bytes > 0 for o in tr.ops)
+    assert [o.index for o in tr.ops] == list(range(len(tr.ops)))
+    assert any(o.replica_groups for o in tr.ops)
+
+
+@pytest.mark.parametrize("dims", [(4,), (2, 2)], ids=["slab", "pencil"])
+@pytest.mark.parametrize("real", [False, True], ids=["c2c", "r2c"])
+@pytest.mark.parametrize("extra", [(), (3,)], ids=["plain", "batched"])
+def test_verify_plan_whole_matrix(devices, dims, real, extra):
+    """Acceptance: every plan type's compiled trace == the
+    ``collective_costs`` prediction — slab/pencil x c2c/r2c x batched,
+    forward AND backward."""
+    n = int(np.prod(dims))
+    topo = pa.Topology(dims, devices=devices[:n])
+    plan = PencilFFTPlan(topo, (8, 8, 4), real=real)
+    fwd = spmd.verify_plan(plan, extra, "forward")
+    bwd = spmd.verify_plan(plan, extra, "backward")
+    assert len(fwd) > 0 and len(bwd) > 0
+
+
+def test_verify_routed_reshard(devices):
+    """Acceptance: the routed-reshard chain verifies too, and the
+    trace is the executable's (``_compiled_route``), not a re-trace."""
+    topo = pa.Topology((2, 4), devices=devices)
+    pin = pa.Pencil(topo, (16, 12, 8), (1, 2))
+    dest = pa.Pencil(topo, (16, 12, 8), (0, 1))
+    route = plan_reshard_route(pin, dest, (), np.float32)
+    assert route.hops
+    tr = spmd.verify_route(route, (), np.float32)
+    assert len(tr) == sum(
+        c["count"] for h in route.hops for c in h.cost.values())
+
+
+def test_trace_compiled_plan_is_residents_trace(devices):
+    """``trace_compiled_plan`` inspects the resident ``CompiledPlan``
+    executable's own jitted callable — certification covers what will
+    actually dispatch."""
+    topo = pa.Topology((2, 2), devices=devices[:4])
+    plan = PencilFFTPlan(topo, (8, 8, 4), dtype=np.complex64, batch=3)
+    cp = plan.compile()
+    tr = spmd.trace_compiled_plan(cp, "forward")
+    assert tr.stats() == plan.collective_costs((3,))
+    assert spmd.trace_compiled_plan(cp, "backward").stats() \
+        == plan.collective_costs((3,))
+
+
+# ---------------------------------------------------------------------------
+# pillar 1: typed rejection
+# ---------------------------------------------------------------------------
+
+
+def test_corrupted_schedule_rejected_naming_op(devices):
+    """Acceptance: a deliberately corrupted schedule is rejected with a
+    typed error NAMING the offending op — both a dropped collective in
+    the trace and a tampered prediction."""
+    topo = pa.Topology((2, 2), devices=devices[:4])
+    plan = PencilFFTPlan(topo, (8, 8, 4), dtype=np.complex64)
+    good = spmd.trace_plan(plan, ())
+    assert good.ops, "plan must move bytes for this drill"
+    # drop the last collective from the compiled trace
+    corrupted = spmd.CollectiveTrace(
+        source="corrupted", ops=good.ops[:-1],
+        donated_params=good.donated_params)
+    with pytest.raises(ScheduleMismatchError) as ei:
+        spmd.verify_plan(plan, (), "forward", trace=corrupted)
+    assert ei.value.op == good.ops[-1].kind
+    assert ei.value.predicted is not None
+    assert good.ops[-1].kind in str(ei.value)
+
+    # tamper the prediction instead (the plan lies about its costs)
+    class Tampered(PencilFFTPlan):
+        def collective_costs(self, extra_dims=None, **kw):
+            costs = PencilFFTPlan.collective_costs(self, extra_dims,
+                                                   **kw)
+            op = next(iter(costs))
+            costs[op] = {"count": costs[op]["count"] + 1,
+                         "bytes": costs[op]["bytes"]}
+            return costs
+
+    plan.__class__ = Tampered
+    try:
+        with pytest.raises(ScheduleMismatchError) as ei:
+            spmd.verify_plan(plan, (), "forward", trace=good)
+        assert ei.value.op in good.stats()
+    finally:
+        plan.__class__ = PencilFFTPlan
+
+
+def test_hbm_bound_violation_names_hop(devices):
+    """Check (c): a static peak-HBM prediction over the limit raises a
+    typed error naming the offending hop, for plans AND routes."""
+    topo = pa.Topology((2, 2), devices=devices[:4])
+    plan = PencilFFTPlan(topo, (8, 8, 4), dtype=np.complex64)
+    peak, label = spmd.predicted_peak_hbm(plan)
+    assert peak > 0 and label.startswith("hop[")
+    assert spmd.verify_hbm(plan, peak) == peak  # at the bound: fits
+    with pytest.raises(HbmBoundError) as ei:
+        spmd.verify_hbm(plan, peak - 1, source="drill")
+    assert ei.value.hop == label
+    assert ei.value.peak_bytes == peak
+    assert "drill" in str(ei.value) and label in str(ei.value)
+
+    topo8 = pa.Topology((2, 4), devices=devices)
+    pin = pa.Pencil(topo8, (16, 12, 8), (1, 2))
+    dest = pa.Pencil(topo8, (16, 12, 8), (0, 1))
+    route = plan_reshard_route(pin, dest, (), np.float32)
+    rpeak, rlabel = spmd.predicted_peak_hbm(route)
+    assert rpeak == max(h.peak_hbm_bytes for h in route.hops)
+    with pytest.raises(HbmBoundError) as ei:
+        spmd.verify_hbm(route, rpeak - 1)
+    assert ei.value.hop == rlabel and rlabel.startswith("route[")
+
+
+def test_consistency_checks(devices):
+    """Check (b): batched-vs-unbatched (count x1, bytes xB) and
+    guard-on-vs-off (same exchange collectives; probe all-reduces
+    excluded by kind) — plus a typed divergence drill."""
+    topo = pa.Topology((2, 2), devices=devices[:4])
+    plan = PencilFFTPlan(topo, (8, 8, 4), dtype=np.complex64)
+    t1 = spmd.trace_plan(plan, ())
+    t3 = spmd.trace_plan(plan, (3,))
+    spmd.verify_consistent(t1, t3, bytes_ratio=3)
+    with pytest.raises(TraceDivergenceError) as ei:
+        spmd.verify_consistent(t1, t3, bytes_ratio=1)  # wrong ratio
+    assert ei.value.op in t1.stats()
+    # guard-on vs guard-off hop bodies compile the same exchanges
+    from pencilarrays_tpu.ops.pallas_kernels import pallas_enabled
+    from pencilarrays_tpu.parallel import transpositions as tr
+
+    pin = pa.Pencil(topo, (8, 8, 4), (1, 2))
+    pout = pa.Pencil(topo, (8, 8, 4), (0, 2))
+    R = tr.assert_compatible(pin, pout)
+    m = AllToAll()
+    aval = spmd._input_aval(pin, (), np.dtype(np.float32))
+    off = spmd.trace_fn(
+        tr._compiled_transpose(pin, pout, R, 0, m, False,
+                               pallas_enabled()),
+        aval, source="guard-off")
+    on = spmd.trace_fn(
+        tr._compiled_guarded_transpose(pin, pout, R, 0, m, False,
+                                       pallas_enabled(), False),
+        aval, source="guard-on")
+    spmd.verify_consistent(off, on)
+
+
+def test_donation_verified_and_missing_donation_typed(devices):
+    """Check (c), donation half: a donate-compiled route carries the
+    input/output alias; a non-donating program fails typed."""
+    topo = pa.Topology((2, 4), devices=devices)
+    pin = pa.Pencil(topo, (16, 12, 8), (1, 2))
+    dest = pa.Pencil(topo, (16, 12, 8), (0, 1))
+    route = plan_reshard_route(pin, dest, (), np.float32)
+    donated = spmd.trace_route(route, (), np.float32, donate=True)
+    spmd.verify_donation(donated)
+    assert 0 in donated.donated_params
+    plain = spmd.trace_route(route, (), np.float32, donate=False)
+    with pytest.raises(DonationError):
+        spmd.verify_donation(plain)
+
+
+# ---------------------------------------------------------------------------
+# pillar 1: certification sweep + journal
+# ---------------------------------------------------------------------------
+
+
+def test_plan_service_certify_sweep(devices, tmp_path, monkeypatch):
+    """``PlanService.certify()`` certifies every resident executable
+    pre-flight, journaled as schema-clean ``analysis.check`` events."""
+    from pencilarrays_tpu.obs.schema import lint_journal
+    from pencilarrays_tpu.serve.service import PlanService
+
+    jdir = str(tmp_path / "obs")
+    monkeypatch.setenv(obs.ENV_VAR, jdir)
+    svc = PlanService(max_batch=4)
+    topo = pa.Topology((2, 2), devices=devices[:4])
+    svc.register_plan("c2c", lambda ctx: PencilFFTPlan(
+        topo, (8, 8, 4), dtype=np.complex64))
+    svc.register_plan("r2c", lambda ctx: PencilFFTPlan(
+        topo, (8, 8, 4), real=True))
+    # one resident executable (the other plan stays trace-certified)
+    svc.registry.compiled(svc.plan("c2c"), (2,))
+    try:
+        report = svc.certify()
+    finally:
+        svc.close()
+        from pencilarrays_tpu.cluster import elastic
+
+        elastic.unregister_plan("serve:c2c")
+        elastic.unregister_plan("serve:r2c")
+    assert report["ok"] and report["certified"] >= 2
+    assert all(r["outcome"] == "ok" for r in report["plans"])
+    targets = {r["target"] for r in report["plans"]}
+    assert {f"serve:{svc.plan('c2c').plan_key()}",
+            f"serve:{svc.plan('r2c').plan_key()}"} <= targets
+    events = [e for e in obs.read_journal(jdir)
+              if e["ev"] == "analysis.check"]
+    assert len(events) == report["certified"]
+    assert all(e["outcome"] == "ok" and e["seconds"] >= 0
+               for e in events)
+    assert lint_journal(obs.read_journal(jdir)) == []
+
+
+def test_certify_hbm_bounds_resident_batched_variant(devices):
+    """Review regression: ``certify(hbm_limit=)`` bounds each resident
+    executable at ITS extra_dims — a coalesced-batch variant must not
+    escape the limit through the plan's default batch, and the
+    non-raising report names the typed error and the variant."""
+    from pencilarrays_tpu.serve.service import PlanService
+
+    svc = PlanService()
+    topo = pa.Topology((2, 2), devices=devices[:4])
+    plan = PencilFFTPlan(topo, (8, 8, 4), dtype=np.complex64)  # batch=()
+    svc.registry.register(plan)
+    svc.registry.compiled(plan, (16,))      # resident batched variant
+    try:
+        unbatched_peak, _ = spmd.predicted_peak_hbm(plan, ())
+        batched_peak, _ = spmd.predicted_peak_hbm(plan, (16,))
+        assert batched_peak > unbatched_peak
+        # a limit the default batch fits but the resident batch blows
+        with pytest.raises(HbmBoundError):
+            svc.certify(hbm_limit=unbatched_peak)
+        report = svc.certify(hbm_limit=unbatched_peak,
+                             raise_on_error=False)
+        assert not report["ok"]
+        bad = [r for r in report["plans"]
+               if r["outcome"] == "HbmBoundError"]
+        assert len(bad) == 1
+        assert bad[0]["extra_dims"] == [16]
+        assert "error" in bad[0]
+        # at the true batched peak everything certifies
+        assert svc.certify(hbm_limit=batched_peak)["ok"]
+    finally:
+        svc.close()
+
+
+def test_certify_failure_journaled_and_raised(devices, tmp_path,
+                                              monkeypatch):
+    """A corrupted resident schedule fails certification with the
+    typed error AND an fsync-critical non-ok ``analysis.check``."""
+    jdir = str(tmp_path / "obs")
+    monkeypatch.setenv(obs.ENV_VAR, jdir)
+    topo = pa.Topology((2, 2), devices=devices[:4])
+    plan = PencilFFTPlan(topo, (8, 8, 4), dtype=np.complex64)
+    real_costs = plan.collective_costs
+    good = spmd.trace_plan(plan, ())
+    op = next(iter(good.stats()))
+
+    def tampered(extra_dims=None, **kw):
+        costs = real_costs(extra_dims, **kw)
+        costs[op] = {"count": costs[op]["count"] + 1,
+                     "bytes": costs[op]["bytes"]}
+        return costs
+
+    monkeypatch.setattr(plan, "collective_costs", tampered)
+    with pytest.raises(ScheduleMismatchError) as ei:
+        spmd.certify_plan(plan, (), target="drill")
+    assert ei.value.op == op
+    events = [e for e in obs.read_journal(jdir)
+              if e["ev"] == "analysis.check"]
+    assert len(events) == 1
+    assert events[0]["outcome"] == "ScheduleMismatchError"
+    assert events[0]["target"] == "drill"
+
+
+# ---------------------------------------------------------------------------
+# pillar 2: AST linter on broken fixture trees
+# ---------------------------------------------------------------------------
+
+
+_SCHEMA_PY = """
+EVENT_TYPES = {"hop": ("method",), "run.start": ("pid",)}
+"""
+
+_FAULTS_PY = """
+POINTS = frozenset({"io.open", "hop.exchange"})
+"""
+
+_ELASTIC_PY = """
+def clear_plan_caches():
+    from ..ops import fft as _fft
+
+    for mod, names in ((_fft, ("_stage_fn",)),):
+        pass
+"""
+
+
+def _write(root, rel, content):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(content))
+    return path
+
+
+def _fixture_repo(tmp_path, extra_files=()):
+    """A minimal parseable repo skeleton: schema/faults/elastic source
+    registries + docs corpus; ``extra_files`` adds the snippets under
+    test."""
+    root = str(tmp_path / "repo")
+    _write(root, "pencilarrays_tpu/obs/schema.py", _SCHEMA_PY)
+    _write(root, "pencilarrays_tpu/resilience/faults.py", _FAULTS_PY)
+    _write(root, "pencilarrays_tpu/cluster/elastic.py", _ELASTIC_PY)
+    _write(root, "pencilarrays_tpu/ops/fft.py", """
+        from functools import lru_cache
+        import jax
+
+        @lru_cache(maxsize=8)
+        def _stage_fn(k):
+            return jax.jit(lambda x: x)
+        """)
+    _write(root, "docs/Resilience.md", "| `io.open` | `hop.exchange` |")
+    _write(root, "README.md", "PENCILARRAYS_TPU_OBS is documented here")
+    for rel, content in extra_files:
+        _write(root, rel, content)
+    return root
+
+
+def test_lint_clean_fixture_has_no_findings(tmp_path):
+    root = _fixture_repo(tmp_path)
+    assert lint_tree(root) == []
+
+
+def test_lint_unregistered_journal_event(tmp_path):
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/ops/thing.py", """
+            def f(obs):
+                obs.record_event("hop", method="x")       # registered
+                obs.record_event("made.up", method="x")   # NOT
+            """)])
+    found = [f for f in lint_tree(root) if f.check == "journal-event"]
+    assert len(found) == 1
+    assert found[0].ident == "made.up"
+    assert "EVENT_TYPES" in found[0].message
+
+
+def test_lint_undocumented_env_knob(tmp_path):
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/knobs.py", """
+            import os
+            A = os.environ.get("PENCILARRAYS_TPU_OBS")       # documented
+            B = os.environ.get("PENCILARRAYS_TPU_SECRET_K")  # NOT
+            """)])
+    found = [f for f in lint_tree(root) if f.check == "env-knob"]
+    assert [f.ident for f in found] == ["PENCILARRAYS_TPU_SECRET_K"]
+
+
+def test_lint_unregistered_plan_cache(tmp_path):
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/serve/extra.py", """
+            from functools import lru_cache
+            import jax
+
+            @lru_cache(maxsize=4)
+            def _rogue_fn(n):
+                return jax.jit(lambda x: x * n)
+
+            @lru_cache(maxsize=4)
+            def _pure_table(n):
+                return {"n": n}   # no jit: not an executable factory
+            """)])
+    found = [f for f in lint_tree(root) if f.check == "plan-cache"]
+    assert [f.ident for f in found] == ["serve.extra._rogue_fn"]
+    assert "clear_plan_caches" in found[0].message
+
+
+def test_lint_fault_point_checks(tmp_path):
+    root = _fixture_repo(tmp_path, [
+        # consults an unregistered point
+        ("pencilarrays_tpu/io/x.py", """
+            from ..resilience import faults
+
+            def f():
+                faults.fire("io.open")
+                faults.fire("io.bogus")
+            """),
+        # a registered point missing from the docs table
+        ("pencilarrays_tpu/resilience/faults2.py", "")])
+    # drop hop.exchange from the docs
+    _write(root, "docs/Resilience.md", "| `io.open` |")
+    found = sorted(f.ident for f in lint_tree(root)
+                   if f.check == "fault-point")
+    assert found == ["hop.exchange", "io.bogus"]
+
+
+def test_lint_unlocked_daemon_state(tmp_path):
+    broken = """
+        _pending = {}
+
+        def note(k, v):
+            _pending[k] = v
+        """
+    locked = """
+        import threading
+
+        _lock = threading.Lock()
+        _pending = {}
+
+        def note(k, v):
+            with _lock:
+                _pending[k] = v
+        """
+    readonly = """
+        _TABLE = {"a": 1}
+
+        def get(k):
+            return _TABLE[k]
+        """
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/obs/broken.py", broken),
+        ("pencilarrays_tpu/serve/lockedmod.py", locked),
+        ("pencilarrays_tpu/cluster/tables.py", readonly),
+        # same mutated state OUTSIDE the daemon packages: out of scope
+        ("pencilarrays_tpu/parallel/free.py", broken)])
+    found = [f for f in lint_tree(root) if f.check == "unlocked-state"]
+    assert [f.ident for f in found] == ["obs.broken._pending"]
+
+
+def test_allowlist_roundtrip(tmp_path):
+    """Allowlist round-trip: a justified entry suppresses its finding,
+    stale entries are reported unused, unjustified/malformed lines are
+    findings themselves."""
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/knobs.py",
+         'import os\nB = os.environ.get("PENCILARRAYS_TPU_SECRET_K")\n')])
+    allow = _write(root, "pa-lint.allow", """
+        # comment lines are fine
+        env-knob PENCILARRAYS_TPU_SECRET_K  # internal-only drill knob
+        env-knob PENCILARRAYS_TPU_NEVER_READ  # stale entry
+        """)
+    findings, al = run_lint(root, Allowlist.load(allow))
+    assert findings == []
+    assert al.unused() == ["env-knob PENCILARRAYS_TPU_NEVER_READ"]
+
+    # an entry without a justification is itself a finding
+    allow2 = _write(root, "pa-lint.allow", """
+        env-knob PENCILARRAYS_TPU_SECRET_K
+        """)
+    findings, _ = run_lint(root, Allowlist.load(allow2))
+    checks = {f.check for f in findings}
+    assert "allowlist" in checks          # the bad line
+    assert "env-knob" in checks           # and the finding is NOT hidden
+
+
+def test_real_repo_lints_clean():
+    """The tree itself: zero findings, empty allowlist hits — the
+    satellite contract ('the linter lands green, not allowlisted')."""
+    findings, al = run_lint(REPO_ROOT)
+    assert findings == [], "\n".join(str(f) for f in findings)
+    assert al.unused() == []
+
+
+def test_finding_identity_is_stable():
+    f = Finding("env-knob", "a/b.py", 12, "PENCILARRAYS_TPU_X", "msg")
+    assert f.key == "env-knob PENCILARRAYS_TPU_X"
+    assert "a/b.py:12" in str(f)
+
+
+# ---------------------------------------------------------------------------
+# the CLI gate
+# ---------------------------------------------------------------------------
+
+
+def test_pa_lint_cli_exits_zero_on_repo():
+    """CI gate: shell the real CLI over the repo — both pillars — and
+    require exit 0.  Runs in a subprocess exactly as CI/a console
+    script would."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # share the suite's persistent compile cache: the sweep re-lowers
+    # tiny programs only
+    env.setdefault("PENCILARRAYS_TPU_COMPILE_CACHE",
+                   os.path.join(REPO_ROOT, ".jax_cache"))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pencilarrays_tpu.analysis", REPO_ROOT],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "pa-lint: clean" in proc.stdout
+    assert "0 lint finding(s)" in proc.stdout
+
+
+def test_pa_lint_cli_reports_findings(tmp_path):
+    """A broken tree exits 1 and prints the finding."""
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/knobs.py",
+         'import os\nB = os.environ.get("PENCILARRAYS_TPU_SECRET_K")\n')])
+    proc = subprocess.run(
+        [sys.executable, "-m", "pencilarrays_tpu.analysis", root,
+         "--no-spmd"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO_ROOT)
+    assert proc.returncode == 1
+    assert "PENCILARRAYS_TPU_SECRET_K" in proc.stdout

@@ -15,25 +15,19 @@ from pencilarrays_tpu import (
     AllToAll,
     Gspmd,
     Pencil,
-    PencilArray,
     PencilFFTPlan,
     Ring,
     Topology,
-    transpose,
     transpose_cost,
 )
-from pencilarrays_tpu.utils.hlo import collective_stats
+from pencilarrays_tpu.analysis import spmd
 
 
 def _measured(pin, pout, extra_dims, dtype, method):
-    x = PencilArray.zeros(pin, extra_dims, dtype)
-
-    def hop(d):
-        return transpose(PencilArray(pin, d, extra_dims), pout,
-                         method=method).data
-
-    hlo = jax.jit(hop).lower(x.data).compile().as_text()
-    return collective_stats(hlo)
+    # ONE shared extractor (analysis/spmd.py) — the former per-test
+    # jit->lower->compile->collective_stats pipeline, typed
+    return spmd.trace_transpose(pin, pout, extra_dims, dtype,
+                                method).stats()
 
 
 TOPOS = [(2,), (4,), (2, 2), (8,), (4, 2)]
@@ -91,11 +85,7 @@ def test_fft_plan_costs_match_compiled(devices):
     for method in METHODS:
         plan = PencilFFTPlan(topo, (16, 12, 20), real=True, method=method)
         for extra in [(), (3,)]:
-            x = plan.allocate_input(extra)
-            hlo = (jax.jit(lambda d: plan.forward(
-                PencilArray(plan.input_pencil, d, extra)).data)
-                .lower(x.data).compile().as_text())
-            measured = collective_stats(hlo)
+            measured = spmd.trace_plan(plan, extra).stats()
             assert measured == plan.collective_costs(extra), (
                 method, extra, measured, plan.collective_costs(extra))
 
@@ -152,11 +142,7 @@ def test_batched_plan_costs_match_compiled(devices):
     — the amortization claim, end to end on the whole plan."""
     topo = Topology((4, 2))
     plan = PencilFFTPlan(topo, (16, 12, 20), real=True, batch=3)
-    x = plan.allocate_input()
-    hlo = (jax.jit(lambda d: plan.forward(
-        PencilArray(plan.input_pencil, d, (3,))).data)
-        .lower(x.data).compile().as_text())
-    measured = collective_stats(hlo)
+    measured = spmd.trace_plan(plan, (3,)).stats()
     assert measured == plan.collective_costs()
     per_sample = plan.collective_costs(())
     for op, c in measured.items():
@@ -169,8 +155,5 @@ def test_backward_costs_equal_forward(devices):
     match the same model."""
     topo = Topology((4, 2))
     plan = PencilFFTPlan(topo, (16, 12, 20), real=True)
-    uh = plan.allocate_output((3,))
-    hlo = (jax.jit(lambda d: plan.backward(
-        PencilArray(plan.output_pencil, d, (3,))).data)
-        .lower(uh.data).compile().as_text())
-    assert collective_stats(hlo) == plan.collective_costs((3,))
+    assert (spmd.trace_plan(plan, (3,), "backward").stats()
+            == plan.collective_costs((3,)))

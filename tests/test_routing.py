@@ -37,10 +37,10 @@ from pencilarrays_tpu import (
     plan_reshard_route,
     reshard,
 )
+from pencilarrays_tpu.analysis import spmd
 from pencilarrays_tpu.obs import drift as obs_drift
 from pencilarrays_tpu.parallel import routing
 from pencilarrays_tpu.parallel import transpositions as tr
-from pencilarrays_tpu.utils.hlo import collective_stats
 
 
 @pytest.fixture(autouse=True)
@@ -286,7 +286,8 @@ def test_route_never_priced_worse_than_gspmd(devices):
 def test_routed_chain_hlo_budget(devices):
     """The compiled fused chain contains EXACTLY the collectives the
     per-hop byte model predicts — count and bytes (the transpose-engine
-    validation, extended over a whole route)."""
+    validation, extended over a whole route, through the ONE shared
+    extractor: ``analysis.spmd``)."""
     topo = Topology((2, 4))
     shape = (16, 12, 8)
     pin = Pencil(topo, shape, (1, 2))
@@ -299,14 +300,10 @@ def test_routed_chain_hlo_budget(devices):
             e = expect.setdefault(op, {"count": 0, "bytes": 0})
             e["count"] += c["count"]
             e["bytes"] += c["bytes"]
-    x = PencilArray.zeros(pin, dtype=np.float32)
-    from pencilarrays_tpu.ops.pallas_kernels import pallas_enabled
-
-    fn = routing._compiled_route(plan.pencils,
-                                 tuple(h.method for h in plan.hops), 0,
-                                 False, pallas_enabled())
-    hlo = jax.jit(fn).lower(x.data).compile().as_text()
-    assert collective_stats(hlo) == expect
+    # verify_route raises a typed ScheduleMismatchError naming the op
+    # on divergence; the stats equality keeps the original pin exact
+    trace = spmd.verify_route(plan, (), np.float32)
+    assert trace.stats() == expect
 
 
 # ---------------------------------------------------------------------------
@@ -331,12 +328,8 @@ def test_transpose_cost_gspmd_matches_compiled(devices):
     pin = Pencil(topo, (8, 8), (0,))
     pout = Pencil(topo, (8, 8), (1,))
     cost = pa.transpose_cost(pin, pout, method=Gspmd())
-    x = PencilArray.zeros(pin, dtype=np.float32)
-    hlo = jax.jit(
-        lambda d: pa.transpose(PencilArray(pin, d), pout,
-                               method=Gspmd()).data
-    ).lower(x.data).compile().as_text()
-    assert collective_stats(hlo) == cost
+    assert spmd.trace_transpose(pin, pout, (), np.float32,
+                                Gspmd()).stats() == cost
     assert sum(v["bytes"] for v in cost.values()) > 0
 
 

@@ -35,8 +35,8 @@ from pencilarrays_tpu.ops.fft import (
     PencilFFTPlan,
     _decomposition_candidates,
 )
+from pencilarrays_tpu.analysis import spmd
 from pencilarrays_tpu.parallel.transpositions import AllToAll, Auto
-from pencilarrays_tpu.utils.hlo import collective_stats
 
 
 def _rand_input(plan, extra_dims=None, seed=0):
@@ -171,11 +171,8 @@ def test_batched_collectives_amortized_hlo_pinned(devices, dims, real):
     plan = PencilFFTPlan(topo, (8, 6, 4), real=real, batch=B)
 
     def measured(extra):
-        u = plan.allocate_input(extra)
-        hlo = (jax.jit(lambda d: plan.forward(
-            pa.PencilArray(plan.input_pencil, d, extra)).data)
-            .lower(u.data).compile().as_text())
-        return collective_stats(hlo)
+        # the ONE shared extractor (analysis/spmd.py)
+        return spmd.trace_plan(plan, extra).stats()
 
     got1 = measured(())
     gotB = measured((B,))
@@ -396,11 +393,7 @@ def test_r2c_schedule_moves_hermitian_half_bytes(devices):
     assert br == {"all-to-all": {"count": 2, "bytes": 4 * 9600}}
     assert 9600 / 15360 == 10 / 16  # padded hermitian-half ratio
     # and the priced prediction IS what the batched program compiles to
-    u = r2c.allocate_input()
-    hlo = (jax.jit(lambda d: r2c.forward(
-        pa.PencilArray(r2c.input_pencil, d, (4,))).data)
-        .lower(u.data).compile().as_text())
-    assert collective_stats(hlo) == br
+    assert spmd.trace_plan(r2c, (4,)).stats() == br
 
 
 def test_auto_decomposition_prices_r2c_schedules(devices):
