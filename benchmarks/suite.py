@@ -741,6 +741,20 @@ def main():
                              "commit the BENCH_ANALYSIS.json artifact)")
     parser.add_argument("--serve-n", type=int, default=16,
                         help="requests per tenant in the serving arm")
+    parser.add_argument("--engine", action="store_true",
+                        help="also run the async-executor arm "
+                             "(benchmarks/exec_bench.py): pipelined "
+                             "engine vs sync-per-dispatch step loop — "
+                             "steps/sec, per-step latency and the "
+                             "host-overlap fraction, with the issued "
+                             "dispatch log certified against the "
+                             "serialized schedule; writes "
+                             "BENCH_EXEC.json")
+    parser.add_argument("--engine-only", action="store_true",
+                        help="run ONLY the --engine arm (used to "
+                             "commit the BENCH_EXEC.json artifact)")
+    parser.add_argument("--engine-steps", type=int, default=20,
+                        help="steps per pass in the executor arm")
     args = parser.parse_args()
 
     import jax
@@ -869,6 +883,29 @@ def main():
                         "n_devices": len(devs)}, "BENCH_SERVE.json",
                        devs=devs)
         if args.serve_only:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+            print(json.dumps(results, indent=1))
+            return
+
+    # -- 15. engine: pipelined vs sync-per-dispatch step loop (opt-in) -----
+    # The ISSUE 12 headline: the per-mesh executor's ordered dispatch
+    # queue + host pool vs the PR-5 serialized loop on an identical
+    # checkpoint-heavy step workload — steps/sec, per-step latency,
+    # host-overlap fraction, and the issued dispatch log statically
+    # certified equal to the serialized schedule (zero trace diffs) —
+    # committed as BENCH_EXEC.json.
+    if args.engine or args.engine_only:
+        from benchmarks.exec_bench import run_exec_suite
+        from benchmarks.exec_bench import write_artifact as write_exec
+
+        results["engine"] = run_exec_suite(devs,
+                                           n_steps=args.engine_steps)
+        write_exec({**results["engine"],
+                    "platform": devs[0].platform,
+                    "n_devices": len(devs)}, "BENCH_EXEC.json",
+                   devs=devs)
+        if args.engine_only:
             with open(args.out, "w") as f:
                 json.dump(results, f, indent=1)
             print(json.dumps(results, indent=1))

@@ -23,6 +23,7 @@ See ``docs/StaticAnalysis.md``.
 
 from .errors import (
     AnalysisError,
+    DispatchOrderError,
     DonationError,
     HbmBoundError,
     ScheduleMismatchError,
@@ -41,6 +42,7 @@ from .spmd import (
     trace_route,
     trace_transpose,
     verify_consistent,
+    verify_dispatch_log,
     verify_donation,
     verify_hbm,
     verify_plan,
@@ -53,6 +55,7 @@ __all__ = [
     "TraceDivergenceError",
     "HbmBoundError",
     "DonationError",
+    "DispatchOrderError",
     "CollectiveOp",
     "CollectiveTrace",
     "EXCHANGE_KINDS",
@@ -67,6 +70,7 @@ __all__ = [
     "verify_consistent",
     "verify_hbm",
     "verify_donation",
+    "verify_dispatch_log",
     "certify_plan",
     "predicted_peak_hbm",
 ]

@@ -16,6 +16,7 @@ __all__ = [
     "TraceDivergenceError",
     "HbmBoundError",
     "DonationError",
+    "DispatchOrderError",
 ]
 
 
@@ -70,6 +71,29 @@ class HbmBoundError(AnalysisError):
         super().__init__(
             f"{source}: hop {hop} needs {peak_bytes} peak HBM bytes "
             f"per chip, over the {limit_bytes}-byte limit")
+
+
+class DispatchOrderError(AnalysisError):
+    """An engine's issued dispatch order diverged from its enqueue
+    order — the pipelined schedule is NOT the serialized schedule, and
+    on a mesh a reordered collective launch is a deadlock.  Names the
+    first diverging dispatch (issue position, label, and the enqueue
+    sequence numbers observed vs expected).  Ordering is guaranteed by
+    construction (one consumer thread, FIFO), so this firing means the
+    executor itself is broken — the check exists precisely so that
+    claim is *proved*, not assumed."""
+
+    def __init__(self, source: str, position: int, label: str,
+                 expected_seq: int, observed_seq: int):
+        self.source = source
+        self.position = position
+        self.label = label
+        self.expected_seq = int(expected_seq)
+        self.observed_seq = int(observed_seq)
+        super().__init__(
+            f"{source}: dispatch order diverges at issue position "
+            f"{position} ({label!r}): expected enqueue seq "
+            f"{expected_seq}, issued seq {observed_seq}")
 
 
 class DonationError(AnalysisError):

@@ -24,9 +24,15 @@ can enforce at runtime:
     table;
 ``unlocked-state``
     mutable module-level state that is actually *mutated* inside the
-    daemon-bearing packages (``obs/``, ``cluster/``, ``serve/`` — the
-    ones that run threads) lives in a module that also defines a
-    module-level lock, or is explicitly allowlisted.
+    daemon-bearing packages (``obs/``, ``cluster/``, ``serve/``,
+    ``engine/`` — the ones that run threads) lives in a module that
+    also defines a module-level lock, or is explicitly allowlisted;
+``thread-spawn``
+    raw ``threading.Thread(...)`` construction appears ONLY inside
+    ``engine/`` — every other subsystem spawns through the engine's
+    :func:`~pencilarrays_tpu.engine.threads.spawn_thread` choke point
+    (named, inventoried, daemonic), so a new daemon thread cannot
+    appear anywhere else without a lint finding.
 
 Everything is parsed from source with :mod:`ast` — the linter never
 imports the modules it checks, so it runs in milliseconds, cannot be
@@ -59,7 +65,10 @@ DEFAULT_ALLOWLIST = "pa-lint.allow"
 
 # the daemon-bearing packages whose module-level mutable state the
 # unlocked-state check audits
-DAEMON_PACKAGES = ("obs", "cluster", "serve")
+DAEMON_PACKAGES = ("obs", "cluster", "serve", "engine")
+
+# the one package allowed to construct threads (thread-spawn check)
+THREAD_PACKAGE = "engine"
 
 _ENV_KNOB_RE = re.compile(r"^PENCILARRAYS_TPU_[A-Z0-9]+(?:_[A-Z0-9]+)*$")
 
@@ -69,7 +78,7 @@ _MUTATING_METHODS = frozenset({
 })
 
 CHECKS = ("journal-event", "env-knob", "plan-cache", "fault-point",
-          "unlocked-state")
+          "unlocked-state", "thread-spawn")
 
 
 @dataclass(frozen=True)
@@ -522,6 +531,48 @@ def _check_unlocked_state(root: str, trees: Dict[str, ast.Module],
                     f"no module-level lock"))
 
 
+def _is_thread_ctor(f: ast.AST) -> bool:
+    """``threading.Thread(...)`` / ``Thread(...)`` (a from-import)."""
+    if isinstance(f, ast.Attribute) and f.attr == "Thread" and \
+            isinstance(f.value, ast.Name) and f.value.id == "threading":
+        return True
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+def _check_thread_spawn(root: str, trees: Dict[str, ast.Module],
+                        findings: List[Finding]) -> None:
+    """Thread construction is an engine/ monopoly: everything else
+    spawns through ``engine.threads.spawn_thread`` (module docstring).
+    The ident is ``<dotted module>.<enclosing function>`` so an
+    allowlist entry survives unrelated edits."""
+    allowed = os.path.join(root, PACKAGE, THREAD_PACKAGE) + os.sep
+    for path, tree in trees.items():
+        if path.startswith(allowed):
+            continue
+        dotted = _module_dotted(root, path)
+
+        def visit(node: ast.AST, scope: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                inner = scope
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    inner = child.name
+                if isinstance(child, ast.Call) and \
+                        _is_thread_ctor(child.func):
+                    ident = f"{dotted}.{scope}"
+                    findings.append(Finding(
+                        "thread-spawn", _rel(root, path), child.lineno,
+                        ident,
+                        f"raw threading.Thread construction in {ident} "
+                        f"— spawn through engine.threads.spawn_thread "
+                        f"(the one audited choke point; threads outside "
+                        f"engine/ are unnamed, uninventoried, and "
+                        f"reopen the dispatch-ordering deadlock class)"))
+                visit(child, inner)
+
+        visit(tree, "<module>")
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -549,6 +600,7 @@ def lint_tree(root: str) -> List[Finding]:
     _check_plan_caches(root, trees, findings)
     _check_fault_points(root, trees, docs_resilience, findings)
     _check_unlocked_state(root, trees, findings)
+    _check_thread_spawn(root, trees, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.check, f.ident))
     return findings
 

@@ -36,6 +36,7 @@ from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 from ..utils.hlo import COLLECTIVE_OPS, _APP_RE, shape_bytes
 from .errors import (
+    DispatchOrderError,
     DonationError,
     HbmBoundError,
     ScheduleMismatchError,
@@ -57,6 +58,7 @@ __all__ = [
     "verify_consistent",
     "verify_hbm",
     "verify_donation",
+    "verify_dispatch_log",
     "certify_plan",
     "predicted_peak_hbm",
 ]
@@ -439,6 +441,64 @@ def verify_donation(trace: CollectiveTrace, *,
             f"parameter(s) {missing} not input/output-aliased "
             f"(donated_params={list(trace.donated_params)}): donation "
             f"did not elide the buffer the pricing assumed")
+
+
+def verify_dispatch_log(records: Sequence, *, source: str = "engine",
+                        verify_traces: bool = True) -> dict:
+    """Check (d), the engine check: a pipelined executor's ISSUED
+    dispatch sequence equals the serialized schedule.
+
+    ``records`` are :class:`~pencilarrays_tpu.engine.DispatchRecord`\\ s
+    (issue order).  Two properties are proved:
+
+    * **order** — issue order == enqueue order (ascending
+      ``enqueue_seq`` along ascending ``issue_seq``; gaps are fine —
+      interleaved traffic from other clients of the same engine was
+      issued between these records — but an INVERSION means the
+      pipelined schedule is not the serialized one and raises
+      :class:`~pencilarrays_tpu.analysis.errors.DispatchOrderError`
+      naming the first diverging dispatch);
+    * **trace** — every record that carries a plan in its ``meta``
+      (``plan``/``extra_dims``/``direction`` — the serve layer's
+      dispatch metadata) has its compiled collective trace re-extracted
+      and proved equal, op-for-op, to the plan's ``collective_costs``
+      prediction via :func:`verify_plan` (raises
+      :class:`ScheduleMismatchError` naming the offending op).  Each
+      distinct ``(plan_key, extra, direction)`` is traced once —
+      identical dispatches share one certification.
+
+    Returns ``{"dispatches", "order_ok", "verified_traces",
+    "unverified", "ops"}``."""
+    records = list(records)
+    prev_seq = None
+    for pos, r in enumerate(records):
+        seq = r.enqueue_seq
+        if prev_seq is not None and seq <= prev_seq:
+            raise DispatchOrderError(source, pos, r.label,
+                                     expected_seq=prev_seq + 1,
+                                     observed_seq=seq)
+        prev_seq = seq
+    verified, unverified, total_ops = 0, 0, 0
+    if verify_traces:
+        seen: Dict[tuple, int] = {}
+        for r in records:
+            meta = getattr(r, "meta", None) or {}
+            plan = meta.get("plan")
+            if plan is None:
+                unverified += 1
+                continue
+            extra = tuple(meta.get("extra_dims", ()))
+            direction = meta.get("direction", "forward")
+            key = (plan.plan_key(), extra, direction)
+            if key not in seen:
+                seen[key] = len(verify_plan(plan, extra, direction))
+            total_ops += seen[key]
+            verified += 1
+    else:
+        unverified = len(records)
+    return {"dispatches": len(records), "order_ok": True,
+            "verified_traces": verified, "unverified": unverified,
+            "ops": total_ops}
 
 
 # ---------------------------------------------------------------------------

@@ -52,7 +52,6 @@ Environment knobs:
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Optional
 
@@ -109,17 +108,21 @@ _coord_key = None                    # (env value, rank, world) it was built for
 
 
 def _env_value() -> str:
-    return os.environ.get(ENV_VAR, "")
+    from ..engine import config as _rtc
+
+    return _rtc.current().cluster_env
 
 
 def enabled() -> bool:
-    """THE gate: one env probe on the disabled path (no coordinator is
-    built, no thread started, nothing allocated unless this is True).
-    Off tokens match case-insensitively (``OFF`` is off, not a FileKV
-    directory named ``OFF``)."""
+    """THE gate: one cached snapshot probe on the disabled path (no
+    coordinator is built, no thread started, nothing allocated unless
+    this is True).  Off tokens match case-insensitively (``OFF`` is
+    off, not a FileKV directory named ``OFF``)."""
     if _override is not None:
         return _override is not False
-    return _env_value().strip().lower() not in _OFF_VALUES
+    from ..engine import config as _rtc
+
+    return _rtc.current().cluster_on
 
 
 def rank() -> int:
@@ -129,23 +132,21 @@ def rank() -> int:
     convention), else 0.  THE one identity-resolution rule — the
     ``%rank`` fault selector and obs journal attribution delegate
     here."""
-    env = os.environ.get(RANK_VAR)
-    if env is not None:
-        try:
-            return int(env)
-        except ValueError:
-            pass
+    from ..engine import config as _rtc
+
+    r = _rtc.current().cluster_rank
+    if r is not None:
+        return r
     return _jax_identity()[0]
 
 
 def world_size() -> int:
     """Mesh size under the same resolution order as :func:`rank`."""
-    env = os.environ.get(WORLD_VAR)
-    if env is not None:
-        try:
-            return int(env)
-        except ValueError:
-            pass
+    from ..engine import config as _rtc
+
+    w = _rtc.current().cluster_world
+    if w is not None:
+        return w
     return _jax_identity()[1]
 
 
@@ -163,38 +164,32 @@ def _jax_identity():
 
 
 def lease_ttl() -> float:
-    try:
-        return float(os.environ.get(LEASE_TTL_VAR, DEFAULT_LEASE_TTL))
-    except ValueError:
-        return DEFAULT_LEASE_TTL
+    from ..engine import config as _rtc
+
+    return _rtc.current().lease_ttl
 
 
 def lease_interval() -> Optional[float]:
-    try:
-        v = os.environ.get(LEASE_INTERVAL_VAR)
-        return float(v) if v else None
-    except ValueError:
-        return None
+    from ..engine import config as _rtc
+
+    return _rtc.current().lease_interval
 
 
 def join_grace() -> Optional[float]:
     """Override for the never-joined window (``None``: the lease
     board's ``max(2*ttl, 20s)`` default) — raise it on pods whose
     containers start far apart, without inflating ``ttl`` (which would
-    also slow real-death detection)."""
-    try:
-        v = os.environ.get(JOIN_GRACE_VAR)
-        return float(v) if v else None
-    except ValueError:
-        return None
+    also slow real-death detection).  Parsing lives in
+    ``engine/config.py`` with every other runtime knob."""
+    from ..engine import config as _rtc
+
+    return _rtc.current().join_grace
 
 
 def verdict_timeout() -> float:
-    try:
-        return float(os.environ.get(VERDICT_TIMEOUT_VAR,
-                                    DEFAULT_VERDICT_TIMEOUT))
-    except ValueError:
-        return DEFAULT_VERDICT_TIMEOUT
+    from ..engine import config as _rtc
+
+    return _rtc.current().verdict_timeout
 
 
 def coordinator():

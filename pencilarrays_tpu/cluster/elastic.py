@@ -123,11 +123,13 @@ _last: Optional["Reformation"] = None  # most recent completed reformation
 
 
 def enabled() -> bool:
-    """THE elastic gate (one env probe when off): with this False the
-    recovery ladder is the PR 5/6 one, bit-for-bit."""
+    """THE elastic gate (one cached snapshot probe when off): with this
+    False the recovery ladder is the PR 5/6 one, bit-for-bit."""
     if _override is not None:
         return _override
-    return os.environ.get(ENV_VAR, "").strip().lower() not in _OFF_VALUES
+    from ..engine import config as _rtconfig
+
+    return _rtconfig.current().elastic_on
 
 
 def enable() -> None:
@@ -170,31 +172,27 @@ def _reset_for_tests() -> None:
 
 
 def _timeout() -> float:
-    try:
-        return float(os.environ.get(TIMEOUT_VAR, DEFAULT_TIMEOUT))
-    except ValueError:
-        return DEFAULT_TIMEOUT
+    from ..engine import config as _rtconfig
+
+    return _rtconfig.current().elastic_timeout
 
 
 def _max_rounds() -> int:
-    try:
-        return max(1, int(os.environ.get(ROUNDS_VAR, DEFAULT_ROUNDS)))
-    except ValueError:
-        return DEFAULT_ROUNDS
+    from ..engine import config as _rtconfig
+
+    return _rtconfig.current().elastic_rounds
 
 
 def _min_world() -> int:
-    try:
-        return max(1, int(os.environ.get(MIN_WORLD_VAR, "1")))
-    except ValueError:
-        return 1
+    from ..engine import config as _rtconfig
+
+    return _rtconfig.current().elastic_min_world
 
 
 def _join_timeout() -> float:
-    try:
-        return float(os.environ.get(JOIN_TIMEOUT_VAR, DEFAULT_JOIN_TIMEOUT))
-    except ValueError:
-        return DEFAULT_JOIN_TIMEOUT
+    from ..engine import config as _rtconfig
+
+    return _rtconfig.current().elastic_join_timeout
 
 
 def _base_ns(ns: str) -> str:
@@ -516,8 +514,32 @@ def reform(coordinator=None, *, reason: str = "reform",
     _journal_reform("begin", _gen + 1, rank=coord.rank, world=coord.world,
                     reason=reason, detect_s=detect_s)
     new_coord = None
+    from .. import engine as _engine
+
     try:
         with _watchdog(f"reform:{reason}", kind="reform"):
+            # -- engine quiesce: BEFORE the membership changes, every
+            # registered engine pauses at its next task boundary and
+            # the in-flight dispatch (if any) completes — no device
+            # program may be mid-issue while the mesh reforms under it.
+            # Queued dispatches are HELD here (a failed reformation
+            # resumes them untouched); they are only dropped typed when
+            # the reformation actually commits below.
+            t0 = time.monotonic()
+            quiesced = _engine.quiesce_all()
+            timings["engine_quiesce_s"] = time.monotonic() - t0
+            if not quiesced:
+                # an in-flight dispatch outlived the quiesce budget (a
+                # wedged collective — often the very failure being
+                # reformed around).  Proceeding is safe-by-generation:
+                # reform_all below retires the old consumer, so the
+                # stuck thread can never issue ANOTHER program — but
+                # the broken invariant must be on the record, not
+                # silent (the watchdog/crash-bundle path owns killing
+                # the stuck call itself)
+                _journal_reform("engine-quiesce-timeout", _gen + 1,
+                                rank=coord.rank,
+                                waited_s=timings["engine_quiesce_s"])
             t0 = time.monotonic()
             m = agree_membership(coord, reason=reason, timeout=timeout)
             timings["membership_s"] = time.monotonic() - t0
@@ -580,10 +602,19 @@ def reform(coordinator=None, *, reason: str = "reform",
                 _plans[name] = factory(ctx)
             if rebuild is not None:
                 rebuild(ctx)
+            # -- engine reform: the reindexed coordinator gets fresh
+            # engines — queued dispatches (compiled for the dead mesh)
+            # fail typed EngineReformedError, timers drop, a fresh
+            # RuntimeConfig snapshot is taken, and a new generation of
+            # consumer/pool threads starts on demand.  Admission-queued
+            # serve requests are untouched: they re-bind to the plans
+            # the factories above just rebuilt.
+            reformed_engines = _engine.reform_all()
             timings["replan_s"] = time.monotonic() - t0
             _journal_reform("replan", m.gen, rank=m.new_rank,
                             plans=sorted(n for n, _ in factories),
-                            dropped_executables=dropped)
+                            dropped_executables=dropped,
+                            engines=reformed_engines)
 
             # -- restore: the agreed step, across the changed world
             restored: Optional[int] = None
@@ -636,6 +667,13 @@ def reform(coordinator=None, *, reason: str = "reform",
                 new_coord.shutdown()
             except Exception:
                 pass
+        # the old mesh is still the live one: un-pause the engines so
+        # their held queues dispatch again (the quiesce above must not
+        # outlive a FAILED reformation as a silent wedge)
+        try:
+            _engine.resume_all()
+        except Exception:
+            pass
         if obs.enabled():
             obs.counter("cluster.reforms", outcome="failed").inc()
         _journal_reform("failed", _gen, rank=coord.rank,

@@ -105,10 +105,10 @@ class LeaseBoard:
             obs.record_event("cluster.lease", rank=self.rank,
                              status="acquired", ttl_s=self.ttl,
                              interval_s=self.interval)
-        t = threading.Thread(target=self._loop, daemon=True,
-                             name=f"pa-cluster-lease-r{self.rank}")
-        self._thread = t
-        t.start()
+        from ..engine.threads import spawn_thread
+
+        self._thread = spawn_thread(self._loop,
+                                    name=f"pa-cluster-lease-r{self.rank}")
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):

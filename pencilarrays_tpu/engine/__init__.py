@@ -1,0 +1,77 @@
+"""Async per-mesh task-graph executor — ordered dispatch, host overlap.
+
+The engine is the runtime's ONE issuer of device work and ONE spawner
+of threads:
+
+* :class:`Engine` (``engine/executor.py``) — an ordered dispatch queue
+  (single consumer thread, collective order guaranteed by
+  construction) plus a host task pool that overlaps checkpoint
+  serialization, guard probe readback, drift sampling and batch
+  packing with the next dispatch's compute.  Steps are
+  :class:`StepFuture`\\ s; double-buffered step pipelines (pack step
+  *k+1* while *k* runs) fall out of the ``pack=`` stage for free.
+* :class:`RuntimeConfig` (``engine/config.py``) — every env-gated
+  runtime knob (``obs``/``guard``/``cluster``/``elastic``) parsed in
+  ONE place and snapshotted once at engine construction.
+* :func:`spawn_thread` (``engine/threads.py``) — the single thread
+  construction choke point ``pa-lint``'s ``thread-spawn`` check
+  enforces repo-wide.
+
+First client: the serve layer (``serve/service.py``) feeds its
+admission queue into the engine instead of running its own polling
+daemon, and ``PlanService.certify(engine=...)`` statically proves the
+pipelined dispatch trace equals the serialized schedule via
+``analysis.spmd.verify_dispatch_log``.  See ``docs/Executor.md``.
+"""
+
+from __future__ import annotations
+
+from .config import RuntimeConfig, current as current_config  # noqa: F401
+from .errors import (  # noqa: F401
+    EngineClosedError,
+    EngineError,
+    EngineReformedError,
+    EngineTaskError,
+)
+from .executor import (  # noqa: F401
+    DispatchRecord,
+    Engine,
+    StepFuture,
+    engines,
+    get_engine,
+    quiesce_all,
+    reform_all,
+    resume_all,
+    shutdown_all,
+)
+from .threads import spawn_thread, spawned  # noqa: F401
+
+__all__ = [
+    "Engine",
+    "StepFuture",
+    "DispatchRecord",
+    "RuntimeConfig",
+    "current_config",
+    "get_engine",
+    "engines",
+    "quiesce_all",
+    "reform_all",
+    "resume_all",
+    "shutdown_all",
+    "spawn_thread",
+    "spawned",
+    "EngineError",
+    "EngineClosedError",
+    "EngineTaskError",
+    "EngineReformedError",
+]
+
+
+def _reset_for_tests() -> None:
+    """Close every registered engine and drop the config cache (tests
+    toggle env vars and mesh state between cases)."""
+    from . import config as _config
+    from . import executor as _executor
+
+    _executor._reset_for_tests()
+    _config._reset_for_tests()

@@ -1,0 +1,53 @@
+"""Typed errors of the per-mesh task-graph executor (``engine/``).
+
+The engine's failure contract mirrors the serve layer's: a failure is
+scoped to the narrowest unit it poisons — ONE step future — and the
+queue keeps draining.  A worker-pool exception must never wedge the
+dispatch consumer (every later future would hang with no symptom), and
+a dispatch enqueued into a closed or reformed engine must fail typed,
+not strand its waiter.
+"""
+
+from __future__ import annotations
+
+__all__ = ["EngineError", "EngineClosedError", "EngineTaskError",
+           "EngineReformedError"]
+
+
+class EngineError(RuntimeError):
+    """Base class of every engine-layer error."""
+
+
+class EngineClosedError(EngineError):
+    """Submit after :meth:`~pencilarrays_tpu.engine.Engine.close` (or a
+    pending task failed because the engine closed under it)."""
+
+
+class EngineTaskError(EngineError):
+    """A host-pool task (a step's pack stage, or a standalone
+    :meth:`~pencilarrays_tpu.engine.Engine.host_task`) raised.  The
+    original exception is chained as ``__cause__`` and kept on
+    ``.cause``; ``.label`` names the task and ``.stage`` which pool
+    stage failed (``"pack"`` | ``"host"``).  The dispatch consumer
+    fails ONLY this task's future and keeps draining the queue — a
+    worker bug costs one step, never the engine."""
+
+    def __init__(self, label: str, stage: str, cause: BaseException):
+        self.label = label
+        self.stage = stage
+        self.cause = cause
+        super().__init__(
+            f"{stage} task {label!r} failed: "
+            f"{type(cause).__name__}: {cause}")
+        self.__cause__ = cause
+
+
+class EngineReformedError(EngineError):
+    """A queued dispatch was failed by an elastic mesh reformation: the
+    device program it would have issued was compiled for a mesh that no
+    longer exists.  Resubmit against the reformed mesh (named serve
+    plans re-bind automatically; see ``docs/Elastic.md``)."""
+
+    def __init__(self, msg: str, *, generation: int):
+        super().__init__(msg)
+        self.generation = generation

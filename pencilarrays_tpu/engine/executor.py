@@ -1,0 +1,609 @@
+"""The per-mesh task-graph executor — ordered dispatch, host overlap.
+
+PR 5 made every runtime arm **sync-per-dispatch** to dodge a
+CPU-backend rendezvous deadlock: two host threads racing collective
+dispatches onto one mesh could interleave their program launches, and
+two ranks disagreeing about launch order deadlock inside the exchange.
+Correct — but it surrendered async pipelining, and everything built
+since contends on the main thread: checkpoint serialization, guard
+probe readback, drift sampling and serve batch packing all run between
+dispatches while the device sits idle (see the post-mortem in
+``docs/Executor.md``).
+
+This module recovers the overlap WITHOUT reopening the deadlock class,
+the DaggerFFT way (arXiv:2601.12209 — distributed FFT stages as an
+async task DAG):
+
+* **one ordered dispatch queue per engine** — a single consumer thread
+  issues every device dispatch in enqueue order.  The SPMD ordering
+  invariant ("every rank issues the same collectives in the same
+  order") holds *by construction*: there is exactly one issuer and it
+  never reorders.  ``analysis.spmd.verify_dispatch_log`` proves it
+  after the fact (issue order == enqueue order, op-for-op trace ==
+  prediction) — the static certification PR 11 built this for;
+* **a host task pool** that runs everything that does NOT touch the
+  mesh — step packing, checkpoint serialization, probe readback, drift
+  sampling — concurrently with the consumer's current dispatch.  A
+  step submitted with a ``pack`` stage has its operand built on the
+  pool while the PREVIOUS step's device program runs: double-buffered
+  step pipelines fall out for free;
+* **steps are futures** — :meth:`Engine.submit` returns a
+  :class:`StepFuture`; failures are scoped to one future and the queue
+  keeps draining (a worker-pool exception becomes a typed
+  :class:`~pencilarrays_tpu.engine.errors.EngineTaskError`, never a
+  wedged consumer).
+
+The engine resolves its :class:`~pencilarrays_tpu.engine.config.
+RuntimeConfig` once at construction — zero per-dispatch env reads —
+and re-resolves only at an explicit :meth:`Engine.reform` (the elastic
+reformation boundary: ``cluster/elastic.py`` quiesces every engine
+before membership changes and reforms them after re-planning).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from . import config as _config
+from .errors import (
+    EngineClosedError,
+    EngineReformedError,
+    EngineTaskError,
+)
+from .threads import spawn_thread
+
+__all__ = ["StepFuture", "DispatchRecord", "Engine", "get_engine",
+           "engines", "quiesce_all", "reform_all", "resume_all",
+           "shutdown_all"]
+
+_NO_OPERAND = object()
+_MAX_LOG = 4096
+
+
+class StepFuture:
+    """One submitted task's future: :meth:`result` blocks until the
+    engine resolved it; typed errors re-raise here.  Callbacks run on
+    the resolving engine thread and must be cheap + non-raising (a
+    raising callback is swallowed and counted, never allowed to kill
+    the consumer)."""
+
+    def __init__(self, label: str = "step"):
+        self.label = label
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._callbacks: List[Callable] = []
+        self._cb_lock = threading.Lock()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"step {self.label!r} not done")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    def add_done_callback(self, fn: Callable[["StepFuture"], None]) -> None:
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        self._run_callback(fn)
+
+    def _run_callback(self, fn) -> None:
+        try:
+            fn(self)
+        except Exception:
+            from .. import obs
+
+            if obs.enabled():
+                obs.counter("engine.callback_errors").inc()
+
+    def _resolve(self, result, error: Optional[BaseException]) -> None:
+        with self._cb_lock:
+            self._result = result
+            self._error = error
+            self._event.set()
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            self._run_callback(fn)
+
+    def _fulfill(self, result) -> None:
+        self._resolve(result, None)
+
+    def _fail(self, error: BaseException) -> None:
+        self._resolve(None, error)
+
+
+@dataclass(frozen=True)
+class DispatchRecord:
+    """One issued dispatch, in issue order — what
+    ``analysis.spmd.verify_dispatch_log`` certifies against the
+    enqueue order and the ``collective_costs`` predictions."""
+
+    enqueue_seq: int
+    issue_seq: int
+    label: str
+    outcome: str                    # "ok" | error type name
+    queued_s: float
+    run_s: float
+    meta: dict = field(default_factory=dict)
+
+
+@dataclass
+class _Task:
+    seq: int
+    label: str
+    run: Callable
+    future: StepFuture
+    pack_future: Optional[StepFuture]
+    meta: dict
+    t_enqueue: float
+
+
+@dataclass
+class _HostItem:
+    fn: Callable
+    future: StepFuture
+    label: str
+    stage: str                      # "pack" | "host"
+
+
+class Engine:
+    """The per-mesh executor (module docstring).
+
+    Parameters
+    ----------
+    name:
+        Registry / thread-name label.  :func:`get_engine` maintains one
+        shared engine per name; direct construction makes a private one.
+    workers:
+        Host-pool width (default: the snapshot's ``engine_workers``,
+        env knob ``PENCILARRAYS_TPU_ENGINE_WORKERS``).
+    config:
+        Explicit :class:`~pencilarrays_tpu.engine.config.RuntimeConfig`
+        (default: ``config.current()`` — resolved ONCE, here).
+    """
+
+    def __init__(self, name: str = "engine", *,
+                 workers: Optional[int] = None,
+                 config: Optional[_config.RuntimeConfig] = None):
+        self.name = name
+        self.config = config if config is not None else _config.current()
+        self._workers = int(workers) if workers else \
+            self.config.engine_workers
+        self._cv = threading.Condition()
+        self._gen = 0
+        self._closed = False
+        self._paused = False
+        self._busy = False              # consumer mid-dispatch
+        self._tasks: deque = deque()
+        self._timers: list = []         # heap of (deadline, seq, fn)
+        self._host_q: deque = deque()
+        self._host_busy = 0
+        self._dispatch_thread = None
+        self._host_threads: list = []
+        self._enq = itertools.count(1)
+        self._timer_seq = itertools.count(1)
+        self._issue_seq = 0
+        self._log: deque = deque(maxlen=_MAX_LOG)
+        self._dispatched = 0
+        self._host_done = 0
+        self._dispatch_busy_s = 0.0
+        self._host_busy_s = 0.0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def generation(self) -> int:
+        """Bumped by every :meth:`reform` (0 = the construction mesh)."""
+        with self._cv:
+            return self._gen
+
+    @property
+    def accepting(self) -> bool:
+        """False while closed or quiesced — pump-style clients defer
+        submission instead of feeding a held queue."""
+        with self._cv:
+            return not (self._closed or self._paused)
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._tasks) + (1 if self._busy else 0)
+
+    def dispatch_log(self) -> List[DispatchRecord]:
+        """Issue-ordered dispatch records — a BOUNDED history (the last
+        ``log_capacity`` dispatches; check :meth:`stats`'s
+        ``log_truncated`` before claiming the log covers a whole
+        run)."""
+        with self._cv:
+            return list(self._log)
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "name": self.name,
+                "generation": self._gen,
+                "queued": len(self._tasks),
+                "busy": self._busy,
+                "host_queued": len(self._host_q),
+                "host_busy": self._host_busy,
+                "dispatched": self._dispatched,
+                "host_tasks": self._host_done,
+                "dispatch_busy_s": self._dispatch_busy_s,
+                "host_busy_s": self._host_busy_s,
+                "workers": self._workers,
+                "log_capacity": _MAX_LOG,
+                "log_truncated": self._dispatched > len(self._log),
+            }
+
+    # -- submission --------------------------------------------------------
+    def submit(self, run: Callable, *, pack: Optional[Callable] = None,
+               label: str = "step", meta: Optional[dict] = None
+               ) -> StepFuture:
+        """Enqueue one device dispatch; returns its future.
+
+        ``run`` issues the device work (the ONLY place collective
+        programs may be launched) and executes on the consumer thread
+        in strict enqueue order.  ``pack`` (optional) builds the
+        operand on the host pool, overlapped with earlier dispatches;
+        its return value becomes ``run``'s single argument (without
+        ``pack``, ``run`` is called with no arguments).  A ``pack``
+        failure fails THIS future typed and the consumer moves on.
+
+        ``meta`` is held BY REFERENCE and snapshotted into the
+        dispatch log only after ``run`` returns — a task whose shape
+        is unknown at submit time (e.g. ``forward_async``'s pack form)
+        may complete its own certification metadata from inside
+        ``run``."""
+        fut = StepFuture(label)
+        with self._cv:
+            if self._closed:
+                raise EngineClosedError(
+                    f"engine {self.name!r} is closed")
+            pf = None
+            if pack is not None:
+                pf = self._offer_host_locked(pack, label, "pack")
+            self._tasks.append(_Task(
+                seq=next(self._enq), label=label, run=run, future=fut,
+                pack_future=pf, meta=meta if meta is not None else {},
+                t_enqueue=time.monotonic()))
+            self._ensure_threads_locked()
+            self._cv.notify_all()
+        return fut
+
+    def host_task(self, fn: Callable, *, label: str = "host"
+                  ) -> StepFuture:
+        """Run ``fn`` on the host pool (checkpoint serialization, probe
+        readback, drift sampling — anything that never launches a
+        collective), overlapped with the dispatch queue.  Failures
+        surface as typed :class:`EngineTaskError` on the future."""
+        with self._cv:
+            if self._closed:
+                raise EngineClosedError(
+                    f"engine {self.name!r} is closed")
+            fut = self._offer_host_locked(fn, label, "host")
+            self._ensure_threads_locked()
+            self._cv.notify_all()
+        return fut
+
+    def call_later(self, delay_s: float, fn: Callable, *,
+                   label: str = "timer") -> None:
+        """Run cheap ``fn`` on the consumer thread after ``delay_s``
+        (the serve pump's deadline-coalescing tick — replaces the old
+        polling daemon).  Timers are held while quiesced and DROPPED by
+        a reform (their scheduling state died with the old mesh: the
+        client re-pumps on its next submission)."""
+        with self._cv:
+            if self._closed:
+                raise EngineClosedError(f"engine {self.name!r} is closed")
+            heapq.heappush(self._timers, (
+                time.monotonic() + max(0.0, float(delay_s)),
+                next(self._timer_seq), fn))
+            self._ensure_threads_locked()
+            self._cv.notify_all()
+
+    def _offer_host_locked(self, fn, label, stage) -> StepFuture:
+        fut = StepFuture(label)
+        self._host_q.append(_HostItem(fn=fn, future=fut, label=label,
+                                      stage=stage))
+        return fut
+
+    # -- lifecycle ---------------------------------------------------------
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until the dispatch queue, timers' backlog and host
+        pool are all idle.  Returns False on timeout.  (Pending timers
+        themselves do not block a drain — they fire work later; a drain
+        waits for work already *submitted*.)"""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cv:
+            while (self._tasks or self._busy or self._host_q
+                   or self._host_busy):
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                self._cv.wait(remaining)
+        return True
+
+    def quiesce(self, timeout: Optional[float] = None) -> bool:
+        """Pause the consumer at the next task boundary: no new device
+        dispatch starts until :meth:`resume` (queued tasks are HELD,
+        not failed).  Blocks until the in-flight dispatch finishes
+        (bounded by ``timeout``, default the snapshot's
+        ``engine_quiesce_s``); returns False if it is still running."""
+        t = self.config.engine_quiesce_s if timeout is None else timeout
+        deadline = time.monotonic() + t
+        with self._cv:
+            self._paused = True
+            self._cv.notify_all()
+            while self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def reform(self, config: Optional[_config.RuntimeConfig] = None,
+               *, timeout: Optional[float] = None) -> int:
+        """The elastic reformation boundary: quiesce, fail every
+        still-queued dispatch typed (:class:`EngineReformedError` — the
+        program it would have issued was compiled for the dead mesh),
+        drop timers, retire the old consumer/pool threads, take a
+        FRESH :class:`RuntimeConfig` snapshot, and resume under a new
+        generation.  Returns the new generation."""
+        self.quiesce(timeout)
+        with self._cv:
+            self._gen += 1
+            gen = self._gen
+            pending = list(self._tasks)
+            self._tasks.clear()
+            host_pending = [h for h in self._host_q]
+            self._host_q.clear()
+            self._timers.clear()
+            self.config = config if config is not None \
+                else _config.current()
+            self._workers = self.config.engine_workers
+            self._dispatch_thread = None
+            self._host_threads = []
+            self._paused = False
+            self._cv.notify_all()
+        err = EngineReformedError(
+            f"engine {self.name!r} reformed to generation {gen}: "
+            f"queued dispatch dropped (its compiled program targeted "
+            f"the previous mesh)", generation=gen)
+        for t in pending:
+            t.future._fail(err)
+        for h in host_pending:
+            h.future._fail(EngineTaskError(h.label, h.stage, err))
+        from .. import obs
+
+        if obs.enabled():
+            obs.counter("engine.reforms").inc()
+            obs.record_event("engine.reform", gen=gen, stage="complete",
+                             name=self.name, dropped=len(pending),
+                             dropped_host=len(host_pending))
+        return gen
+
+    def close(self) -> None:
+        """Refuse new work, fail everything queued typed, retire the
+        threads.  In-flight work finishes (its future resolves)."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            pending = list(self._tasks)
+            self._tasks.clear()
+            host_pending = list(self._host_q)
+            self._host_q.clear()
+            self._timers.clear()
+            self._cv.notify_all()
+        err = EngineClosedError(f"engine {self.name!r} closed")
+        for t in pending:
+            t.future._fail(err)
+        for h in host_pending:
+            h.future._fail(EngineTaskError(h.label, h.stage, err))
+
+    # -- the consumer + pool ----------------------------------------------
+    def _ensure_threads_locked(self) -> None:
+        gen = self._gen
+        if self._dispatch_thread is None or not \
+                self._dispatch_thread.is_alive():
+            self._dispatch_thread = spawn_thread(
+                self._loop_dispatch, args=(gen,),
+                name=f"pa-engine-{self.name}-dispatch-g{gen}")
+        self._host_threads = [t for t in self._host_threads
+                              if t.is_alive()]
+        want = self._workers
+        need = min(want - len(self._host_threads),
+                   len(self._host_q) + 1)
+        for i in range(max(0, need)):
+            self._host_threads.append(spawn_thread(
+                self._loop_host, args=(gen,),
+                name=f"pa-engine-{self.name}-host{len(self._host_threads)}"
+                     f"-g{gen}"))
+
+    def _loop_dispatch(self, gen: int) -> None:
+        while True:
+            timer_fn = None
+            task = None
+            with self._cv:
+                while True:
+                    if self._closed or gen != self._gen:
+                        return
+                    now = time.monotonic()
+                    if not self._paused and self._timers \
+                            and self._timers[0][0] <= now:
+                        timer_fn = heapq.heappop(self._timers)[2]
+                        break
+                    if not self._paused and self._tasks:
+                        task = self._tasks.popleft()
+                        self._busy = True
+                        break
+                    wait = None
+                    if self._timers and not self._paused:
+                        wait = max(0.0, self._timers[0][0] - now)
+                    self._cv.wait(wait)
+            if timer_fn is not None:
+                try:
+                    timer_fn()
+                except Exception:
+                    from .. import obs
+
+                    if obs.enabled():
+                        obs.counter("engine.timer_errors").inc()
+                continue
+            self._run_task(task)
+
+    def _run_task(self, task: _Task) -> None:
+        t0 = time.monotonic()
+        out, err = None, None
+        operand = _NO_OPERAND
+        if task.pack_future is not None:
+            # head-of-line wait: ordering REQUIRES issuing in enqueue
+            # order, so a slow pack stalls the queue behind it — the
+            # price of the invariant (packs for later steps keep
+            # running on the pool meanwhile)
+            task.pack_future._event.wait()
+            perr = task.pack_future.error()
+            if perr is not None:
+                err = perr
+            else:
+                operand = task.pack_future._result
+        if err is None:
+            try:
+                out = (task.run() if operand is _NO_OPERAND
+                       else task.run(operand))
+            except BaseException as e:
+                # NEVER re-raise on the consumer: a dead consumer
+                # strands every queued future with no symptom.  The
+                # waiter re-raises from the future (KeyboardInterrupt
+                # included — the synchronous paths surface it).
+                err = e
+        t1 = time.monotonic()
+        with self._cv:
+            self._busy = False
+            self._issue_seq += 1
+            self._dispatched += 1
+            self._dispatch_busy_s += t1 - t0
+            self._log.append(DispatchRecord(
+                enqueue_seq=task.seq, issue_seq=self._issue_seq,
+                label=task.label,
+                outcome="ok" if err is None else type(err).__name__,
+                queued_s=t0 - task.t_enqueue, run_s=t1 - t0,
+                meta=task.meta))
+            self._cv.notify_all()
+        if err is None:
+            task.future._fulfill(out)
+        else:
+            task.future._fail(err)
+
+    def _loop_host(self, gen: int) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    if self._closed or gen != self._gen:
+                        return
+                    if self._host_q:
+                        item = self._host_q.popleft()
+                        self._host_busy += 1
+                        break
+                    self._cv.wait()
+            t0 = time.monotonic()
+            out, err = None, None
+            try:
+                out = item.fn()
+            except BaseException as e:
+                err = EngineTaskError(item.label, item.stage, e)
+            t1 = time.monotonic()
+            with self._cv:
+                self._host_busy -= 1
+                self._host_done += 1
+                self._host_busy_s += t1 - t0
+                self._cv.notify_all()
+            if err is None:
+                item.future._fulfill(out)
+            else:
+                item.future._fail(err)
+
+
+# ---------------------------------------------------------------------------
+# the per-process engine registry (one shared engine per name)
+# ---------------------------------------------------------------------------
+
+_registry_lock = threading.Lock()
+_engines: Dict[str, Engine] = {}
+
+
+def get_engine(name: str = "default") -> Engine:
+    """The process's shared engine under ``name`` (built lazily).  One
+    mesh should funnel through ONE engine — the ordering guarantee is
+    per-queue — so clients default to the shared ``"default"`` engine
+    unless they own a genuinely separate mesh."""
+    with _registry_lock:
+        e = _engines.get(name)
+        if e is None or e._closed:
+            e = Engine(name)
+            _engines[name] = e
+        return e
+
+
+def engines() -> Dict[str, Engine]:
+    with _registry_lock:
+        return dict(_engines)
+
+
+def quiesce_all(timeout: Optional[float] = None) -> bool:
+    """Quiesce every registered engine (elastic calls this BEFORE
+    membership consensus: no dispatch may be mid-flight while the mesh
+    changes under it).  Returns False if any in-flight dispatch did not
+    finish in time."""
+    ok = True
+    for e in engines().values():
+        ok = e.quiesce(timeout) and ok
+    return ok
+
+
+def reform_all(config: Optional[_config.RuntimeConfig] = None) -> int:
+    """Reform every registered engine (elastic calls this after
+    re-planning: the reindexed coordinator gets fresh engines).
+    Returns how many engines were reformed."""
+    es = engines()
+    for e in es.values():
+        e.reform(config)
+    return len(es)
+
+
+def resume_all() -> None:
+    """Resume every registered engine (the failed-reformation path:
+    the old mesh is still the live one)."""
+    for e in engines().values():
+        e.resume()
+
+
+def shutdown_all() -> None:
+    for e in engines().values():
+        e.close()
+    with _registry_lock:
+        _engines.clear()
+
+
+def _reset_for_tests() -> None:
+    shutdown_all()

@@ -1,0 +1,60 @@
+"""The ONE place the runtime constructs threads.
+
+Every long-lived thread in this tree — the engine's dispatch consumer
+and host workers, the guard's watchdog monitor, the cluster heartbeat,
+the obs aggregator — is born here, through :func:`spawn_thread`.  The
+``pa-lint`` ``thread-spawn`` check (``analysis/lint.py``) enforces it:
+raw ``threading.Thread(...)`` construction outside ``engine/`` is a
+lint finding, so a new daemon cannot appear anywhere else without
+showing up in review.  Centralizing construction buys three things:
+
+* **naming discipline** — every runtime thread carries a ``pa-``
+  prefixed name, so a stack dump (crash bundles snapshot all threads)
+  attributes each one to its subsystem;
+* **inventory** — :func:`spawned` lists what this process has started,
+  which the engine's quiesce/reform path and tests introspect;
+* **a single choke point** — if thread creation ever needs to change
+  process-wide (pinning, instrumentation, an interpreter without
+  threads), it changes here.
+
+Threads are daemonic by default: nothing in this tree may hold the
+interpreter alive — shutdown is owned by explicit ``stop``/``close``
+calls, never by a join at exit.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+__all__ = ["spawn_thread", "spawned"]
+
+_lock = threading.Lock()
+_spawned: List[str] = []        # names, most recent last (bounded)
+_MAX_NAMES = 512
+
+
+def spawn_thread(target: Callable, *, name: str, daemon: bool = True,
+                 args: tuple = (), kwargs: Optional[dict] = None
+                 ) -> threading.Thread:
+    """Construct AND start one named runtime thread.
+
+    ``name`` is required (anonymous ``Thread-N`` names make crash-bundle
+    stack dumps unreadable) and should carry the ``pa-`` subsystem
+    prefix convention (``pa-engine-…``, ``pa-guard-watchdog``,
+    ``pa-cluster-lease-r0``...).  Returns the started thread."""
+    t = threading.Thread(target=target, name=name, daemon=daemon,
+                         args=args, kwargs=kwargs or {})
+    with _lock:
+        _spawned.append(name)
+        if len(_spawned) > _MAX_NAMES:
+            del _spawned[: _MAX_NAMES // 2]
+    t.start()
+    return t
+
+
+def spawned() -> List[str]:
+    """Names of every thread this process has spawned through the choke
+    point (bounded history, most recent last)."""
+    with _lock:
+        return list(_spawned)

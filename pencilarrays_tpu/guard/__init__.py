@@ -100,28 +100,21 @@ _OFF_VALUES = ("", "0", "off", "false")
 _lock = threading.Lock()
 _override: Optional[bool] = None      # programmatic enable()/disable()
 _override_dir: Optional[str] = None
-_env_cache: Optional[str] = None
-_env_on = False
 _finite_counter = 0
-
-
-def _env_enabled() -> bool:
-    """Re-read ``ENV_VAR`` on change (workers arm late, like faults)."""
-    global _env_cache, _env_on
-    env = os.environ.get(ENV_VAR, "")
-    if env != _env_cache:
-        _env_cache = env
-        _env_on = env not in _OFF_VALUES
-    return _env_on
 
 
 def enabled() -> bool:
     """THE gate every guarded call site probes first.  One branch + one
-    cached env lookup on the disabled path — no probe ops are traced,
-    no watchdog is armed, nothing is allocated unless this is True."""
+    cached snapshot probe on the disabled path — no probe ops are
+    traced, no watchdog is armed, nothing is allocated unless this is
+    True.  The env value rides the engine's shared
+    :class:`~pencilarrays_tpu.engine.config.RuntimeConfig` snapshot,
+    which re-resolves on change (workers arm late, like faults)."""
     if _override is not None:
         return _override
-    return _env_enabled()
+    from ..engine import config as _rtc
+
+    return _rtc.current().guard_on
 
 
 def enable(bundle_directory: Optional[str] = None) -> None:
@@ -145,49 +138,51 @@ def disable() -> None:
 
 
 def _reset_for_tests() -> None:
-    """Full gate reset: drop overrides AND the env cache (tests toggle
-    the env between cases; production code never needs this).  Also
-    resets the crash-bundle cap, so a test file's many drilled
-    detections cannot starve a later test of its bundle."""
-    global _override, _override_dir, _env_cache, _env_on, _finite_counter
+    """Full gate reset: drop overrides AND the shared config snapshot
+    (tests toggle the env between cases; production code never needs
+    this).  Also resets the crash-bundle cap, so a test file's many
+    drilled detections cannot starve a later test of its bundle."""
+    global _override, _override_dir, _finite_counter
     with _lock:
         _override = None
         _override_dir = None
-        _env_cache = None
-        _env_on = False
         _finite_counter = 0
+    from ..engine import config as _rtc
     from . import bundle as _bundle
 
+    _rtc._reset_for_tests()
     _bundle._reset_for_tests()
 
 
 def bundle_dir() -> str:
-    """Resolved crash-bundle directory for the current configuration."""
+    """Resolved crash-bundle directory for the current configuration
+    (knob parsing lives in ``engine/config.py``: a non-``1``/``on``
+    gate value is itself the directory)."""
     if _override_dir:
         return _override_dir
-    env = os.environ.get(ENV_VAR, "")
-    if env not in _OFF_VALUES + ("1", "on", "true"):
-        return env
-    return os.environ.get(DIR_VAR, DEFAULT_DIR)
+    from ..engine import config as _rtc
+
+    cfg = _rtc.current()
+    if cfg.guard_env not in _OFF_VALUES + ("1", "on", "true"):
+        return cfg.guard_env
+    return cfg.guard_dir_env
 
 
 def hang_timeout() -> float:
     """Watchdog deadline in seconds (``0`` disables the watchdog while
     leaving the invariant probes armed)."""
-    try:
-        return float(os.environ.get(TIMEOUT_VAR, DEFAULT_TIMEOUT))
-    except ValueError:
-        return DEFAULT_TIMEOUT
+    from ..engine import config as _rtc
+
+    return _rtc.current().guard_timeout
 
 
 def finite_every() -> int:
     """Finiteness-tap sampling period: probe every Nth guarded dispatch
     (``0`` = tap off; the content-sum probe still catches NaN births on
     pure-movement hops, since NaN poisons the post sum)."""
-    try:
-        return max(0, int(os.environ.get(FINITE_VAR, "0")))
-    except ValueError:
-        return 0
+    from ..engine import config as _rtc
+
+    return _rtc.current().guard_finite_every
 
 
 def finite_tick() -> bool:

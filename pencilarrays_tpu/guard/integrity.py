@@ -32,7 +32,6 @@ applies to a hop's output (``hop.exchange``) or a restored dataset
 from __future__ import annotations
 
 import math
-import os
 from functools import lru_cache
 from typing import Optional, Tuple
 
@@ -104,12 +103,11 @@ def _default_rtol(count: int, dtype) -> float:
     (pairwise-ish) reduction tree plus safety margin."""
     if not np.issubdtype(np.dtype(dtype), np.inexact):
         return 0.0
-    env = os.environ.get("PENCILARRAYS_TPU_GUARD_RTOL", "")
-    if env:
-        try:
-            return float(env)
-        except ValueError:
-            pass
+    from ..engine import config as _rtc
+
+    rtol = _rtc.current().guard_rtol     # PENCILARRAYS_TPU_GUARD_RTOL
+    if rtol is not None:
+        return rtol
     import jax
 
     eps = (np.finfo(np.float64).eps if jax.config.jax_enable_x64

@@ -75,9 +75,9 @@ def _ensure_monitor() -> None:
     if _monitor_started:
         return
     _monitor_started = True
-    t = threading.Thread(target=_monitor_loop, name="pa-guard-watchdog",
-                         daemon=True)
-    t.start()
+    from ..engine.threads import spawn_thread
+
+    spawn_thread(_monitor_loop, name="pa-guard-watchdog")
 
 
 def _monitor_loop() -> None:

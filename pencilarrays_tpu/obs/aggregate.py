@@ -53,11 +53,11 @@ DEFAULT_CADENCE_S = 10.0
 
 
 def agg_cadence() -> float:
-    """Publish/fold cadence in seconds (0 = aggregation disabled)."""
-    try:
-        return float(os.environ.get(AGG_CADENCE_VAR, DEFAULT_CADENCE_S))
-    except ValueError:
-        return DEFAULT_CADENCE_S
+    """Publish/fold cadence in seconds (0 = aggregation disabled;
+    parsing lives in ``engine/config.py``)."""
+    from ..engine import config as _rtc
+
+    return _rtc.current().obs_agg_cadence
 
 
 def fold_snapshots(snaps: Dict[int, dict], *,
@@ -319,10 +319,10 @@ class MeshAggregator:
     def start(self) -> None:
         if self._thread is not None:
             return
-        t = threading.Thread(target=self._loop, daemon=True,
-                             name=f"pa-obs-agg-r{self.rank}")
-        self._thread = t
-        t.start()
+        from ..engine.threads import spawn_thread
+
+        self._thread = spawn_thread(self._loop,
+                                    name=f"pa-obs-agg-r{self.rank}")
 
     def _loop(self) -> None:
         # alignment burst: both sides run a dense beacon window at

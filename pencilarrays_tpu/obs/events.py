@@ -108,8 +108,6 @@ CRITICAL_EVENTS = frozenset({
 _lock = threading.Lock()
 _override: Optional[bool] = None     # programmatic enable()/disable()
 _override_dir: Optional[str] = None
-_env_cache: Optional[str] = None
-_env_on = False
 _run_id: Optional[str] = None
 _file = None
 _file_dir: Optional[str] = None
@@ -117,23 +115,18 @@ _file_proc: Optional[int] = None
 _seq = 0
 
 
-def _env_enabled() -> bool:
-    """Re-read ``ENV_VAR`` on change (workers arm late, like faults)."""
-    global _env_cache, _env_on
-    env = os.environ.get(ENV_VAR, "")
-    if env != _env_cache:
-        _env_cache = env
-        _env_on = env not in ("", "0", "off", "false")
-    return _env_on
-
-
 def enabled() -> bool:
     """THE gate every instrumented call site probes first.  One branch +
-    one cached env lookup on the disabled path — payloads are never
-    built unless this returns True."""
+    one cached snapshot probe on the disabled path — payloads are never
+    built unless this returns True.  The env value rides the engine's
+    shared :class:`~pencilarrays_tpu.engine.config.RuntimeConfig`
+    snapshot, which re-resolves on change (workers arm late, like
+    faults)."""
     if _override is not None:
         return _override
-    return _env_enabled()
+    from ..engine import config as _rtc
+
+    return _rtc.current().obs_on
 
 
 def enable(directory: Optional[str] = None) -> None:
@@ -161,30 +154,34 @@ def disable() -> None:
 
 
 def _reset_for_tests() -> None:
-    """Full reset: drop overrides AND the env cache (tests toggle the
-    env between cases; production code never needs this)."""
-    global _override, _override_dir, _env_cache, _env_on, _run_id, _seq
+    """Full reset: drop overrides AND the shared config snapshot (tests
+    toggle the env between cases; production code never needs this)."""
+    global _override, _override_dir, _run_id, _seq
     with _lock:
         _close_locked()
         _override = None
         _override_dir = None
-        _env_cache = None
-        _env_on = False
         _run_id = None
         _seq = 0
+    from ..engine import config as _rtc
     from . import correlate
 
+    _rtc._reset_for_tests()
     correlate._reset_for_tests()
 
 
 def journal_dir() -> str:
-    """Resolved journal directory for the current configuration."""
+    """Resolved journal directory for the current configuration (knob
+    parsing lives in ``engine/config.py``: a non-``1``/``on`` gate
+    value is itself the directory)."""
     if _override_dir:
         return _override_dir
-    env = os.environ.get(ENV_VAR, "")
-    if env not in ("", "0", "1", "on", "true", "off", "false"):
-        return env
-    return os.environ.get(DIR_VAR, DEFAULT_DIR)
+    from ..engine import config as _rtc
+
+    cfg = _rtc.current()
+    if cfg.obs_env not in ("", "0", "1", "on", "true", "off", "false"):
+        return cfg.obs_env
+    return cfg.obs_dir_env
 
 
 def run_id() -> str:
@@ -331,20 +328,18 @@ def _json_safe(v):
 
 
 def _fsync_policy() -> str:
-    return os.environ.get(FSYNC_VAR, "critical")
+    from ..engine import config as _rtc
+
+    return _rtc.current().obs_fsync      # PENCILARRAYS_TPU_OBS_FSYNC
 
 
 def _max_bytes() -> Optional[int]:
     """Rotation cap from ``PENCILARRAYS_TPU_OBS_MAX_MB`` (None = never
-    rotate, the pre-PR-7 behavior)."""
-    v = os.environ.get(MAX_MB_VAR)
-    if not v:
-        return None
-    try:
-        mb = float(v)
-    except ValueError:
-        return None
-    return int(mb * 1024 * 1024) if mb > 0 else None
+    rotate, the pre-PR-7 behavior; parsing lives in
+    ``engine/config.py``)."""
+    from ..engine import config as _rtc
+
+    return _rtc.current().obs_max_bytes
 
 
 def _rotate_locked() -> None:

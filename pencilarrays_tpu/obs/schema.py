@@ -89,6 +89,10 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     "serve.coalesce": ("key", "n", "reqs", "reason", "wait_s"),
     "serve.dispatch": ("key", "n", "tenants", "score_bytes", "reason"),
     "serve.complete": ("tenant", "req", "outcome", "seconds", "key"),
+    # per-mesh task-graph executor (engine/): one record per engine
+    # reformation boundary (queued dispatches dropped typed, fresh
+    # RuntimeConfig snapshot, new generation)
+    "engine.reform": ("gen", "stage"),
     # static analysis (analysis/): one record per certification —
     # ``PlanService.certify()`` registry sweeps, pa-lint SPMD runs and
     # direct ``certify_plan`` calls; non-ok outcomes are fsync-critical

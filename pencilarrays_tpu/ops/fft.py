@@ -1412,6 +1412,72 @@ class PencilFFTPlan:
         self._guard_tap_post(tap, "fft.backward", x)
         return x
 
+    def forward_async(self, u: Optional[PencilArray] = None, *,
+                      pack=None, engine=None, donate: bool = False):
+        """Submit one forward transform as an ordered engine dispatch;
+        returns its :class:`~pencilarrays_tpu.engine.StepFuture` — the
+        step-as-future form (DaggerFFT's task-graph shape) an
+        application loop pipelines with.
+
+        Exactly one of ``u``/``pack``: ``u`` is a ready
+        :class:`PencilArray` (dispatch only), ``pack`` is a zero-arg
+        callable run on the engine's HOST pool returning the sample in
+        the plan's global logical shape — built while the previous
+        step's device program runs (double-buffered step pipelines:
+        submit step *k+1*'s ``pack`` while *k* computes).  The consumer
+        thread scatters it (``from_global``) and issues the transform
+        chain, so device work never leaves the ordered queue.  Engine
+        defaults to the process's shared one."""
+        return self._submit_async("forward", u, pack, engine, donate)
+
+    def backward_async(self, uh: Optional[PencilArray] = None, *,
+                       pack=None, engine=None, donate: bool = False):
+        """The mirrored :meth:`forward_async` (spectral -> physical;
+        a ``pack`` callable returns the spectral-shape host sample)."""
+        return self._submit_async("backward", uh, pack, engine, donate)
+
+    def _submit_async(self, direction: str, u, pack, engine,
+                      donate: bool):
+        import numpy as np
+
+        from ..engine import get_engine
+
+        eng = engine if engine is not None else get_engine()
+        if (u is None) == (pack is None):
+            raise ValueError(
+                f"{direction}_async needs exactly one of u= (a ready "
+                f"PencilArray) or pack= (a host-pool operand builder)")
+        fwd = direction == "forward"
+        run_plan = self.forward if fwd else self.backward
+        label = f"fft.{direction}:{self.plan_key()[:8]}"
+        if pack is None:
+            return eng.submit(lambda: run_plan(u, donate=donate),
+                              label=label,
+                              meta={"plan": self, "direction": direction,
+                                    "extra_dims": u.extra_dims})
+        pen = self.input_pencil if fwd else self.output_pencil
+        dt = self.dtype_physical if fwd else self.dtype_spectral
+        base_ndim = len(self.shape_physical)
+        # the pack form's batch is unknown until pack runs: the
+        # dispatch's certification metadata is completed INSIDE run
+        # (the engine's DispatchRecord holds this same dict and only
+        # snapshots it into the log after run returns), so
+        # verify_dispatch_log re-traces the program that actually
+        # dispatched — never a false unbatched certification
+        meta = {"plan": self, "direction": direction}
+
+        def run(host):
+            host = np.asarray(host, dtype=dt)
+            meta["extra_dims"] = tuple(host.shape[base_ndim:])
+            arr = PencilArray.from_global(
+                pen, host, extra_ndims=host.ndim - base_ndim)
+            # the scatter's buffer is plan-owned: donate it to the
+            # first hop regardless of the caller's flag (there is no
+            # caller-visible input array to invalidate)
+            return run_plan(arr, donate=True)
+
+        return eng.submit(run, pack=pack, label=label, meta=meta)
+
     def scale_factor(self) -> float:
         """Global normalization factor of a full round trip:
         ``backward(forward(u)) == scale_factor() * u``.  1 except for

@@ -24,7 +24,19 @@ deterministic loop that composes them into a *service*:
   unpoisoned.  With the integrity guard armed
   (``PENCILARRAYS_TPU_GUARD``), dispatch takes the *eager* schedule
   (per-hop invariant probes, the instrumented path); with it off, the
-  registry's single-dispatch compiled executable (the fast path).
+  registry's single-dispatch compiled executable (the fast path);
+* execution rides the per-mesh **engine**
+  (:mod:`~pencilarrays_tpu.engine`): every batch becomes one ordered
+  dispatch-queue task — the batch's host-side packing (the numpy
+  stack of host payloads) runs on the engine's host pool, OVERLAPPED
+  with the previous batch's device compute, and the device program is
+  issued by the engine's single consumer thread in take-order, so the
+  SPMD collective-ordering invariant holds by construction
+  (``certify(engine=True)`` proves it post-hoc via
+  :func:`~pencilarrays_tpu.analysis.spmd.verify_dispatch_log`).
+  Streaming mode (:meth:`PlanService.start`) is an engine timer tick
+  honoring the coalescing deadlines — the PR-10 polling daemon thread
+  is gone.
 
 Determinism contract (multi-controller meshes): one service instance
 runs per rank; batching and ordering decisions are pure functions of
@@ -92,13 +104,19 @@ class PlanService:
     registry:
         Share a :class:`~pencilarrays_tpu.serve.registry.PlanRegistry`
         across services (default: a private one).
+    engine:
+        Explicit :class:`~pencilarrays_tpu.engine.Engine` to dispatch
+        through (default: the process's shared ``"default"`` engine —
+        one mesh, ONE ordered dispatch queue, so concurrent services
+        and app step loops cannot interleave collective launches).
     """
 
     def __init__(self, *, max_batch: int = 8, max_wait_s: float = 0.002,
                  starve_after_s: float = 1.0,
                  quota: Optional[TenantQuota] = None,
                  quotas: Optional[Dict[str, TenantQuota]] = None,
-                 retry=None, registry: Optional[PlanRegistry] = None):
+                 retry=None, registry: Optional[PlanRegistry] = None,
+                 engine=None):
         self.registry = registry or PlanRegistry()
         self.queue = AdmissionQueue(
             max_batch=max_batch, max_wait_s=max_wait_s,
@@ -109,10 +127,22 @@ class PlanService:
         self._named: Dict[str, object] = {}
         self._elastic_names: set = set()
         self._closed = False
-        self._thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
+        self._engine_obj = engine
+        self._streaming = False
+        self._pump_scheduled = False
         self._dispatches = 0
         self._completed: Dict[str, int] = {}
+
+    def engine(self):
+        """The engine this service dispatches through (the explicit
+        one, else the process's shared default — resolved per call so
+        an elastic reformation's fresh engine is picked up without
+        re-plumbing)."""
+        if self._engine_obj is not None:
+            return self._engine_obj
+        from ..engine import get_engine
+
+        return get_engine()
 
     # -- named (elastic-rebindable) plans ----------------------------------
     def register_plan(self, name: str, factory: Callable):
@@ -302,15 +332,40 @@ class PlanService:
             if direction is not None:
                 fields["direction"] = direction
             obs.record_event("serve.request", **fields)
+        # streaming mode: EVERY admission (re)schedules the pump tick —
+        # a request landing on an idle queue must not wait for a tick
+        # that was never armed (an idle tick does not reschedule itself,
+        # and an engine reform drops pending timers)
+        if self._streaming:
+            self._schedule_pump()
 
     # -- dispatch ----------------------------------------------------------
     def step(self, *, flush: bool = False) -> int:
-        """Dispatch every ready batch (coalescing deadlines honored;
-        ``flush=True`` takes partial groups too — the ragged final
-        batch).  Returns the number of batches dispatched."""
+        """Dispatch every ready batch through the engine (coalescing
+        deadlines honored; ``flush=True`` takes partial groups too —
+        the ragged final batch) and block until their futures resolve.
+        Returns the number of batches dispatched.  Batches are
+        submitted in take-order and the engine's single consumer issues
+        them in submission order, so the dispatched collective sequence
+        is identical to the pre-engine serialized loop (certifiable:
+        :meth:`certify` with ``engine=True``).  Client-thread API —
+        never call from inside engine-executed work."""
         batches = self.queue.take_ready(flush=flush)
-        for b in batches:
-            self._dispatch(b)
+        futs = [self._submit_batch(b) for b in batches]
+        interrupt = None
+        for f in futs:
+            if f is None:
+                continue    # every entry failed validation: no dispatch
+            f._event.wait()
+            err = f.error()
+            if interrupt is None and isinstance(
+                    err, (KeyboardInterrupt, SystemExit)):
+                # the tickets are failed (nobody waits on a dead
+                # future) but the interrupt itself must reach the
+                # caller — the pre-engine contract, preserved
+                interrupt = err
+        if interrupt is not None:
+            raise interrupt
         return len(batches)
 
     def drain(self) -> int:
@@ -323,41 +378,66 @@ class PlanService:
         return n
 
     def start(self, poll_s: float = 0.001) -> None:
-        """Run the dispatch loop on a daemon thread (streaming mode —
-        single-controller meshes only; multi-controller ranks must
-        drain at agreed points, see the determinism contract)."""
-        if self._thread is not None:
-            return
-
-        def loop():
-            from .. import obs
-
-            while not self._stop.is_set():
-                try:
-                    n = self.step()
-                except Exception:
-                    # the daemon must survive a scheduling bug: a dead
-                    # dispatch thread strands every future ticket with
-                    # no symptom (per-batch errors already fail their
-                    # own tickets inside _dispatch — only unexpected
-                    # scheduling-path errors reach here)
-                    if obs.enabled():
-                        obs.counter("serve.loop_errors").inc()
-                    n = 0
-                if not n:
-                    time.sleep(poll_s)
-
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=loop, name="pa-serve-dispatch", daemon=True)
-        self._thread.start()
+        """Arm streaming mode (single-controller meshes only;
+        multi-controller ranks must drain at agreed points, see the
+        determinism contract): every admission schedules an engine
+        timer honoring the coalescing deadline, whose tick takes ready
+        batches into the ordered dispatch queue.  No thread is created
+        and nothing polls — the PR-10 private daemon loop (poll, sleep,
+        repeat, contending with the main thread for every dispatch) is
+        deleted; ``poll_s`` is kept as the minimum tick spacing."""
+        self._min_tick_s = float(poll_s)
+        self._streaming = True
+        self._schedule_pump()
 
     def stop(self) -> None:
-        if self._thread is None:
+        """Disarm streaming mode (queued work stays queued for an
+        explicit :meth:`step`/:meth:`drain`; a scheduled tick may still
+        fire once and drains what is ready)."""
+        self._streaming = False
+
+    def _schedule_pump(self, *, delay_s: Optional[float] = None) -> None:
+        """Schedule ONE pending pump tick (collapsing duplicates) at
+        the coalescing deadline — or immediately when a full batch is
+        already ready."""
+        if not self._streaming or self._closed:
             return
-        self._stop.set()
-        self._thread.join()
-        self._thread = None
+        eng = self.engine()
+        if not eng.accepting:
+            return      # quiesced/reforming: re-pumped at next submit
+        with self._lock:
+            if self._pump_scheduled:
+                return
+            self._pump_scheduled = True
+        if delay_s is None:
+            delay_s = max(self.queue.max_wait_s,
+                          getattr(self, "_min_tick_s", 0.001))
+        try:
+            eng.call_later(delay_s, self._pump, label="serve-pump")
+        except Exception:
+            with self._lock:
+                self._pump_scheduled = False
+            raise
+
+    def _pump(self) -> None:
+        """The streaming tick (runs on the engine consumer thread):
+        submit every ready batch, then reschedule while traffic
+        remains.  Must never raise — a scheduling bug costs one tick,
+        never the engine."""
+        from .. import obs
+
+        with self._lock:
+            self._pump_scheduled = False
+        if not self._streaming or self._closed:
+            return
+        try:
+            for b in self.queue.take_ready():
+                self._submit_batch(b)
+        except Exception:
+            if obs.enabled():
+                obs.counter("serve.loop_errors").inc()
+        if self.queue.depth():
+            self._schedule_pump()
 
     def close(self, *, drain: bool = True) -> None:
         """Stop accepting work; by default drain what is queued.  The
@@ -380,6 +460,31 @@ class PlanService:
 
     # -- the batch executor ------------------------------------------------
     def _dispatch(self, batch: Batch) -> None:
+        """Submit one batch through the engine and wait for it — the
+        synchronous per-batch unit (callers that drive ``take_ready``
+        themselves; :meth:`step` is the batched form)."""
+        fut = self._submit_batch(batch)
+        if fut is None:
+            return
+        fut._event.wait()
+        err = fut.error()
+        if isinstance(err, (KeyboardInterrupt, SystemExit)):
+            raise err
+
+    def _submit_batch(self, batch: Batch):
+        """Turn one ready batch into one ordered engine dispatch.
+
+        Runs on the submitting thread (a :meth:`step` caller or the
+        streaming pump tick): journals the batch formation, fails
+        blame-one validation losers typed, then submits ONE engine
+        task — host-payload packing (the numpy stack) as the task's
+        ``pack`` stage on the host pool (overlapped with earlier
+        batches' device compute), the ``guarded_step``-wrapped device
+        dispatch as its ``run`` stage on the consumer thread.  Returns
+        the batch's :class:`~pencilarrays_tpu.engine.StepFuture` (or
+        ``None`` when every entry failed validation and nothing
+        dispatches).  Tickets are fulfilled by the future's completion
+        callback, so streaming mode needs no waiter."""
         from .. import obs
         from ..guard.recover import guarded_step
 
@@ -410,7 +515,7 @@ class PlanService:
             else:
                 self._finish_one(batch, e, error=err)
         if not survivors:
-            return          # nothing actually dispatches: no
+            return None     # nothing actually dispatches: no
             # serve.dispatch record, no dispatch count
         batch.entries = survivors
         tenants = sorted({e.ticket.tenant for e in survivors})
@@ -419,23 +524,72 @@ class PlanService:
                 "serve.dispatch", key=batch.key, n=len(survivors),
                 tenants=tenants, score_bytes=batch.cost,
                 reason=batch.reason)
-        self._dispatches += 1
-        t0 = time.perf_counter()
-        try:
-            outs = guarded_step(
-                lambda: self._run_batch(batch),
-                retry=self.retry, label=f"serve:{batch.key}",
-                meta={"tenants": tenants,
-                      "reqs": [e.ticket.id for e in batch.entries]})
-        except BaseException as e:
-            self._finish(batch, None, e, time.perf_counter() - t0)
-            if not isinstance(e, Exception):
-                # KeyboardInterrupt / SystemExit: the tickets are
-                # failed (nobody waits on a dead future) but the
-                # interrupt itself must reach the caller
-                raise
-            return
-        self._finish(batch, outs, None, time.perf_counter() - t0)
+        with self._lock:
+            self._dispatches += 1
+        pack = self._host_pack_fn(batch)
+        timing = {"s": 0.0}
+        meta = self._dispatch_meta(batch)
+
+        def run(host_operand=None):
+            t0 = time.perf_counter()
+            try:
+                return guarded_step(
+                    lambda: self._run_batch(batch, host_operand),
+                    retry=self.retry, label=f"serve:{batch.key}",
+                    meta={"tenants": tenants,
+                          "reqs": [e.ticket.id for e in batch.entries]})
+            finally:
+                timing["s"] = time.perf_counter() - t0
+
+        fut = self.engine().submit(
+            run, pack=pack, label=f"serve:{batch.key}", meta=meta)
+        fut.add_done_callback(
+            lambda f: self._finish(batch, f._result, f.error(),
+                                   timing["s"]))
+        return fut
+
+    def _host_pack_fn(self, batch: Batch):
+        """The batch's host-pool pack stage: for an all-host FFT batch,
+        the numpy dtype-cast + stack (ONE ``from_global`` scatter later
+        on the consumer — the PR 10 coalescing shape, now overlapped
+        with the previous dispatch's compute).  Device payloads have
+        nothing to pack on the host (``None``: materialize + stack run
+        on the consumer thread with the device program — device work
+        never leaves the ordered queue)."""
+        import numpy as np
+
+        from ..parallel.arrays import PencilArray
+
+        if batch.kind != "fft" or any(
+                isinstance(e.payload, PencilArray)
+                for e in batch.entries):
+            return None
+        e0 = batch.entries[0]
+        plan, direction = e0.plan, e0.direction
+        entries = list(batch.entries)
+
+        def pack():
+            dt = (plan.dtype_physical if direction == "forward"
+                  else plan.dtype_spectral)
+            if len(entries) == 1:
+                return np.asarray(entries[0].payload, dtype=dt)
+            return np.stack(
+                [np.asarray(e.payload, dtype=dt) for e in entries],
+                axis=-1)
+
+        return pack
+
+    def _dispatch_meta(self, batch: Batch) -> dict:
+        """What ``certify(engine=True)`` needs to re-verify this
+        dispatch against its ``collective_costs`` prediction."""
+        B = len(batch.entries)
+        meta = {"service": id(self), "kind": batch.kind,
+                "key": batch.key, "n": B, "cost": batch.cost}
+        if batch.kind == "fft":
+            e0 = batch.entries[0]
+            meta.update(plan=e0.plan, direction=e0.direction,
+                        extra_dims=(B,) if B > 1 else ())
+        return meta
 
     def _validate_entry(self, batch: Batch, entry: _Entry
                         ) -> Optional[BaseException]:
@@ -463,10 +617,14 @@ class PlanService:
                 f"differs from its coalesce group's")
         return None
 
-    def _run_batch(self, batch: Batch) -> List[object]:
+    def _run_batch(self, batch: Batch,
+                   host_operand=None) -> List[object]:
         """Build the coalesced operand, execute ONE dispatch, split the
-        results per request.  Runs inside ``guarded_step`` — re-runnable
-        by construction (inputs are never donated on the serve path)."""
+        results per request.  Runs inside ``guarded_step`` on the
+        engine's consumer thread — re-runnable by construction (inputs
+        are never donated on the serve path, and ``host_operand`` — the
+        pool-packed host stack, when the batch had one — re-scatters
+        cleanly on every retry)."""
         from .. import guard
 
         entries = batch.entries
@@ -480,7 +638,8 @@ class PlanService:
             return self._split(out, B)
         e0 = entries[0]
         plan, direction = e0.plan, e0.direction
-        arr = self._coalesce_fft(plan, direction, entries)
+        arr = self._coalesce_fft(plan, direction, entries,
+                                 host_operand=host_operand)
         if guard.enabled():
             # isolation path: the EAGER schedule — per-hop invariant
             # probes inside each exchange program, hang watchdog per
@@ -525,9 +684,12 @@ class PlanService:
         parts = _split_fn(B)(out.data)
         return [PencilArray(out.pencil, p, ()) for p in parts]
 
-    def _coalesce_fft(self, plan, direction: str, entries: List[_Entry]):
+    def _coalesce_fft(self, plan, direction: str, entries: List[_Entry],
+                      *, host_operand=None):
         """The batch operand: an all-host batch is stacked ON THE HOST
-        and scattered in ONE ``from_global`` (one pad/permute/
+        (by the engine's host pool — ``host_operand``, built while the
+        previous batch's device program ran — or inline on a cold
+        path) and scattered in ONE ``from_global`` (one pad/permute/
         device_put for the whole batch — B per-sample scatters plus a
         device-side restack would eat the coalescing win); any device
         payload in the batch falls back to per-sample materialize +
@@ -541,6 +703,9 @@ class PlanService:
         dt = (plan.dtype_physical if direction == "forward"
               else plan.dtype_spectral)
         B = len(entries)
+        if host_operand is not None:
+            return PencilArray.from_global(
+                pen, host_operand, extra_ndims=0 if B == 1 else 1)
         if not any(isinstance(e.payload, PencilArray) for e in entries):
             if B == 1:
                 return PencilArray.from_global(
@@ -613,7 +778,7 @@ class PlanService:
 
     # -- pre-flight certification ------------------------------------------
     def certify(self, *, hbm_limit: Optional[int] = None,
-                raise_on_error: bool = True) -> dict:
+                raise_on_error: bool = True, engine: bool = False) -> dict:
         """Statically certify every resident plan BEFORE it serves
         traffic: each registered fingerprint's compiled executables
         (forward AND backward, every resident ``extra_dims``/donate
@@ -630,7 +795,19 @@ class PlanService:
         ``raise_on_error`` the first divergence re-raises its typed
         error (:class:`~pencilarrays_tpu.analysis.errors.
         ScheduleMismatchError` naming the offending op, ...) after the
-        report entry is journaled — the pre-flight gate."""
+        report entry is journaled — the pre-flight gate.
+
+        ``engine=True`` additionally certifies the PIPELINED execution
+        this service actually ran: the engine's issued dispatch log
+        (filtered to this service's batches) is proved equal to the
+        serialized schedule — issue order == enqueue order, and each
+        dispatched program's compiled collective trace == its plan's
+        ``collective_costs`` prediction op-for-op
+        (:func:`~pencilarrays_tpu.analysis.spmd.verify_dispatch_log`;
+        typed :class:`~pencilarrays_tpu.analysis.errors.
+        DispatchOrderError` / :class:`~pencilarrays_tpu.analysis.
+        errors.ScheduleMismatchError` naming the first divergence).
+        The result rides the report under ``"engine"``."""
         from ..analysis.errors import AnalysisError
         from ..analysis.spmd import certify_plan
 
@@ -662,6 +839,25 @@ class PlanService:
                                else plan.batch_dims)}
                     report["ok"] = False
                 report["plans"].append(rec)
+        if engine:
+            from ..analysis.spmd import verify_dispatch_log
+
+            eng = self.engine()
+            mine = [r for r in eng.dispatch_log()
+                    if r.meta.get("service") == id(self)]
+            try:
+                report["engine"] = verify_dispatch_log(
+                    mine, source=f"serve-engine:{eng.name}")
+                # the log is a bounded window: a certification that did
+                # not see the whole run must say so, never imply it did
+                report["engine"]["log_truncated"] = \
+                    eng.stats()["log_truncated"]
+            except AnalysisError as e:
+                if raise_on_error:
+                    raise
+                report["engine"] = {"outcome": type(e).__name__,
+                                    "error": str(e)}
+                report["ok"] = False
         report["seconds"] = time.perf_counter() - t0
         report["certified"] = len(report["plans"])
         return report

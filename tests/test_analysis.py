@@ -487,6 +487,54 @@ def test_lint_unlocked_daemon_state(tmp_path):
     assert [f.ident for f in found] == ["obs.broken._pending"]
 
 
+def test_lint_thread_spawn_outside_engine(tmp_path):
+    """Raw Thread construction is an engine/ monopoly: a rogue daemon
+    anywhere else is a finding (stable-ident'd by its enclosing def),
+    spawn_thread call sites and engine-internal construction are not,
+    and a justified allowlist entry suppresses it."""
+    rogue = """
+        import threading
+
+        def start_daemon():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+        """
+    from_import = """
+        from threading import Thread
+
+        def sneaky():
+            Thread(target=print).start()
+        """
+    clean = """
+        def start(self):
+            from ..engine.threads import spawn_thread
+
+            self._t = spawn_thread(self._loop, name="pa-x")
+        """
+    engine_own = """
+        import threading
+
+        def spawn_thread(target, *, name):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            return t
+        """
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/obs/rogue.py", rogue),
+        ("pencilarrays_tpu/io/sneak.py", from_import),
+        ("pencilarrays_tpu/cluster/ok.py", clean),
+        ("pencilarrays_tpu/engine/threads.py", engine_own)])
+    found = sorted(f.ident for f in lint_tree(root)
+                   if f.check == "thread-spawn")
+    assert found == ["io.sneak.sneaky", "obs.rogue.start_daemon"]
+    allow = _write(root, "pa-lint.allow", """
+        thread-spawn obs.rogue.start_daemon  # drill-only daemon
+        thread-spawn io.sneak.sneaky  # legacy, tracked in ISSUE-99
+        """)
+    findings, _ = run_lint(root, Allowlist.load(allow))
+    assert [f for f in findings if f.check == "thread-spawn"] == []
+
+
 def test_allowlist_roundtrip(tmp_path):
     """Allowlist round-trip: a justified entry suppresses its finding,
     stale entries are reported unused, unjustified/malformed lines are
