@@ -458,9 +458,10 @@ def verify_dispatch_log(records: Sequence, *, source: str = "engine",
       pipelined schedule is not the serialized one and raises
       :class:`~pencilarrays_tpu.analysis.errors.DispatchOrderError`
       naming the first diverging dispatch);
-    * **trace** — every record that carries a plan in its ``meta``
-      (``plan``/``extra_dims``/``direction`` — the serve layer's
-      dispatch metadata) has its compiled collective trace re-extracted
+    * **trace** — every ``"ok"`` record that carries a plan in its
+      ``meta`` (``plan``/``extra_dims``/``direction`` — the serve
+      layer's dispatch metadata) has its compiled collective trace
+      re-extracted
       and proved equal, op-for-op, to the plan's ``collective_costs``
       prediction via :func:`verify_plan` (raises
       :class:`ScheduleMismatchError` naming the offending op).  Each
@@ -484,7 +485,10 @@ def verify_dispatch_log(records: Sequence, *, source: str = "engine",
         for r in records:
             meta = getattr(r, "meta", None) or {}
             plan = meta.get("plan")
-            if plan is None:
+            # a non-ok record launched nothing certifiable (a failed
+            # pack never ran its device program, and its meta may be
+            # incomplete) — it must not inflate verified_traces
+            if plan is None or getattr(r, "outcome", "ok") != "ok":
                 unverified += 1
                 continue
             extra = tuple(meta.get("extra_dims", ()))

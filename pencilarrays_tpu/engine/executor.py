@@ -76,6 +76,7 @@ class StepFuture:
     def __init__(self, label: str = "step"):
         self.label = label
         self._event = threading.Event()
+        self._resolved = False
         self._result = None
         self._error: Optional[BaseException] = None
         self._callbacks: List[Callable] = []
@@ -96,7 +97,7 @@ class StepFuture:
 
     def add_done_callback(self, fn: Callable[["StepFuture"], None]) -> None:
         with self._cb_lock:
-            if not self._event.is_set():
+            if not self._resolved:
                 self._callbacks.append(fn)
                 return
         self._run_callback(fn)
@@ -104,7 +105,11 @@ class StepFuture:
     def _run_callback(self, fn) -> None:
         try:
             fn(self)
-        except Exception:
+        except BaseException:
+            # NEVER propagate — BaseException included: callbacks run
+            # on the resolving engine thread, where an escaping
+            # SystemExit would kill the consumer AND skip the event
+            # set below, hanging every result() waiter
             from .. import obs
 
             if obs.enabled():
@@ -114,10 +119,22 @@ class StepFuture:
         with self._cb_lock:
             self._result = result
             self._error = error
-            self._event.set()
+            self._resolved = True
             cbs, self._callbacks = self._callbacks, []
-        for fn in cbs:
-            self._run_callback(fn)
+        # the event is set only AFTER the done callbacks ran: a waiter
+        # woken by result()/the event may rely on completion side
+        # effects (serve fulfills its tickets in a callback — step()'s
+        # "block until resolved" promise must cover them, or a
+        # ticket.result(0) right after step() is a flaky TimeoutError).
+        # Callbacks therefore must not call result() on their own
+        # future — they read _result/error() directly.  The finally is
+        # load-bearing: the event MUST fire even if callback handling
+        # itself breaks, or every waiter hangs silently
+        try:
+            for fn in cbs:
+                self._run_callback(fn)
+        finally:
+            self._event.set()
 
     def _fulfill(self, result) -> None:
         self._resolve(result, None)
@@ -181,8 +198,16 @@ class Engine:
                  config: Optional[_config.RuntimeConfig] = None):
         self.name = name
         self.config = config if config is not None else _config.current()
-        self._workers = int(workers) if workers else \
-            self.config.engine_workers
+        if workers is not None and int(workers) < 1:
+            raise ValueError(
+                "engine workers must be >= 1: the host pool runs pack "
+                "stages, and a pool of 0 would wedge every submit(pack=) "
+                "head-of-line wait")
+        # the config path is clamped, not raised: RuntimeConfig built
+        # directly (bypassing env resolution's own max(1,...)) must
+        # not reintroduce the zero-worker pack wedge silently
+        self._workers = int(workers) if workers is not None else \
+            max(1, self.config.engine_workers)
         self._cv = threading.Condition()
         self._gen = 0
         self._closed = False
@@ -196,6 +221,7 @@ class Engine:
         self._host_threads: list = []
         self._enq = itertools.count(1)
         self._timer_seq = itertools.count(1)
+        self._reform_cbs: list = []
         self._issue_seq = 0
         self._log: deque = deque(maxlen=_MAX_LOG)
         self._dispatched = 0
@@ -261,11 +287,13 @@ class Engine:
         ``pack``, ``run`` is called with no arguments).  A ``pack``
         failure fails THIS future typed and the consumer moves on.
 
-        ``meta`` is held BY REFERENCE and snapshotted into the
-        dispatch log only after ``run`` returns — a task whose shape
-        is unknown at submit time (e.g. ``forward_async``'s pack form)
-        may complete its own certification metadata from inside
-        ``run``."""
+        ``meta`` is held BY REFERENCE until ``run`` returns — a task
+        whose shape is unknown at submit time (e.g.
+        ``forward_async``'s pack form) may complete its own
+        certification metadata from inside ``run`` — and then a
+        shallow COPY is snapshotted into the dispatch log, so later
+        mutation of the caller's dict cannot rewrite certification
+        history."""
         fut = StepFuture(label)
         with self._cv:
             if self._closed:
@@ -313,6 +341,30 @@ class Engine:
             self._ensure_threads_locked()
             self._cv.notify_all()
 
+    def on_reform(self, fn: Callable[["Engine"], None]
+                  ) -> Callable[[], None]:
+        """Register ``fn(engine)`` to run at the END of every
+        :meth:`reform` — the new generation is live and accepting by
+        then.  The hook streaming clients use to re-arm timers the
+        reform dropped (their scheduling state died with the old mesh,
+        but already-queued client work must not wait for fresh traffic
+        to notice); they also run at :meth:`resume` — every transition
+        back to accepting.  Callbacks survive reforms, must be cheap, and a
+        raising callback is swallowed and counted, never allowed to
+        fail the reform.  Returns an idempotent unsubscribe callable —
+        a client outlived by a shared engine MUST call it at its own
+        close, or its dead callback rides every later reform."""
+        with self._cv:
+            self._reform_cbs.append(fn)
+
+        def _unsubscribe() -> None:
+            with self._cv:
+                try:
+                    self._reform_cbs.remove(fn)
+                except ValueError:
+                    pass
+        return _unsubscribe
+
     def _offer_host_locked(self, fn, label, stage) -> StepFuture:
         fut = StepFuture(label)
         self._host_q.append(_HostItem(fn=fn, future=fut, label=label,
@@ -357,9 +409,30 @@ class Engine:
         return True
 
     def resume(self) -> None:
+        """Un-pause the consumer (the failed-reformation path: the old
+        mesh is still the live one).  :meth:`on_reform` callbacks run
+        here too: a client that deferred scheduling while the engine
+        was quiesced (e.g. a streaming admission that skipped arming
+        its tick) must be woken without waiting for fresh traffic."""
         with self._cv:
             self._paused = False
             self._cv.notify_all()
+        self._run_reform_cbs()
+
+    def _run_reform_cbs(self) -> None:
+        with self._cv:
+            cbs = list(self._reform_cbs)
+        for fn in cbs:
+            try:
+                fn(self)
+            except BaseException:
+                # the documented never-fail contract: an interrupt
+                # escaping here would abort reform_all mid-fleet,
+                # leaving engines partially reformed with no record
+                from .. import obs
+
+                if obs.enabled():
+                    obs.counter("engine.callback_errors").inc()
 
     def reform(self, config: Optional[_config.RuntimeConfig] = None,
                *, timeout: Optional[float] = None) -> int:
@@ -368,19 +441,31 @@ class Engine:
         program it would have issued was compiled for the dead mesh),
         drop timers, retire the old consumer/pool threads, take a
         FRESH :class:`RuntimeConfig` snapshot, and resume under a new
-        generation.  Returns the new generation."""
+        generation; :meth:`on_reform` callbacks then run against the
+        live new generation.  Returns the new generation."""
         self.quiesce(timeout)
         with self._cv:
             self._gen += 1
             gen = self._gen
+            # a quiesce-timeout survivor is written off HERE: its
+            # consumer skips all state updates once the generation
+            # moved (see _run_task), so the busy flag must not keep
+            # counting it toward the new generation's depth/drain
+            self._busy = False
             pending = list(self._tasks)
             self._tasks.clear()
             host_pending = [h for h in self._host_q]
             self._host_q.clear()
             self._timers.clear()
+            # drop the old generation's dispatch history: its records
+            # pin plan objects (and their dead-mesh compiled
+            # executables) in meta, and verify paths must see only the
+            # live generation (stats' log_truncated already says the
+            # log no longer covers the whole run)
+            self._log.clear()
             self.config = config if config is not None \
                 else _config.current()
-            self._workers = self.config.engine_workers
+            self._workers = max(1, self.config.engine_workers)
             self._dispatch_thread = None
             self._host_threads = []
             self._paused = False
@@ -400,6 +485,7 @@ class Engine:
             obs.record_event("engine.reform", gen=gen, stage="complete",
                              name=self.name, dropped=len(pending),
                              dropped_host=len(host_pending))
+        self._run_reform_cbs()
         return gen
 
     def close(self) -> None:
@@ -414,6 +500,8 @@ class Engine:
             host_pending = list(self._host_q)
             self._host_q.clear()
             self._timers.clear()
+            self._reform_cbs.clear()    # a closed engine never
+            # reforms; holding client closures would only leak them
             self._cv.notify_all()
         err = EngineClosedError(f"engine {self.name!r} closed")
         for t in pending:
@@ -452,6 +540,11 @@ class Engine:
                     if not self._paused and self._timers \
                             and self._timers[0][0] <= now:
                         timer_fn = heapq.heappop(self._timers)[2]
+                        # a firing tick is in-flight work: quiesce()
+                        # must wait it out (a streaming pump mid-tick
+                        # submits dispatches — reforming under it
+                        # would issue dead-mesh programs)
+                        self._busy = True
                         break
                     if not self._paused and self._tasks:
                         task = self._tasks.popleft()
@@ -469,10 +562,14 @@ class Engine:
 
                     if obs.enabled():
                         obs.counter("engine.timer_errors").inc()
+                with self._cv:
+                    if gen == self._gen:    # stale ticks were written
+                        self._busy = False  # off by reform()
+                    self._cv.notify_all()
                 continue
-            self._run_task(task)
+            self._run_task(task, gen)
 
-    def _run_task(self, task: _Task) -> None:
+    def _run_task(self, task: _Task, gen: int) -> None:
         t0 = time.monotonic()
         out, err = None, None
         operand = _NO_OPERAND
@@ -499,17 +596,33 @@ class Engine:
                 err = e
         t1 = time.monotonic()
         with self._cv:
-            self._busy = False
-            self._issue_seq += 1
-            self._dispatched += 1
-            self._dispatch_busy_s += t1 - t0
-            self._log.append(DispatchRecord(
-                enqueue_seq=task.seq, issue_seq=self._issue_seq,
-                label=task.label,
-                outcome="ok" if err is None else type(err).__name__,
-                queued_s=t0 - task.t_enqueue, run_s=t1 - t0,
-                meta=task.meta))
+            stale = gen != self._gen
+            if not stale:
+                self._busy = False
+                self._issue_seq += 1
+                self._dispatched += 1
+                self._dispatch_busy_s += t1 - t0
+                # the logged meta is a shallow-copy SNAPSHOT: the log
+                # is immutable certification history once the dispatch
+                # completes, and must not pin the caller's (possibly
+                # plan-holding) dict against later mutation or reuse
+                self._log.append(DispatchRecord(
+                    enqueue_seq=task.seq, issue_seq=self._issue_seq,
+                    label=task.label,
+                    outcome="ok" if err is None else type(err).__name__,
+                    queued_s=t0 - task.t_enqueue, run_s=t1 - t0,
+                    meta=dict(task.meta)))
             self._cv.notify_all()
+        if stale:
+            # a quiesce-timeout survivor finishing after a reform: its
+            # generation's accounting was already written off, and its
+            # lower enqueue_seq must NOT land after new-generation log
+            # records (a spurious DispatchOrderError on a healthy
+            # engine) — resolve the future, touch nothing else
+            from .. import obs
+
+            if obs.enabled():
+                obs.counter("engine.stale_dispatches").inc()
         if err is None:
             task.future._fulfill(out)
         else:

@@ -157,9 +157,13 @@ class AdmissionQueue:
     def quota_for(self, tenant: str) -> TenantQuota:
         return self.quotas.get(tenant, self.default_quota)
 
-    def offer(self, entry: _Entry) -> None:
+    def offer(self, entry: _Entry) -> bool:
         """Admit one request or raise typed
-        :class:`~pencilarrays_tpu.serve.errors.AdmissionError`."""
+        :class:`~pencilarrays_tpu.serve.errors.AdmissionError`.
+        Returns True when this admission brought its coalesce group to
+        a full ``max_batch`` — the streaming pump's fast-path signal
+        (a full batch gains nothing by waiting out the deadline),
+        known for free at append time."""
         t = entry.ticket.tenant
         q = self.quota_for(t)
         with self._lock:
@@ -183,7 +187,9 @@ class AdmissionQueue:
             entry.seq = next(self._seq)
             self._tenant_requests[t] = n + 1
             self._tenant_bytes[t] = b + entry.nbytes
-            self._pending.setdefault(entry.ticket.key, []).append(entry)
+            group = self._pending.setdefault(entry.ticket.key, [])
+            group.append(entry)
+            return len(group) >= self.max_batch
 
     def close_gate(self) -> None:
         """Refuse all future :meth:`offer` calls (atomic with the offer
@@ -299,6 +305,21 @@ class AdmissionQueue:
         return int(route.gspmd_score_bytes or 0)
 
     # -- introspection -----------------------------------------------------
+    def next_ready_in(self, now: Optional[float] = None
+                      ) -> Optional[float]:
+        """Seconds until the OLDEST pending group's coalescing
+        deadline (0.0 when already due; None when nothing is
+        pending) — the streaming pump re-arms at this instead of a
+        fresh full ``max_wait_s``, so a group admitted just after a
+        tick never waits ~2x its deadline."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if not self._pending:
+                return None
+            oldest = min(v[0].ticket.t_submit
+                         for v in self._pending.values() if v)
+        return max(0.0, oldest + self.max_wait_s - now)
+
     def depth(self, tenant: Optional[str] = None) -> int:
         with self._lock:
             if tenant is None:

@@ -62,9 +62,11 @@ renders a served run end to end.
 
 from __future__ import annotations
 
+import itertools
 import math
 import threading
 import time
+import weakref
 from typing import Callable, Dict, List, Optional
 
 from functools import lru_cache
@@ -74,6 +76,10 @@ from .queue import AdmissionQueue, Batch, TenantQuota, Ticket, _Entry
 from .registry import PlanRegistry
 
 __all__ = ["PlanService"]
+
+_service_ids = itertools.count(1)   # dispatch-log attribution tokens:
+# NEVER id(self) — a recycled address would pull a dead service's
+# records into another service's certify(engine=True)
 
 
 @lru_cache(maxsize=64)
@@ -127,9 +133,22 @@ class PlanService:
         self._named: Dict[str, object] = {}
         self._elastic_names: set = set()
         self._closed = False
+        self._sid = next(_service_ids)
         self._engine_obj = engine
         self._streaming = False
         self._pump_scheduled = False
+        self._pump_token = None     # (engine, generation) the pending
+        # tick was scheduled against — a reform drops timers, so a
+        # stale token means "scheduled" is a lie and must re-arm (the
+        # ENGINE OBJECT, not id(): a recycled address on a swapped
+        # engine must never collide into a false dedup)
+        self._pump_deadline = 0.0   # when the armed tick fires — an
+        # URGENT re-arm (full batch ready) may undercut it
+        self._hooked_engines = weakref.WeakSet()    # engines whose
+        # on_reform hook already re-arms this service's pump
+        self._unhooks: List[Callable] = []  # their unsubscribes,
+        # called at close() so a shared long-lived engine never
+        # accumulates dead services' hooks
         self._dispatches = 0
         self._completed: Dict[str, int] = {}
 
@@ -315,7 +334,7 @@ class PlanService:
             raise ServiceClosedError("service is closed")
         t = entry.ticket.tenant
         try:
-            self.queue.offer(entry)
+            full = self.queue.offer(entry)
         except ServeError as e:
             if obs.enabled():
                 obs.counter("serve.rejected", tenant=t,
@@ -335,24 +354,38 @@ class PlanService:
         # streaming mode: EVERY admission (re)schedules the pump tick —
         # a request landing on an idle queue must not wait for a tick
         # that was never armed (an idle tick does not reschedule itself,
-        # and an engine reform drops pending timers)
+        # and an engine reform drops pending timers).  An admission
+        # that COMPLETED a batch ticks at the minimum spacing: a full
+        # batch gains nothing by waiting out the coalescing deadline
         if self._streaming:
-            self._schedule_pump()
+            if full:
+                self._schedule_pump(
+                    delay_s=getattr(self, "_min_tick_s", 0.001))
+            else:
+                self._schedule_pump()
 
     # -- dispatch ----------------------------------------------------------
     def step(self, *, flush: bool = False) -> int:
         """Dispatch every ready batch through the engine (coalescing
         deadlines honored; ``flush=True`` takes partial groups too —
         the ragged final batch) and block until their futures resolve.
-        Returns the number of batches dispatched.  Batches are
+        Returns the number of batches TAKEN — dispatched, or failed
+        typed at submission (a batch that left the queue always
+        resolves its tickets, one way or the other).  Batches are
         submitted in take-order and the engine's single consumer issues
         them in submission order, so the dispatched collective sequence
         is identical to the pre-engine serialized loop (certifiable:
         :meth:`certify` with ``engine=True``).  Client-thread API —
         never call from inside engine-executed work."""
         batches = self.queue.take_ready(flush=flush)
-        futs = [self._submit_batch(b) for b in batches]
+        futs = []
         interrupt = None
+        for b in batches:
+            f, err = self._submit_or_fail(b)
+            futs.append(f)
+            if interrupt is None and isinstance(
+                    err, (KeyboardInterrupt, SystemExit)):
+                interrupt = err
         for f in futs:
             if f is None:
                 continue    # every entry failed validation: no dispatch
@@ -370,8 +403,8 @@ class PlanService:
 
     def drain(self) -> int:
         """Flush-dispatch until the queue is empty; returns batches
-        dispatched.  The deterministic entry point: tests and
-        multi-controller meshes submit, then drain."""
+        taken (see :meth:`step`).  The deterministic entry point:
+        tests and multi-controller meshes submit, then drain."""
         n = 0
         while self.queue.depth():
             n += self.step(flush=True)
@@ -391,33 +424,88 @@ class PlanService:
         self._schedule_pump()
 
     def stop(self) -> None:
-        """Disarm streaming mode (queued work stays queued for an
-        explicit :meth:`step`/:meth:`drain`; a scheduled tick may still
-        fire once and drains what is ready)."""
+        """Disarm streaming mode: queued work stays queued for an
+        explicit :meth:`step`/:meth:`drain`.  A scheduled tick may
+        still fire once but dispatches NOTHING once streaming is off —
+        stop() means no further implicit dispatch, period."""
         self._streaming = False
 
     def _schedule_pump(self, *, delay_s: Optional[float] = None) -> None:
         """Schedule ONE pending pump tick (collapsing duplicates) at
         the coalescing deadline — or immediately when a full batch is
-        already ready."""
+        already ready.  Never raises: the caller is the admission path
+        (the request is already queued — a scheduling failure must not
+        strip the submitter of a ticket that may still dispatch) or the
+        pump tick itself."""
+        from .. import obs
+
         if not self._streaming or self._closed:
             return
         eng = self.engine()
+        self._hook_reform(eng)
         if not eng.accepting:
-            return      # quiesced/reforming: re-pumped at next submit
-        with self._lock:
-            if self._pump_scheduled:
-                return
-            self._pump_scheduled = True
+            return      # quiesced/reforming: the engine's reform/
+            # resume hook (or the next submit) re-pumps
         if delay_s is None:
             delay_s = max(self.queue.max_wait_s,
                           getattr(self, "_min_tick_s", 0.001))
+        token = (eng, eng.generation)
+        now = time.monotonic()
+        with self._lock:
+            if (self._pump_scheduled and self._pump_token == token
+                    and now + delay_s >= self._pump_deadline - 1e-4):
+                return      # an armed tick already fires soon enough
+            # re-arm when: the token is stale (the engine reformed —
+            # dropping its timers — or was swapped, so "scheduled" is
+            # a lie), OR an urgent deadline (a full batch) undercuts
+            # the armed tick.  The superseded tick still fires and
+            # drains harmlessly (take_ready dedups the work)
+            self._pump_scheduled = True
+            self._pump_token = token
+            self._pump_deadline = now + delay_s
         try:
             eng.call_later(delay_s, self._pump, label="serve-pump")
         except Exception:
+            # engine closed/reformed between the accepting check and
+            # the call: queued work is NOT lost — the next admission
+            # (or an explicit step/drain) re-pumps.  Clear the flag
+            # only if OUR token still owns it: a concurrent admission
+            # may have legitimately re-armed on the live generation
             with self._lock:
-                self._pump_scheduled = False
-            raise
+                if self._pump_token == token:
+                    self._pump_scheduled = False
+            if obs.enabled():
+                obs.counter("serve.pump_schedule_errors").inc()
+
+    def _hook_reform(self, eng) -> None:
+        """Register (once per engine) a post-reform hook that re-arms
+        the pump: a reform drops the armed tick, and ALREADY-QUEUED
+        streaming traffic must drain even if no further admission ever
+        arrives to notice the stale token.  The hook holds only a
+        weakref to the service so a long-lived shared engine never
+        keeps a closed service alive."""
+        with self._lock:
+            if eng in self._hooked_engines:
+                return
+            self._hooked_engines.add(eng)
+        ref = weakref.ref(self)
+
+        def _rearm(_eng):
+            svc = ref()
+            if svc is not None and svc._streaming and not svc._closed \
+                    and svc.queue.depth():
+                svc._schedule_pump()
+
+        unhook = eng.on_reform(_rearm)
+        with self._lock:
+            # close() may have swapped _unhooks out while we were
+            # registering: our entry would land in a list nobody ever
+            # drains, leaving a dead service's hook on a shared engine
+            late = self._closed
+            if not late:
+                self._unhooks.append(unhook)
+        if late:
+            unhook()
 
     def _pump(self) -> None:
         """The streaming tick (runs on the engine consumer thread):
@@ -426,18 +514,31 @@ class PlanService:
         never the engine."""
         from .. import obs
 
+        now = time.monotonic()
         with self._lock:
-            self._pump_scheduled = False
+            # only the OWNING tick clears the flag: a superseded
+            # later-deadline tick firing while a live one is still
+            # armed (deadline in the future) must not clear it, or
+            # every admission until that live tick re-arms redundantly
+            if now >= self._pump_deadline - 1e-4:
+                self._pump_scheduled = False
         if not self._streaming or self._closed:
             return
         try:
-            for b in self.queue.take_ready():
-                self._submit_batch(b)
+            batches = self.queue.take_ready()
         except Exception:
+            batches = []
             if obs.enabled():
                 obs.counter("serve.loop_errors").inc()
+        for b in batches:
+            self._submit_or_fail(b)
         if self.queue.depth():
-            self._schedule_pump()
+            # re-arm at the oldest pending group's own deadline — a
+            # fresh full max_wait_s from now would make a group that
+            # just missed this tick wait up to ~2x its deadline
+            wait = self.queue.next_ready_in()
+            self._schedule_pump(delay_s=None if wait is None else max(
+                wait, getattr(self, "_min_tick_s", 0.001)))
 
     def close(self, *, drain: bool = True) -> None:
         """Stop accepting work; by default drain what is queued.  The
@@ -455,21 +556,55 @@ class PlanService:
         from ..cluster import elastic
         with self._lock:
             names, self._elastic_names = self._elastic_names, set()
+            unhooks, self._unhooks = self._unhooks, []
         for n in names:
             elastic.unregister_plan(n)
+        for u in unhooks:       # drop our reform hooks from engines
+            try:                # that outlive this service
+                u()
+            except Exception:
+                pass
 
     # -- the batch executor ------------------------------------------------
     def _dispatch(self, batch: Batch) -> None:
         """Submit one batch through the engine and wait for it — the
         synchronous per-batch unit (callers that drive ``take_ready``
-        themselves; :meth:`step` is the batched form)."""
-        fut = self._submit_batch(batch)
+        themselves; :meth:`step` is the batched form).  A submission
+        failure fails the batch's tickets typed (interrupts still
+        propagate)."""
+        fut, serr = self._submit_or_fail(batch)
+        if isinstance(serr, (KeyboardInterrupt, SystemExit)):
+            raise serr
         if fut is None:
             return
         fut._event.wait()
         err = fut.error()
         if isinstance(err, (KeyboardInterrupt, SystemExit)):
             raise err
+
+    def _submit_or_fail(self, batch: Batch):
+        """:meth:`_submit_batch`, but a submission failure (engine
+        closed/reformed between ``take_ready`` and submit, a scheduling
+        bug) fails THIS batch's tickets typed instead of propagating —
+        once a batch left the queue, nobody but us will ever resolve
+        its tickets.  NEVER raises (the streaming pump runs on the
+        engine consumer thread, where an escaped exception kills the
+        consumer and strands every queued future).  Returns ``(future,
+        error)``: the future is ``None`` when nothing dispatched, the
+        error is the submission failure so synchronous callers can
+        re-raise interrupts after the tickets are failed."""
+        from .. import obs
+
+        try:
+            return self._submit_batch(batch), None
+        except BaseException as e:
+            try:
+                self._finish(batch, None, e, 0.0)
+            except Exception:
+                pass
+            if obs.enabled():
+                obs.counter("serve.submit_errors").inc()
+            return None, e
 
     def _submit_batch(self, batch: Batch):
         """Turn one ready batch into one ordered engine dispatch.
@@ -583,7 +718,7 @@ class PlanService:
         """What ``certify(engine=True)`` needs to re-verify this
         dispatch against its ``collective_costs`` prediction."""
         B = len(batch.entries)
-        meta = {"service": id(self), "kind": batch.kind,
+        meta = {"service": self._sid, "kind": batch.kind,
                 "key": batch.key, "n": B, "cost": batch.cost}
         if batch.kind == "fft":
             e0 = batch.entries[0]
@@ -844,7 +979,7 @@ class PlanService:
 
             eng = self.engine()
             mine = [r for r in eng.dispatch_log()
-                    if r.meta.get("service") == id(self)]
+                    if r.meta.get("service") == self._sid]
             try:
                 report["engine"] = verify_dispatch_log(
                     mine, source=f"serve-engine:{eng.name}")

@@ -392,6 +392,203 @@ def test_serve_streaming_without_daemon_thread(devices):
     engine.close()
 
 
+def test_streaming_rearms_after_engine_reform(devices):
+    """The armed pump tick dies with a reform (timers are dropped);
+    the generation-tagged dedup must notice, or every later admission
+    no-ops at the duplicate check and streaming wedges forever
+    (regression pin)."""
+    from pencilarrays_tpu.serve import PlanService
+
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(13)
+    engine = Engine("re-stream")
+    svc = PlanService(max_batch=4, max_wait_s=0.05, engine=engine)
+    svc.start()                 # arms a tick the reform will drop
+    engine.reform()
+    t = svc.submit("t", (rng.standard_normal((8, 6, 4))
+                         + 1j * rng.standard_normal((8, 6, 4))
+                         ).astype(np.complex64), plan=plan)
+    assert t.result(60) is not None
+    svc.stop()
+    svc.close()
+    engine.close()
+
+
+def test_streaming_queued_traffic_drains_after_reform(devices):
+    """A request queued BEFORE the reform must drain afterwards even
+    if no further admission ever arrives: the engine's post-reform
+    hook re-arms the pump (the admission-path token check alone only
+    recovered on the NEXT submit — regression pin)."""
+    from pencilarrays_tpu.serve import PlanService
+
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(19)
+    engine = Engine("re-queued")
+    svc = PlanService(max_batch=4, max_wait_s=0.2, engine=engine)
+    svc.start()
+    t = svc.submit("t", (rng.standard_normal((8, 6, 4))
+                         + 1j * rng.standard_normal((8, 6, 4))
+                         ).astype(np.complex64), plan=plan)
+    engine.reform()     # drops the armed tick before its deadline
+    assert t.result(60) is not None     # NO further submit
+    svc.stop()
+    svc.close()
+    # the service unhooks at close: a shared long-lived engine must
+    # not accumulate dead services' reform callbacks
+    assert not engine._reform_cbs
+    engine.close()
+
+
+def test_streaming_full_batch_dispatches_before_deadline(devices):
+    """A full coalesce group gains nothing by waiting: the admission
+    that completes the batch ticks at the minimum spacing instead of
+    the coalescing deadline (regression pin — full batches used to
+    wait out the whole max_wait_s window)."""
+    from pencilarrays_tpu.serve import PlanService
+
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(29)
+
+    def payload():
+        return (rng.standard_normal((8, 6, 4))
+                + 1j * rng.standard_normal((8, 6, 4))
+                ).astype(np.complex64)
+
+    engine = Engine("fullfast")
+    svc = PlanService(max_batch=2, max_wait_s=5.0, engine=engine)
+    # warm the B=2 coalesced executable OUTSIDE the timed window
+    for tk in [svc.submit("t", payload(), plan=plan) for _ in range(2)]:
+        pass
+    svc.drain()
+    svc.start()
+    t0 = time.monotonic()
+    tickets = [svc.submit("t", payload(), plan=plan) for _ in range(2)]
+    for tk in tickets:
+        assert tk.result(30) is not None
+    assert time.monotonic() - t0 < 2.5      # far below max_wait_s=5
+    svc.stop()
+    svc.close()
+    engine.close()
+
+
+def test_streaming_quiesced_admission_drains_on_resume(devices):
+    """A request admitted while the engine is quiesced arms no tick
+    (accepting is False); a FAILED reformation resumes the engine
+    without reforming it, so resume() must run the re-arm hooks too —
+    otherwise the queued request waits for unrelated future traffic
+    (regression pin)."""
+    from pencilarrays_tpu.serve import PlanService
+
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(23)
+    engine = Engine("re-resume")
+    svc = PlanService(max_batch=4, max_wait_s=0.01, engine=engine)
+    svc.start()
+    assert engine.quiesce(5)
+    t = svc.submit("t", (rng.standard_normal((8, 6, 4))
+                         + 1j * rng.standard_normal((8, 6, 4))
+                         ).astype(np.complex64), plan=plan)
+    engine.resume()     # the failed-reformation path
+    assert t.result(60) is not None     # NO further submit
+    svc.stop()
+    svc.close()
+    engine.close()
+
+
+def test_step_fails_tickets_when_submission_fails(devices):
+    """Once a batch left the admission queue its tickets are the
+    service's to resolve: a submission failure (engine closed between
+    take_ready and submit) fails THAT batch typed and still submits /
+    fails the remaining taken batches — never strands a waiter
+    (regression pin)."""
+    from pencilarrays_tpu.serve import PlanService
+
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(17)
+    engine = Engine("strand")
+    svc = PlanService(max_batch=4, max_wait_s=0.0, engine=engine)
+    host = (rng.standard_normal((8, 6, 4))
+            + 1j * rng.standard_normal((8, 6, 4))).astype(np.complex64)
+    fwd = svc.submit("t", host, plan=plan)
+    bwd = svc.submit("t", host, plan=plan, direction="backward")
+    engine.close()
+    # two keys -> two batches; BOTH are taken and both fail typed
+    assert svc.step(flush=True) == 2
+    for tk in (fwd, bwd):
+        with pytest.raises(EngineClosedError):
+            tk.result(0)
+    svc.close()
+
+
+def test_stale_generation_dispatch_skips_log():
+    """A quiesce-timeout survivor finishing after a reform must not
+    append its old (lower) enqueue_seq behind new-generation records —
+    that made verify_dispatch_log raise a spurious DispatchOrderError
+    on a healthy engine (regression pin).  Its future still resolves
+    and the engine is not left busy."""
+    engine = Engine("stale", workers=1)
+    started, release = threading.Event(), threading.Event()
+
+    def slow():
+        started.set()
+        release.wait(30)
+        return "slow"
+
+    f_old = engine.submit(slow, label="old-gen")
+    assert started.wait(10)
+    engine.reform(timeout=0.05)     # quiesce times out on the stuck
+    # dispatch; reform writes it off and proceeds
+    f_new = engine.submit(lambda: "new", label="new-gen")
+    assert f_new.result(10) == "new"
+    release.set()
+    assert f_old.result(10) == "slow"
+    assert [r.label for r in engine.dispatch_log()] == ["new-gen"]
+    assert spmd.verify_dispatch_log(
+        engine.dispatch_log(), source="stale")["order_ok"]
+    assert not engine.stats()["busy"]
+    engine.close()
+
+
+def test_quiesce_waits_for_mid_flight_timer():
+    """A firing timer tick is in-flight work: a streaming pump mid-
+    tick submits dispatches, so quiesce() must wait it out exactly
+    like a run-stage dispatch (regression pin — timer work used to be
+    invisible to quiesce, letting a reformation proceed under a
+    running tick)."""
+    engine = Engine("timerbusy")
+    started, release = threading.Event(), threading.Event()
+
+    def tick():
+        started.set()
+        release.wait(10)
+
+    engine.call_later(0.0, tick)
+    assert started.wait(10)
+    assert not engine.quiesce(0.2)      # tick mid-flight: times out
+    release.set()
+    assert engine.quiesce(10)           # tick done: quiesce completes
+    engine.resume()
+    engine.close()
+
+
+def test_dispatch_log_meta_is_a_snapshot():
+    """The logged meta is certification history: mutating the caller's
+    dict after the dispatch completes must not rewrite it."""
+    engine = Engine("snap")
+    meta = {"k": 1}
+    engine.submit(lambda: None, label="m", meta=meta).result(10)
+    meta["k"] = 2
+    rec = engine.dispatch_log()[-1]
+    assert rec.meta == {"k": 1}
+    assert rec.meta is not meta
+    engine.close()
+
+
 def test_reform_fails_held_dispatches_typed():
     engine = Engine("held")
     assert engine.quiesce(5)
