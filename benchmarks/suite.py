@@ -755,6 +755,18 @@ def main():
                              "commit the BENCH_EXEC.json artifact)")
     parser.add_argument("--engine-steps", type=int, default=20,
                         help="steps per pass in the executor arm")
+    parser.add_argument("--wire", action="store_true",
+                        help="also run the reduced-precision wire arm "
+                             "(benchmarks/wire_bench.py): per-format "
+                             "transpose round-trip timing with the "
+                             "halved-byte HLO pin, plus NS/diffusion "
+                             "spectral-consumer error envelopes; "
+                             "writes BENCH_WIRE.json")
+    parser.add_argument("--wire-only", action="store_true",
+                        help="run ONLY the --wire arm (used to commit "
+                             "the BENCH_WIRE.json artifact)")
+    parser.add_argument("--wire-n", type=int, default=32,
+                        help="cube edge of the wire arm's grid")
     args = parser.parse_args()
 
     import jax
@@ -906,6 +918,29 @@ def main():
                     "n_devices": len(devs)}, "BENCH_EXEC.json",
                    devs=devs)
         if args.engine_only:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+            print(json.dumps(results, indent=1))
+            return
+
+    # -- 16. wire: reduced-precision exchange payloads (opt-in) ------------
+    # The ISSUE 13 headline: bf16/f16 wire formats halve priced AND
+    # measured exchange bytes (HLO-pinned inside the artifact) with the
+    # spectral consumers' accuracy envelopes measured end to end —
+    # committed as BENCH_WIRE.json.
+    if args.wire or args.wire_only:
+        from benchmarks.wire_bench import run_wire_suite
+        from benchmarks.wire_bench import write_artifact as write_wire
+
+        results["wire"] = run_wire_suite(
+            devs, n=args.wire_n,
+            k1=4 if len(devs) > 1 else 8,
+            repeats=3)
+        write_wire({**results["wire"],
+                    "platform": devs[0].platform,
+                    "n_devices": len(devs)}, "BENCH_WIRE.json",
+                   devs=devs)
+        if args.wire_only:
             with open(args.out, "w") as f:
                 json.dump(results, f, indent=1)
             print(json.dumps(results, indent=1))

@@ -1,0 +1,215 @@
+"""Reduced-precision wire benchmark — writes ``BENCH_WIRE.json``.
+
+Three questions, answered with measurements (the BENCH_* discipline:
+every claim carries its own noise floor):
+
+1. **speed** — seconds per transpose round trip at each wire format
+   (``None`` / ``bf16`` / ``f16``) on the actual mesh, via the hardened
+   K-differenced device-timing protocol (``utils/benchtime.py``).  On
+   the CPU virtual mesh the "wire" is memcpy bandwidth, so the headline
+   is a *validation* number (the packed program runs, bytes halve, the
+   cast overhead is visible); real ICI speedups come from TPU captures
+   of the same suite;
+2. **bytes** — the priced exchange bytes per wire format, HLO-pinned:
+   the artifact records both the analytic prediction AND the compiled
+   program's measured collective stats, and ``hlo_pinned`` asserts they
+   are EQUAL (the acceptance gate: a packing regression that stopped
+   halving wire bytes fails the committed artifact, not just a test);
+3. **accuracy** — per-workload error envelopes for the spectral
+   consumers (the ROADMAP's end-to-end validation): the Navier-Stokes
+   model steps Taylor-Green forward and the diffusion model runs its
+   exact propagator, each at every wire format, compared against the
+   full-precision run — max/L2 relative error and "ULPs at scale"
+   (max abs error over the f32 spacing at the field's magnitude), the
+   numbers ``docs/WirePrecision.md`` quotes when advising bf16 vs f16.
+
+Usage: ``python benchmarks/wire_bench.py [--devices N] [--n 32]`` or
+``python benchmarks/suite.py --wire`` (registered opt-in arm).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WIRE_FORMATS = (None, "bf16", "f16")
+
+
+def _err_stats(ref: np.ndarray, got: np.ndarray) -> dict:
+    """Error envelope of ``got`` against the full-precision ``ref``:
+    max/L2 relative error at the field's scale, plus ULPs-at-scale
+    (absolute error over the f32 spacing at ``max|ref|`` — how many
+    representable f32 steps the worst element moved)."""
+    ref = np.asarray(ref)
+    got = np.asarray(got)
+    if np.iscomplexobj(ref) or np.iscomplexobj(got):
+        ref = np.stack([ref.real, ref.imag])
+        got = np.stack([got.real, got.imag])
+    ref64 = ref.astype(np.float64)
+    got64 = got.astype(np.float64)
+    scale = float(np.max(np.abs(ref64)))
+    diff = np.abs(got64 - ref64)
+    l2 = float(np.linalg.norm(diff.ravel())
+               / max(np.linalg.norm(ref64.ravel()), 1e-300))
+    rel_max = float(np.max(diff) / max(scale, 1e-300))
+    ulp = float(np.max(diff) / np.spacing(np.float32(max(scale, 1e-30))))
+    return {"rel_err_max": rel_max, "rel_err_l2": l2,
+            "ulp_at_scale": ulp}
+
+
+def _transpose_arm(topo, shape, dtype, k1, repeats) -> dict:
+    """Per-wire-format transpose round-trip timing + the HLO byte pin."""
+    import jax.numpy as jnp
+
+    from pencilarrays_tpu import Pencil, PencilArray
+    from pencilarrays_tpu.analysis import spmd
+    from pencilarrays_tpu.ops.pallas_kernels import pallas_enabled
+    from pencilarrays_tpu.parallel.transpositions import (
+        AllToAll, _compiled_transpose, assert_compatible, transpose_cost)
+    from pencilarrays_tpu.utils.benchtime import (device_seconds_per_iter,
+                                                  last_spread)
+
+    M = topo.ndims
+    pin = Pencil(topo, shape, tuple(range(1, M + 1)))
+    pout = Pencil(topo, shape, (0,) + tuple(range(2, M + 1)))
+    R = assert_compatible(pin, pout)
+    x0 = PencilArray.zeros(pin, (), dtype).data
+    out: dict = {}
+    t_full = None
+    for wire in WIRE_FORMATS:
+        m = AllToAll(wire_dtype=wire)
+        fwd = _compiled_transpose(pin, pout, R, 0, m, False,
+                                  pallas_enabled())
+        bwd = _compiled_transpose(pout, pin, R, 0, m, False,
+                                  pallas_enabled())
+        t = device_seconds_per_iter(lambda d: bwd(fwd(d)), x0,
+                                    k0=1, k1=k1, repeats=repeats) / 2.0
+        predicted = transpose_cost(pin, pout, (), dtype, m)
+        measured = spmd.trace_transpose(pin, pout, (), dtype, m).stats()
+        key = wire or "none"
+        if wire is None:
+            t_full = t
+        out[key] = {
+            "seconds_per_hop": t,
+            "k1_spread": last_spread().get("k1_worst_over_best"),
+            "predicted": predicted,
+            "measured": measured,
+            "hlo_pinned": predicted == measured,
+            "predicted_bytes": sum(v["bytes"] for v in predicted.values()),
+            "speedup_vs_full": (t_full / t) if t_full else None,
+        }
+    return out
+
+
+def _ns_arm(topo, n, steps=3) -> dict:
+    """Navier-Stokes spectral consumer: Taylor-Green stepped ``steps``
+    times per wire format; error envelope of the spectral state vs the
+    full-precision run."""
+    import jax
+
+    from pencilarrays_tpu import gather
+    from pencilarrays_tpu.models import NavierStokesSpectral, taylor_green
+
+    ref = None
+    out: dict = {}
+    for wire in WIRE_FORMATS:
+        model = NavierStokesSpectral(topo, n, viscosity=1e-3,
+                                     wire_dtype=wire)
+        uh = taylor_green(model)
+        for _ in range(steps):
+            uh = model.step(uh, 1e-3)
+        state = np.asarray(gather(uh))
+        jax.block_until_ready(uh.data)
+        if wire is None:
+            ref = state
+            out["none"] = {"rel_err_max": 0.0, "rel_err_l2": 0.0,
+                           "ulp_at_scale": 0.0}
+        else:
+            out[wire] = _err_stats(ref, state)
+    return {"what": f"NS Taylor-Green {n}^3, {steps} RK2 steps, "
+                    f"spectral-state error vs full precision", **out}
+
+
+def _diffusion_arm(topo, n, t=0.05) -> dict:
+    """Diffusion spectral consumer: the exact propagator over ``t``
+    per wire format vs the full-precision solution."""
+    from pencilarrays_tpu import Pencil, PencilArray, gather
+    from pencilarrays_tpu.models.diffusion import DiffusionSpectral
+
+    rng = np.random.default_rng(7)
+    u0_host = rng.standard_normal((n, n, n)).astype(np.float32)
+    ref = None
+    out: dict = {}
+    for wire in WIRE_FORMATS:
+        model = DiffusionSpectral(topo, n, kappa=0.5, wire_dtype=wire)
+        u0 = PencilArray.from_global(model.plan.input_pencil, u0_host)
+        u_t = np.asarray(gather(model.solve(u0, t)))
+        if wire is None:
+            ref = u_t
+            out["none"] = {"rel_err_max": 0.0, "rel_err_l2": 0.0,
+                           "ulp_at_scale": 0.0}
+        else:
+            out[wire] = _err_stats(ref, u_t)
+    return {"what": f"diffusion exact propagator {n}^3 to t={t}, "
+                    f"physical-space error vs full precision", **out}
+
+
+def run_wire_suite(devs, n: int = 32, k1: int = 6, repeats: int = 3,
+                   ns_steps: int = 3) -> dict:
+    """The full ``--wire`` arm (importable: the slow-marked smoke test
+    runs it at a tiny ``n``)."""
+    import jax.numpy as jnp
+
+    from pencilarrays_tpu import Topology, dims_create
+
+    dims = dims_create(len(devs), 2) if len(devs) > 1 else (1,)
+    topo = Topology(dims, devices=devs) if len(dims) > 1 else Topology(
+        (1,), devices=devs)
+    results: dict = {"shape": [n, n, n], "topo": list(topo.dims)}
+    if len(devs) > 1:
+        results["transpose_f32"] = _transpose_arm(
+            topo, (n, n, n), jnp.float32, k1, repeats)
+        results["transpose_c64"] = _transpose_arm(
+            topo, (n, n, n), jnp.complex64, k1, repeats)
+        results["hlo_pinned"] = all(
+            e["hlo_pinned"]
+            for arm in ("transpose_f32", "transpose_c64")
+            for e in results[arm].values())
+    results["workload_navier_stokes"] = _ns_arm(topo, n, steps=ns_steps)
+    results["workload_diffusion"] = _diffusion_arm(topo, n)
+    return results
+
+
+def write_artifact(results: dict, path: str = "BENCH_WIRE.json",
+                   devs=None) -> None:
+    doc = dict(results)
+    if devs is not None:
+        doc.setdefault("platform", devs[0].platform)
+        doc.setdefault("n_devices", len(devs))
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--n", type=int, default=32)
+    parser.add_argument("--out", default="BENCH_WIRE.json")
+    args = parser.parse_args()
+
+    import jax
+
+    devs = jax.devices()[: args.devices]
+    results = run_wire_suite(devs, n=args.n)
+    write_artifact(results, args.out, devs=devs)
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,17 @@ can enforce at runtime:
     ``engine/`` — every other subsystem spawns through the engine's
     :func:`~pencilarrays_tpu.engine.threads.spawn_thread` choke point
     (named, inventoried, daemonic), so a new daemon thread cannot
-    appear anywhere else without a lint finding.
+    appear anywhere else without a lint finding;
+``wire-cast``
+    direct ``.astype(`` calls never touch exchange payloads: inside
+    the exchange-program modules (``parallel/transpositions.py``,
+    ``parallel/routing.py``) and the fused-hop builder
+    (``ops/fft.py`` ``_fused_hop_fn``) every element-type change goes
+    through the sanctioned reduced-precision pack/unpack helpers in
+    ``parallel/wire.py`` — an ad-hoc cast there would silently change
+    wire bytes out from under the HLO-pinned cost model and dodge the
+    guard's wire-tolerance contract (same enforcement pattern as
+    ``thread-spawn``: one audited choke point, empty allowlist).
 
 Everything is parsed from source with :mod:`ast` — the linter never
 imports the modules it checks, so it runs in milliseconds, cannot be
@@ -78,7 +88,16 @@ _MUTATING_METHODS = frozenset({
 })
 
 CHECKS = ("journal-event", "env-knob", "plan-cache", "fault-point",
-          "unlocked-state", "thread-spawn")
+          "unlocked-state", "thread-spawn", "wire-cast")
+
+# the exchange-program sources the wire-cast check audits: whole
+# modules whose traced bodies build exchange programs, plus named
+# functions in modules that only partly do (fft.py's fused hop builder
+# — its plan-level dtype coercions outside the fused program are
+# legitimate).  parallel/wire.py is the sanctioned choke point and is
+# exempt by construction.
+WIRE_CAST_MODULES = ("parallel/transpositions.py", "parallel/routing.py")
+WIRE_CAST_FUNCTIONS = {"ops/fft.py": ("_fused_hop_fn",)}
 
 
 @dataclass(frozen=True)
@@ -573,6 +592,51 @@ def _check_thread_spawn(root: str, trees: Dict[str, ast.Module],
         visit(tree, "<module>")
 
 
+def _check_wire_cast(root: str, trees: Dict[str, ast.Module],
+                     findings: List[Finding]) -> None:
+    """Exchange payloads change element type ONLY through
+    ``parallel/wire.py``'s pack/unpack (module docstring).  The ident
+    is ``<dotted module>.<enclosing function>`` (stable across
+    unrelated edits, the thread-spawn convention)."""
+    targets: Dict[str, Optional[Tuple[str, ...]]] = {
+        os.path.join(root, PACKAGE, *m.split("/")): None
+        for m in WIRE_CAST_MODULES}
+    for m, fns in WIRE_CAST_FUNCTIONS.items():
+        targets[os.path.join(root, PACKAGE, *m.split("/"))] = tuple(fns)
+    for path, tree in trees.items():
+        if path not in targets:
+            continue
+        only_fns = targets[path]
+        dotted = _module_dotted(root, path)
+
+        def visit(node: ast.AST, scope: str, inside: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                in_scope, in_target = scope, inside
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    in_scope = child.name
+                    if only_fns is not None:
+                        in_target = inside or child.name in only_fns
+                if (isinstance(child, ast.Call)
+                        and isinstance(child.func, ast.Attribute)
+                        and child.func.attr == "astype"
+                        and (only_fns is None or in_target)):
+                    ident = f"{dotted}.{scope}"
+                    findings.append(Finding(
+                        "wire-cast", _rel(root, path), child.lineno,
+                        ident,
+                        f"direct .astype( on a potential exchange "
+                        f"payload in {ident} — element-type changes in "
+                        f"exchange programs go through the sanctioned "
+                        f"pack/unpack helpers (parallel/wire.py), or "
+                        f"the HLO-pinned byte model and the guard's "
+                        f"wire tolerance silently diverge from the "
+                        f"bytes actually moved"))
+                visit(child, in_scope, in_target)
+
+        visit(tree, "<module>", only_fns is None)
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -601,6 +665,7 @@ def lint_tree(root: str) -> List[Finding]:
     _check_fault_points(root, trees, docs_resilience, findings)
     _check_unlocked_state(root, trees, findings)
     _check_thread_spawn(root, trees, findings)
+    _check_wire_cast(root, trees, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.check, f.ident))
     return findings
 

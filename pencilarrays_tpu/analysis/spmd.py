@@ -384,7 +384,7 @@ def predicted_peak_hbm(plan_or_route,
     import numpy as np
 
     from ..parallel.routing import _hop_peak_bytes
-    from ..parallel.transpositions import assert_compatible
+    from ..parallel.transpositions import _method_wire, assert_compatible
 
     peak, label = 0, "<empty>"
     if hasattr(plan_or_route, "hops"):          # ReshardRoute
@@ -393,7 +393,8 @@ def predicted_peak_hbm(plan_or_route,
         dt = np.dtype(dtype if dtype is not None else np.float32)
         for k, h in enumerate(route.hops):
             R = assert_compatible(h.src, h.dest)
-            p = _hop_peak_bytes(h.src, h.dest, R, extra, dt.itemsize)
+            p = _hop_peak_bytes(h.src, h.dest, R, extra, dt,
+                                _method_wire(h.method))
             if p > peak:
                 peak, label = p, f"route[{k}] {h.src.decomposition}->" \
                                  f"{h.dest.decomposition}"
@@ -404,11 +405,13 @@ def predicted_peak_hbm(plan_or_route,
     if extra_dims is None:
         extra_dims = plan.batch_dims
     extra = tuple(int(e) for e in extra_dims)
-    for k, (src, dst, hop_dtype, _base, _k_mult) in enumerate(
+    plan_wire = getattr(plan, "wire_dtype", None)
+    for k, (src, dst, hop_dtype, base, _k_mult) in enumerate(
             _iter_priced_hops(plan._steps)):
         R = assert_compatible(src, dst)
-        p = _hop_peak_bytes(src, dst, R, extra,
-                            np.dtype(hop_dtype).itemsize)
+        wire = _method_wire(base) if base is not None else plan_wire
+        p = _hop_peak_bytes(src, dst, R, extra, np.dtype(hop_dtype),
+                            wire)
         if p > peak:
             peak, label = p, f"hop[{k}] {src.decomposition}->" \
                              f"{dst.decomposition}"
@@ -466,10 +469,20 @@ def verify_dispatch_log(records: Sequence, *, source: str = "engine",
       prediction via :func:`verify_plan` (raises
       :class:`ScheduleMismatchError` naming the offending op).  Each
       distinct ``(plan_key, extra, direction)`` is traced once —
-      identical dispatches share one certification.
+      identical dispatches share one certification;
+    * **wire bytes** — a record whose ``meta`` carries ``wire_bytes``
+      (the payload size the dispatcher LOGGED for the exchange — the
+      serve layer and ``forward_async`` stamp it, wire dtype included)
+      is additionally checked against the plan's priced schedule at the
+      record's own ``extra_dims``: a logged payload size that disagrees
+      with what the schedule prices raises :class:`ScheduleMismatchError`
+      (op ``"wire-bytes"``) instead of certifying cleanly — before this
+      check, a mismatched payload (e.g. a full-precision dispatch
+      logged against a reduced-wire plan, or a stale batch size) passed
+      because only op identity/order was compared.
 
     Returns ``{"dispatches", "order_ok", "verified_traces",
-    "unverified", "ops"}``."""
+    "unverified", "wire_checked", "ops"}``."""
     records = list(records)
     prev_seq = None
     for pos, r in enumerate(records):
@@ -479,9 +492,10 @@ def verify_dispatch_log(records: Sequence, *, source: str = "engine",
                                      expected_seq=prev_seq + 1,
                                      observed_seq=seq)
         prev_seq = seq
-    verified, unverified, total_ops = 0, 0, 0
+    verified, unverified, total_ops, wire_checked = 0, 0, 0, 0
     if verify_traces:
         seen: Dict[tuple, int] = {}
+        priced: Dict[tuple, int] = {}
         for r in records:
             meta = getattr(r, "meta", None) or {}
             plan = meta.get("plan")
@@ -494,6 +508,17 @@ def verify_dispatch_log(records: Sequence, *, source: str = "engine",
             extra = tuple(meta.get("extra_dims", ()))
             direction = meta.get("direction", "forward")
             key = (plan.plan_key(), extra, direction)
+            if meta.get("wire_bytes") is not None:
+                if key[:2] not in priced:
+                    priced[key[:2]] = sum(
+                        v["bytes"]
+                        for v in plan.collective_costs(extra).values())
+                if int(meta["wire_bytes"]) != priced[key[:2]]:
+                    raise ScheduleMismatchError(
+                        f"{source} [{r.label}]", "wire-bytes",
+                        {"bytes": priced[key[:2]]},
+                        {"bytes": int(meta["wire_bytes"])})
+                wire_checked += 1
             if key not in seen:
                 seen[key] = len(verify_plan(plan, extra, direction))
             total_ops += seen[key]
@@ -502,7 +527,7 @@ def verify_dispatch_log(records: Sequence, *, source: str = "engine",
         unverified = len(records)
     return {"dispatches": len(records), "order_ok": True,
             "verified_traces": verified, "unverified": unverified,
-            "ops": total_ops}
+            "wire_checked": wire_checked, "ops": total_ops}
 
 
 # ---------------------------------------------------------------------------

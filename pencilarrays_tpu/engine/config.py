@@ -60,6 +60,7 @@ WATCHED_VARS: Tuple[str, ...] = (
     "PENCILARRAYS_TPU_GUARD_DIR",
     "PENCILARRAYS_TPU_GUARD_TIMEOUT",
     "PENCILARRAYS_TPU_GUARD_RTOL",
+    "PENCILARRAYS_TPU_GUARD_WIRE_RTOL",
     "PENCILARRAYS_TPU_GUARD_FINITE",
     # obs/
     "PENCILARRAYS_TPU_OBS",
@@ -121,6 +122,7 @@ class RuntimeConfig:
     guard_dir_env: str = "pa_guard"
     guard_timeout: float = 300.0
     guard_rtol: Optional[float] = None
+    guard_wire_rtol: Optional[float] = None
     guard_finite_every: int = 0
     # obs/ — same raw-value convention (the value can be the journal dir)
     obs_env: str = ""
@@ -173,6 +175,8 @@ class RuntimeConfig:
             guard_timeout=_float(g("PENCILARRAYS_TPU_GUARD_TIMEOUT"),
                                  300.0),
             guard_rtol=_opt_float(g("PENCILARRAYS_TPU_GUARD_RTOL")),
+            guard_wire_rtol=_opt_float(
+                g("PENCILARRAYS_TPU_GUARD_WIRE_RTOL")),
             guard_finite_every=max(0, finite if finite is not None else 0),
             obs_env=obs_env,
             obs_on=obs_env not in _OFF,

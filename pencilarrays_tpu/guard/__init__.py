@@ -62,7 +62,12 @@ import threading
 from contextlib import contextmanager
 from typing import Optional
 
-from .errors import GuardError, HangTimeoutError, IntegrityError  # noqa: F401
+from .errors import (  # noqa: F401
+    GuardError,
+    HangTimeoutError,
+    IntegrityError,
+    WirePrecisionError,
+)
 
 __all__ = [
     "ENV_VAR",
@@ -72,6 +77,7 @@ __all__ = [
     "FINITE_VAR",
     "GuardError",
     "IntegrityError",
+    "WirePrecisionError",
     "HangTimeoutError",
     "enabled",
     "enable",

@@ -8,7 +8,8 @@ so chaos drills can assert "typed guard error, never garbage" with one
 
 from __future__ import annotations
 
-__all__ = ["GuardError", "IntegrityError", "HangTimeoutError"]
+__all__ = ["GuardError", "IntegrityError", "WirePrecisionError",
+           "HangTimeoutError"]
 
 
 class GuardError(Exception):
@@ -34,6 +35,23 @@ class IntegrityError(GuardError):
         self.observed = observed
         self.kind = kind
         self.bundle = bundle
+
+
+class WirePrecisionError(IntegrityError):
+    """A reduced-precision (``wire_dtype``) hop's restored payload
+    drifted from its source beyond the wire format's modeled
+    quantization tolerance (``parallel/wire.py`` ``wire_rtol``; scaled
+    by the number of packed exchanges crossed, override
+    ``PENCILARRAYS_TPU_GUARD_WIRE_RTOL``).  Either the tolerance model
+    is wrong for this workload (raise the knob, or use full precision)
+    or the wire corrupted data — both are typed failures, never a
+    silent wrong answer.  Subclasses :class:`IntegrityError`, so every
+    existing chaos-drill ``except`` clause still catches it;
+    ``wire_dtype`` carries the offending format."""
+
+    def __init__(self, message: str, *, wire_dtype=None, **kw):
+        super().__init__(message, **kw)
+        self.wire_dtype = wire_dtype
 
 
 class HangTimeoutError(GuardError, TimeoutError):

@@ -37,7 +37,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from .errors import IntegrityError
+from .errors import IntegrityError, WirePrecisionError
 
 __all__ = [
     "probe_stats",
@@ -126,49 +126,86 @@ def _component_ok(a: float, b: float, tol_abs: float) -> bool:
 
 
 def probes_match(pre, post, count: int, dtype,
-                 *, finite: bool = False) -> Tuple[bool, str]:
+                 *, finite: bool = False,
+                 wire_dtype: Optional[str] = None,
+                 wire_hops: int = 1) -> Tuple[bool, str]:
     """Host-side compare of a probe pair.  Returns ``(ok, kind)`` where
-    ``kind`` is ``"sum"`` or ``"nonfinite"`` for the failing check."""
+    ``kind`` is ``"sum"``, ``"wire"`` or ``"nonfinite"`` for the
+    failing check.
+
+    With ``wire_dtype`` set the hop crossed a reduced-precision
+    exchange (``parallel/wire.py``): the restored payload legitimately
+    differs from the source by per-element quantization, so the
+    content-sum tolerance widens by the wire format's modeled rtol
+    (``wire_rtol``), scaled by ``wire_hops`` packed exchanges.
+    Exceeding the WIDENED tolerance reports ``kind="wire"`` — accuracy
+    loss beyond the model, raised typed as
+    :class:`~pencilarrays_tpu.guard.errors.WirePrecisionError` by
+    :func:`check_hop_probes`, never a silent wrong answer."""
+    from ..parallel.wire import wire_rtol
+
     pre = np.asarray(pre, dtype=np.float64)
     post = np.asarray(post, dtype=np.float64)
-    tol_abs = _default_rtol(count, dtype) * (abs(pre[2]) + 1.0)
+    rtol = _default_rtol(count, dtype)
+    if wire_dtype is not None:
+        rtol += max(1, int(wire_hops)) * wire_rtol(wire_dtype, count)
+    tol_abs = rtol * (abs(pre[2]) + 1.0)
     for i in (0, 1, 2):
         if not _component_ok(float(pre[i]), float(post[i]), tol_abs):
-            return False, "sum"
+            return False, "wire" if wire_dtype is not None else "sum"
     if finite and int(pre[3]) != int(post[3]):
         return False, "nonfinite"
     return True, "ok"
 
 
 def check_hop_probes(hop: str, pre, post, count: int, dtype, *,
-                     finite: bool = False, ctx: Optional[dict] = None) -> None:
+                     finite: bool = False,
+                     wire_dtype: Optional[str] = None,
+                     wire_hops: int = 1,
+                     ctx: Optional[dict] = None) -> None:
     """Verify one guarded hop's probe pair; on mismatch journal
     ``guard.sdc``, write a crash bundle and raise
-    :class:`IntegrityError`.  On success bumps
-    ``guard.checks{outcome="ok"}`` only (no journal traffic on the
-    clean path)."""
+    :class:`IntegrityError` (:class:`WirePrecisionError` when the hop
+    rode a ``wire_dtype`` exchange and the restored content exceeded
+    the per-dtype quantization tolerance — see :func:`probes_match`).
+    On success bumps ``guard.checks{outcome="ok"}`` only (no journal
+    traffic on the clean path)."""
     from .. import obs
 
-    ok, kind = probes_match(pre, post, count, dtype, finite=finite)
+    ok, kind = probes_match(pre, post, count, dtype, finite=finite,
+                            wire_dtype=wire_dtype, wire_hops=wire_hops)
     if ok:
         if obs.enabled():
             obs.counter("guard.checks", outcome="ok").inc()
         return
     predicted = [float(v) for v in np.asarray(pre)]
     observed = [float(v) for v in np.asarray(post)]
+    extra_ctx = dict(ctx or {})
+    if wire_dtype is not None:
+        extra_ctx.setdefault("wire_dtype", wire_dtype)
+        extra_ctx.setdefault("wire_hops", wire_hops)
     if obs.enabled():
         obs.counter("guard.checks", outcome=kind).inc()
         obs.record_event("guard.sdc", hop=hop, kind=kind,
                          predicted=predicted, observed=observed,
                          count=count, dtype=np.dtype(dtype).name,
-                         **(ctx or {}))
+                         **extra_ctx)
     from .bundle import write_crash_bundle
 
     bundle = write_crash_bundle(
         "sdc", hop,
         error=f"{kind} invariant mismatch: {predicted} -> {observed}",
         extra={"predicted": predicted, "observed": observed,
-               "kind": kind, **(ctx or {})})
+               "kind": kind, **extra_ctx})
+    if kind == "wire":
+        raise WirePrecisionError(
+            f"wire-precision tolerance exceeded on {hop}: content-sum "
+            f"drift beyond the {wire_dtype} quantization model across "
+            f"{wire_hops} packed exchange(s) (predicted {predicted}, "
+            f"observed {observed}; crash bundle: "
+            f"{bundle or 'unavailable'})",
+            hop=hop, predicted=predicted, observed=observed, kind=kind,
+            bundle=bundle, wire_dtype=wire_dtype)
     raise IntegrityError(
         f"silent data corruption detected on {hop}: {kind} invariant "
         f"mismatch (predicted {predicted}, observed {observed}; crash "

@@ -57,7 +57,8 @@ class NavierStokesSpectral:
 
     def __init__(self, topology: Topology, n, *, viscosity: float = 1e-2,
                  dtype=jnp.float32, dealias: bool = True,
-                 decomposition: Optional[str] = None):
+                 decomposition: Optional[str] = None,
+                 wire_dtype=None):
         if isinstance(n, int):
             n = (n, n, n)
         self.shape = tuple(n)
@@ -73,9 +74,13 @@ class NavierStokesSpectral:
         # score can pick a grid that is cheaper only for traffic the
         # model never sends (verdicts provably flip with the batch,
         # tests/test_throughput.py).
+        # wire_dtype opts the plan's exchanges into the reduced-
+        # precision wire format (docs/WirePrecision.md); transform math
+        # stays full precision and BENCH_WIRE.json carries this model's
+        # measured accuracy envelope per wire format
         self.plan = PencilFFTPlan(topology, self.shape, real=True,
                                   dtype=dtype, decomposition=decomposition,
-                                  batch=3)
+                                  batch=3, wire_dtype=wire_dtype)
         self.dealias = dealias
 
 

@@ -492,18 +492,27 @@ def _schedule_score(plan: "PencilFFTPlan", extra_dims: Tuple[int, ...],
     launch costs ``latency_bytes`` bytes-equivalent, wire bytes count
     at face value scaled by the hop's observed drift ratio (the PR-4
     discipline — a hop measured at 2x its modeled time gets its bytes
-    doubled).  Each hop is priced at the dtype AND extents the data
-    carries at that point of the schedule, so post-``rfft`` hops are
-    charged the Hermitian-half block, and ``extra_dims`` folds the
-    batch into every hop's bytes (count unchanged)."""
+    doubled), and a reduced-precision hop is charged its pack/unpack
+    cast traffic (``wire.cast_score_bytes``, HBM-discounted) on top of
+    its halved wire bytes.  Each hop is priced at the dtype AND extents
+    the data carries at that point of the schedule, so post-``rfft``
+    hops are charged the Hermitian-half block, and ``extra_dims`` folds
+    the batch into every hop's bytes (count unchanged)."""
     from ..parallel.routing import trusted_drift
-    from ..parallel.transpositions import _hop_label, transpose_cost
+    from ..parallel.transpositions import (_hop_label, _method_wire,
+                                           transpose_cost)
+    from ..parallel.wire import cast_score_bytes
 
     method = plan.method
     if isinstance(method, Auto) and method.mode == "measure":
         # scoring must stay cheap and deterministic (the _try_fuse_hop
-        # convention): decide from the analytic model, never benchmark
-        method = Auto(mode="estimate", latency_bytes=method.latency_bytes)
+        # convention): decide from the analytic model, never benchmark.
+        # replace() keeps every other field — the wire_dtype in
+        # particular, or a measure-mode wire plan would be scored at
+        # full-precision bytes
+        from dataclasses import replace
+
+        method = replace(method, mode="estimate")
     score = hops = total_bytes = total_count = 0
     for src, dst, hop_dtype, base, k_mult in _iter_priced_hops(plan._steps):
         if base is None:
@@ -523,7 +532,9 @@ def _schedule_score(plan: "PencilFFTPlan", extra_dims: Tuple[int, ...],
         drift = trusted_drift(drift_hops, _hop_label(src, dst, m, hop_dtype))
         count = sum(v["count"] for v in cost.values()) * k_mult
         nbytes = sum(v["bytes"] for v in cost.values())
-        score += int(count * latency_bytes + nbytes * drift)
+        score += int(count * latency_bytes + nbytes * drift
+                     + cast_score_bytes(nbytes, hop_dtype,
+                                        _method_wire(m)))
         hops += 1
         total_bytes += nbytes
         total_count += count
@@ -649,6 +660,22 @@ class PencilFFTPlan:
     ``vmap``) over the same plan.  Headline metric: transforms/sec at
     fixed mesh (``benchmarks/throughput.py``, ``BENCH_THROUGHPUT.json``).
 
+    ``wire_dtype="bf16" | "f16"`` (default ``None`` = full precision,
+    bit-identical to today) opts every exchange hop into the
+    reduced-precision wire format: payloads are cast-packed to the wire
+    dtype immediately before each collective and restored immediately
+    after, inside the same jitted/shard_map program, so XLA fuses the
+    casts into the exchange boundaries and the collective itself moves
+    half the bytes (f32/c64 payloads; complex hops split-complex pack —
+    see ``docs/WirePrecision.md`` for the accuracy model and the
+    guard's typed :class:`~pencilarrays_tpu.guard.errors.
+    WirePrecisionError` tolerance contract).  Transform math stays full
+    precision.  Priced end-to-end: ``collective_costs`` reports the
+    halved wire bytes (still HLO-pinned), ``plan_key()`` fingerprints
+    the wire dtype (mixed-precision serve traffic never coalesces
+    together), and ``Auto``/``decomposition="auto"``/the reshard route
+    planner select with it.
+
     ``decomposition="auto" | "slab" | "pencil"`` re-factorizes the
     topology's devices into the cheapest admissible process grid:
     every 1-D (slab) and 2-D (pencil) candidate's full schedule is
@@ -669,9 +696,28 @@ class PencilFFTPlan:
                  normalization: str = "backward",
                  pipeline=None, batch: Optional[int] = None,
                  decomposition: Optional[str] = None,
+                 wire_dtype=None,
                  _probe: bool = False):
         global_shape = tuple(int(n) for n in global_shape)
         N = len(global_shape)
+        # -- reduced-precision wire format --------------------------------
+        # ``wire_dtype="bf16" | "f16"`` (default None = full precision,
+        # bit-identical) packs EVERY exchange hop's payload down to the
+        # wire format immediately before its collective and restores it
+        # after, inside the same program (parallel/wire.py) — transform
+        # math and accumulation stay full precision; only the wire
+        # narrows (bytes ÷2 for f32/c64 payloads, HLO-pinned).  The
+        # plan's method carries it (with_wire), so pricing, execution,
+        # plan_key() and the guard's tolerance model all see one truth.
+        from ..parallel.transpositions import with_wire
+        from ..parallel.wire import canonical_wire_dtype
+
+        self.wire_dtype = canonical_wire_dtype(wire_dtype)
+        method = with_wire(method, self.wire_dtype)
+        if self.wire_dtype is None:
+            from ..parallel.transpositions import _method_wire
+
+            self.wire_dtype = _method_wire(method)
         # -- batched throughput mode --------------------------------------
         # ``batch=B`` declares B independent transforms sharing this ONE
         # exchange schedule: allocate_input/allocate_output/compile/
@@ -984,9 +1030,11 @@ class PencilFFTPlan:
             # decide it from the analytic model rather than running
             # device benchmarks inside __init__ (measure-mode Auto
             # still times the plan's serialized "t" hops lazily, at
-            # first transpose, as before)
-            method = Auto(mode="estimate",
-                          latency_bytes=method.latency_bytes)
+            # first transpose, as before).  replace() keeps the wire
+            # dtype riding the downgraded resolution
+            from dataclasses import replace
+
+            method = replace(method, mode="estimate")
         # _quiet for probe plans: a discarded candidate's fused-hop
         # resolution must neither journal a phantom auto.verdict nor
         # poison the per-run dedup against the built plan's own verdict
@@ -1083,7 +1131,7 @@ class PencilFFTPlan:
                 self.decomposition_verdict["candidates"])
         else:
             decomp = {"mode": "fixed", "winner": list(self.topology.dims)}
-        return {
+        summary = {
             "shape": list(self.shape_physical),
             "transforms": list(self.transforms),
             # input dtype: single-device plans have no exchange steps
@@ -1093,7 +1141,9 @@ class PencilFFTPlan:
             "topo": list(self.topology.dims),
             "method": _method_label(self.method)
             if not isinstance(self.method, Auto)
-            else f"Auto({self.method.mode})",
+            else f"Auto({self.method.mode})"
+            + (f"[wire={self.method.wire_dtype}]"
+               if self.method.wire_dtype else ""),
             "pipeline": self.pipeline_chunks,
             "normalization": self.normalization,
             # schema v3 (obs/schema.py): the batch the plan prices its
@@ -1103,6 +1153,13 @@ class PencilFFTPlan:
             "steps": steps,
             "predicted_costs": costs,
         }
+        if self.wire_dtype is not None:
+            # reduced-wire plans fingerprint apart from full-precision
+            # siblings (serve coalescing must never mix the two); the
+            # key is absent when the wire is off, so every historical
+            # plan_key is byte-stable
+            summary["wire_dtype"] = self.wire_dtype
+        return summary
 
     # -- pencils ----------------------------------------------------------
     @property
@@ -1172,6 +1229,28 @@ class PencilFFTPlan:
                 m = m.base
             add(src, dst, hop_dtype, m, k_mult=k_mult)
         return total
+
+    def predicted_wire_bytes(self, extra_dims: Optional[Tuple[int, ...]]
+                             = None) -> int:
+        """Total predicted per-chip collective bytes of ONE forward (or
+        backward) application — the scalar the engine dispatch log
+        carries (``meta["wire_bytes"]``) and
+        ``analysis.spmd.verify_dispatch_log`` re-checks against the
+        plan's priced schedule, so a dispatch whose logged payload size
+        disagrees with the schedule it claims to run fails typed.  With
+        ``wire_dtype`` set this is the HALVED byte figure (the wire
+        format is part of the price).  Cached per ``extra_dims`` on the
+        plan instance: this is stamped on every async/serve dispatch,
+        and the analytic pricing walk must not ride the hot dispatch
+        path the executor exists to keep short."""
+        if extra_dims is None:
+            extra_dims = self.batch_dims
+        key = tuple(int(e) for e in extra_dims)
+        cache = self.__dict__.setdefault("_wire_bytes_cache", {})
+        if key not in cache:
+            cache[key] = sum(
+                v["bytes"] for v in self.collective_costs(key).values())
+        return cache[key]
 
     def allocate_input(self, extra_dims: Optional[Tuple[int, ...]] = None
                        ) -> PencilArray:
@@ -1454,7 +1533,10 @@ class PencilFFTPlan:
             return eng.submit(lambda: run_plan(u, donate=donate),
                               label=label,
                               meta={"plan": self, "direction": direction,
-                                    "extra_dims": u.extra_dims})
+                                    "extra_dims": u.extra_dims,
+                                    "wire_dtype": self.wire_dtype,
+                                    "wire_bytes": self.predicted_wire_bytes(
+                                        u.extra_dims)})
         pen = self.input_pencil if fwd else self.output_pencil
         dt = self.dtype_physical if fwd else self.dtype_spectral
         base_ndim = len(self.shape_physical)
@@ -1464,11 +1546,14 @@ class PencilFFTPlan:
         # snapshots it into the log after run returns), so
         # verify_dispatch_log re-traces the program that actually
         # dispatched — never a false unbatched certification
-        meta = {"plan": self, "direction": direction}
+        meta = {"plan": self, "direction": direction,
+                "wire_dtype": self.wire_dtype}
 
         def run(host):
             host = np.asarray(host, dtype=dt)
             meta["extra_dims"] = tuple(host.shape[base_ndim:])
+            meta["wire_bytes"] = self.predicted_wire_bytes(
+                meta["extra_dims"])
             arr = PencilArray.from_global(
                 pen, host, extra_ndims=host.ndim - base_ndim)
             # the scatter's buffer is plan-owned: donate it to the

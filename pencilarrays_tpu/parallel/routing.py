@@ -71,19 +71,20 @@ from .transpositions import (
     Gspmd,
     Pipelined,
     Ring,
+    _exchange_factory,
     _exchange_operand_extents,
+    _exchange_transpose,
     _hop_label,
     _method_label,
+    _method_wire,
     _metered_cached,
-    _transpose_all_to_all,
     _transpose_local,
-    _transpose_pipelined,
-    _transpose_ring,
     assert_compatible,
     gspmd_reshard_cost,
     resolve_method,
     transpose_cost,
 )
+from .wire import cast_score_bytes, wire_itemsize
 
 __all__ = [
     "ReshardRoute",
@@ -200,32 +201,43 @@ class ReshardRoute:
         return (self.src,) + tuple(h.dest for h in self.hops)
 
 
-def _score(cost: dict, latency_bytes: int, drift: float = 1.0) -> int:
+def _score(cost: dict, latency_bytes: int, drift: float = 1.0,
+           dtype=None, wire_dtype: Optional[str] = None) -> int:
     """Bytes-equivalent score of one priced hop — the Auto(estimate)
     currency: each collective launch costs ``latency_bytes``
     bytes-equivalent, wire bytes count at face value scaled by the
-    hop's observed drift ratio (1.0 when unmeasured)."""
+    hop's observed drift ratio (1.0 when unmeasured), and a
+    reduced-precision edge is additionally charged its pack/unpack
+    cast traffic (``wire.cast_score_bytes`` — HBM-discounted, so the
+    wire's halved ICI bytes win unless the hop was tiny)."""
     count = sum(v["count"] for v in cost.values())
     nbytes = sum(v["bytes"] for v in cost.values())
-    return int(count * latency_bytes + nbytes * drift)
+    return int(count * latency_bytes + nbytes * drift
+               + cast_score_bytes(nbytes, dtype, wire_dtype))
 
 
 def _hop_peak_bytes(pin: Pencil, pout: Pencil, R: Optional[int],
-                    extra_dims: Tuple[int, ...], isize: int) -> int:
+                    extra_dims: Tuple[int, ...], dtype,
+                    wire_dtype: Optional[str] = None) -> int:
     """Per-chip HBM high-water mark of one hop: the exchanged operand
     (logical local block with the to-be-split dim padded — the shape the
     byte model prices) plus its same-sized result, both live across the
-    collective.  Local permutes charge in+out blocks."""
+    collective.  Local permutes charge in+out blocks.  A reduced-wire
+    hop's exchanged operand is the PACKED block (half the bytes), its
+    restored result full precision — which is how a reduced-precision
+    edge can fit under an ``hbm_limit`` that pruned its full-precision
+    sibling."""
     import numpy as np
 
-    if R is None:  # local permute: in + out blocks
+    isize = np.dtype(dtype if dtype is not None else np.float32).itemsize
+    if R is None:  # local permute: in + out blocks (nothing packs)
         return (pin.bytes_per_device(extra_dims, isize=isize)
                 + pout.bytes_per_device(extra_dims, isize=isize))
     ext = _exchange_operand_extents(pin, pout, R)
     elems = int(np.prod(ext, dtype=np.int64))
     for e in extra_dims:
         elems *= int(e)
-    return 2 * elems * isize
+    return elems * (wire_itemsize(dtype, wire_dtype) + isize)
 
 
 def _node_pencil(node: Tuple[int, ...], pin: Pencil, dest: Pencil) -> Pencil:
@@ -266,9 +278,11 @@ def _plan_cached(pin: Pencil, dest: Pencil, extra_dims: Tuple[int, ...],
         cost = transpose_cost(psrc, pdst, extra_dims, dtype, m)
         drift = trusted_drift(drift_hops, _hop_label(psrc, pdst, m, dtype))
         R = assert_compatible(psrc, pdst)
-        peak = _hop_peak_bytes(psrc, pdst, R, extra_dims, dtype.itemsize)
+        wire = _method_wire(m)
+        peak = _hop_peak_bytes(psrc, pdst, R, extra_dims, dtype, wire)
         return RouteHop(psrc, pdst, m, cost,
-                        _score(cost, latency_bytes, drift), peak)
+                        _score(cost, latency_bytes, drift, dtype, wire),
+                        peak)
 
     hops: Tuple[RouteHop, ...] = ()
     searched = 0
@@ -372,8 +386,11 @@ def plan_reshard_route(pin: Pencil, dest: Pencil,
                          "pass an explicit exchange method or Auto()")
     if isinstance(method, Auto) and method.mode == "measure":
         # planning stays deterministic & benchmark-free (the fused-hop
-        # planner's convention, ops/fft.py:_try_fuse_hop)
-        method = Auto(mode="estimate", latency_bytes=method.latency_bytes)
+        # planner's convention, ops/fft.py:_try_fuse_hop); replace()
+        # keeps the wire_dtype riding the downgraded resolution
+        from dataclasses import replace
+
+        method = replace(method, mode="estimate")
     latency = method.latency_bytes if isinstance(method, Auto) \
         else Auto().latency_bytes
     dt = np.dtype(dtype if dtype is not None else np.float32)
@@ -393,12 +410,12 @@ def _apply_hop(data, pin: Pencil, pout: Pencil, R: Optional[int],
                method: AbstractTransposeMethod, extra_ndims: int):
     if R is None:
         return _transpose_local(data, pin, pout, extra_ndims)
-    if isinstance(method, AllToAll):
-        return _transpose_all_to_all(data, pin, pout, R, extra_ndims)
-    if isinstance(method, Ring):
-        return _transpose_ring(data, pin, pout, R, extra_ndims)
-    if isinstance(method, Pipelined):
-        return _transpose_pipelined(data, pin, pout, R, extra_ndims, method)
+    if isinstance(method, (AllToAll, Ring, Pipelined)):
+        # the factory owns the method's chunking and wire pack/unpack —
+        # the same one-path rule as transpositions._hop_body, so a
+        # routed edge's wire_dtype packs exactly like a standalone hop
+        return _exchange_transpose(data, pin, pout, R, extra_ndims,
+                                   _exchange_factory(method, pin, pout))
     raise TypeError(f"no explicit hop executor for method {method!r}")
 
 
@@ -484,10 +501,22 @@ def _execute_route_guarded(src: PencilArray, route: ReshardRoute,
         else:
             out, probes = fn(src.data)
         count = int(src.data.size)
+        wired, wire_hops = None, 0
         for k, h in enumerate(route.hops):
+            # each post-probe is compared against the SOURCE probe, so
+            # a wire hop anywhere upstream makes the compare
+            # tolerance-bound by the wire model from that hop on —
+            # scaled by how many packed exchanges the data has crossed
+            hop_wire = _method_wire(h.method)
+            if hop_wire is not None:
+                # mixed-wire chains bound by the coarsest format seen
+                wired = ("bf16" if "bf16" in (wired, hop_wire)
+                         else hop_wire)
+                wire_hops += 1
             gi.check_hop_probes(
                 f"route[{k}] {_hop_label(h.src, h.dest, h.method, src.dtype)}",
                 probes[0], probes[k + 1], count, src.dtype, finite=finite,
+                wire_dtype=wired, wire_hops=wire_hops,
                 ctx={"hop_index": k, "hops": len(route.hops)})
     return PencilArray(route.dest, out, src.extra_dims)
 

@@ -87,6 +87,7 @@ __all__ = [
     "Transposition",
     "transpose",
     "transpose_cost",
+    "with_wire",
     "gspmd_reshard_cost",
     "resolve_method",
     "reshard",
@@ -99,9 +100,73 @@ class AbstractTransposeMethod:
     pass
 
 
+def _canon_wire_field(method) -> None:
+    """Normalize a frozen method's ``wire_dtype`` field at construction
+    (``"bfloat16"`` and jnp dtypes collapse to the canonical ``"bf16"``/
+    ``"f16"`` spelling, so method equality/hashing — the executable
+    cache key — never splits on spelling)."""
+    from .wire import canonical_wire_dtype
+
+    object.__setattr__(method, "wire_dtype",
+                       canonical_wire_dtype(method.wire_dtype))
+
+
+def _method_wire(method: "AbstractTransposeMethod") -> Optional[str]:
+    """The wire dtype one concrete method puts on the fabric (``None``
+    = full precision).  Pipelined hops inherit their base's wire; Gspmd
+    has no explicit exchange to pack."""
+    if isinstance(method, (AllToAll, Ring, Auto)):
+        return method.wire_dtype
+    if isinstance(method, Pipelined):
+        return _method_wire(method.base)
+    return None
+
+
+def with_wire(method: "AbstractTransposeMethod",
+              wire_dtype) -> "AbstractTransposeMethod":
+    """Return ``method`` carrying ``wire_dtype`` on its exchange(s) —
+    the plan-level spelling (``PencilFFTPlan(wire_dtype=...)`` wraps
+    its method through here).  ``None`` passes the method through
+    unchanged; a method that already carries a DIFFERENT wire dtype is
+    a conflict, not a silent override."""
+    from dataclasses import replace
+
+    from .wire import canonical_wire_dtype
+
+    wire = canonical_wire_dtype(wire_dtype)
+    if wire is None:
+        return method
+    cur = _method_wire(method)
+    if cur is not None and cur != wire:
+        raise ValueError(
+            f"method {method!r} already carries wire_dtype={cur!r}; "
+            f"conflicting wire_dtype={wire!r} requested")
+    if isinstance(method, (AllToAll, Ring, Auto)):
+        return replace(method, wire_dtype=wire)
+    if isinstance(method, Pipelined):
+        return replace(method, base=with_wire(method.base, wire))
+    raise ValueError(
+        f"wire_dtype is only supported on explicit exchange methods "
+        f"(AllToAll/Ring/Pipelined) and Auto; got {method!r} (Gspmd "
+        f"exchanges are partitioner-owned and cannot be packed)")
+
+
 @dataclass(frozen=True)
 class AllToAll(AbstractTransposeMethod):
-    """Explicit single-axis ``lax.all_to_all`` under ``shard_map``."""
+    """Explicit single-axis ``lax.all_to_all`` under ``shard_map``.
+
+    ``wire_dtype="bf16" | "f16"`` (default ``None`` = full precision,
+    bit-identical to the historical behavior) packs the exchanged
+    payload down to the reduced wire format immediately before the
+    collective and restores it immediately after, inside the same
+    traced program (``parallel/wire.py``): the wire moves half the
+    bytes (f32/c64; a quarter for f64/c128) while all surrounding math
+    stays full precision.  Complex payloads split-complex pack."""
+
+    wire_dtype: Optional[str] = None
+
+    def __post_init__(self):
+        _canon_wire_field(self)
 
 
 @dataclass(frozen=True)
@@ -117,10 +182,17 @@ class Ring(AbstractTransposeMethod):
     ``Transpositions.jl:61-65, 510-516``), re-expressed so XLA's
     latency-hiding scheduler can overlap rounds with the unpack placement.
     RAGGED-AWARE: runs G-1 rounds among the G nonempty ceil-rule
-    participants instead of P-1 (see :func:`_transpose_ring`).
+    participants instead of P-1 (see :func:`_ring_factory`).
     Data movement is bit-identical to :class:`AllToAll`; which is faster
     is a hardware/topology question (shifted ppermute rounds the fabric
-    routes over up to r hops each, vs one fused collective)."""
+    routes over up to r hops each, vs one fused collective).
+    ``wire_dtype`` as on :class:`AllToAll`: every ppermute round's tile
+    rides the fabric in the reduced wire format."""
+
+    wire_dtype: Optional[str] = None
+
+    def __post_init__(self):
+        _canon_wire_field(self)
 
 
 # reference method-name aliases (Transpositions.jl:17-24)
@@ -229,16 +301,25 @@ class Auto(AbstractTransposeMethod):
 
     Either way the data movement is bit-identical across candidates
     (test-pinned), so Auto never changes results — only scheduling.
+
+    ``wire_dtype`` rides the resolution: every candidate (and the
+    winner) carries it, so an ``Auto(wire_dtype="bf16")`` hop prices
+    AND executes the halved-byte exchange whichever method wins (the
+    method choice itself is wire-invariant in estimate mode — both
+    scores scale by the same per-element wire bytes — but measure mode
+    times the packed candidates for real).
     """
 
     mode: str = "estimate"
     latency_bytes: int = 128 * 1024
+    wire_dtype: Optional[str] = None
 
     def __post_init__(self):
         if self.mode not in ("estimate", "measure"):
             raise ValueError(
                 f"Auto mode must be 'estimate' or 'measure', got "
                 f"{self.mode!r}")
+        _canon_wire_field(self)
 
 
 def assert_compatible(pin: Pencil, pout: Pencil) -> Optional[int]:
@@ -344,13 +425,6 @@ def _a2a_factory(pin: Pencil, pout: Pencil):
     return factory
 
 
-def _transpose_all_to_all(data, pin: Pencil, pout: Pencil, R: int,
-                          extra_ndims: int):
-    """Exchange on topology axis ``R`` via :func:`_a2a_factory`."""
-    return _exchange_transpose(data, pin, pout, R, extra_ndims,
-                               _a2a_factory(pin, pout))
-
-
 def _transpose_local(data, pin: Pencil, pout: Pencil, extra_ndims: int):
     """Same decomposition — only the permutation (storage order) changes;
     a pure local permute (reference ``transpose_impl!`` local path,
@@ -383,10 +457,28 @@ def _transpose_local(data, pin: Pencil, pout: Pencil, extra_ndims: int):
 
 
 def _ring_factory(pin: Pencil, pout: Pencil):
-    """Exchange factory for :class:`Ring` — see :func:`_transpose_ring`
-    for the full design notes.  The returned exchange closure is shape-
-    polymorphic along every dim other than (a, b): it serves the whole
-    block and any :class:`Pipelined` chunk of it equally."""
+    """Exchange factory for :class:`Ring`: staged shifted ``ppermute``
+    rounds of single tiles — RAGGED-AWARE.  The returned exchange
+    closure is shape-polymorphic along every dim other than (a, b): it
+    serves the whole block and any :class:`Pipelined` chunk of it
+    equally.
+
+    Bytes-on-the-wire model (vs reference ``Transpositions.jl:383-389``,
+    which sends exact per-peer intersection ranges): under XLA SPMD every
+    round's tile must have ONE static shape across devices, while the
+    true intersection extents vary per (source, dest) pair — so exact
+    intersection-size transfers are unrepresentable, and for dense
+    configurations padded-uniform tiles are already optimal.  What IS
+    statically known is which ceil-rule blocks are *entirely empty*:
+    with ``n`` true elements in ``P`` blocks of ``ceil(n/P)``, only the
+    first ``S = ceil(n / ceil(n/P))`` devices own data.  The ring
+    therefore runs ``G-1`` rounds among the first ``G = max(S_a, S_b)``
+    participants instead of ``P-1``: for the pathological raggedness the
+    padded scheme is worst at (``n`` barely above ``P``), this removes
+    most of the pure-padding traffic — e.g. ``n_a = n_b = 9, P = 8``
+    runs 4 rounds instead of 7.  Structurally-empty destination blocks
+    are zero-filled, keeping the padding-is-zeros invariant and
+    bit-identity with :class:`AllToAll`."""
     def factory(axis, P, a, b):
         n_a = pin.size_global()[a]
         n_b = pin.size_global()[b]
@@ -400,7 +492,7 @@ def _ring_factory(pin: Pencil, pout: Pencil):
             tiles = jnp.stack(
                 [jax.lax.slice_in_dim(x, j * b_blk, (j + 1) * b_blk, axis=b)
                  for j in range(G)], axis=0)
-            me = jax.lax.axis_index(axis).astype(jnp.int32)
+            me = jnp.asarray(jax.lax.axis_index(axis), jnp.int32)
             # received[s] must hold sender s's tile for me; my own tile
             # seeds the buffer, round r delivers sender (me - r)'s.
             # (Devices >= G hold only padding; their clamped seeds and
@@ -445,15 +537,45 @@ def _ring_factory(pin: Pencil, pout: Pencil):
     return factory
 
 
+def _wire_wrapped_factory(inner_factory, wire_dtype: str):
+    """Bracket an exchange factory's closures with the sanctioned wire
+    pack/unpack (``parallel/wire.py``): cast down immediately before
+    the collective, restore immediately after — INSIDE the exchange
+    closure, so a :class:`Pipelined` chunk packs per chunk (the chunked
+    program stays chunk-local; no full-array cast materializes to kill
+    the overlap win) and Ring rounds move packed tiles."""
+    from . import wire as _wire
+
+    def factory(axis, P, a, b):
+        inner = inner_factory(axis, P, a, b)
+
+        def exchange(x):
+            with jax.named_scope("wire_pack"):
+                packed = _wire.pack(x, wire_dtype)
+            moved = inner(packed)
+            with jax.named_scope("wire_unpack"):
+                return _wire.unpack(moved, x.dtype, wire_dtype)
+
+        return exchange
+
+    return factory
+
+
 def _exchange_factory(method: AbstractTransposeMethod, pin: Pencil,
                       pout: Pencil):
     """Dispatch the explicit single-axis exchange factory for a concrete
-    method; :class:`Pipelined` wraps its base factory per-chunk.  Shared
-    with the FFT planner's fused pipelined hops (``ops/fft.py``)."""
+    method; :class:`Pipelined` wraps its base factory per-chunk and a
+    ``wire_dtype`` brackets the innermost exchange with the reduced-
+    precision pack/unpack.  Shared with the FFT planner's fused
+    pipelined hops (``ops/fft.py``)."""
     if isinstance(method, AllToAll):
-        return _a2a_factory(pin, pout)
+        f = _a2a_factory(pin, pout)
+        return (_wire_wrapped_factory(f, method.wire_dtype)
+                if method.wire_dtype else f)
     if isinstance(method, Ring):
-        return _ring_factory(pin, pout)
+        f = _ring_factory(pin, pout)
+        return (_wire_wrapped_factory(f, method.wire_dtype)
+                if method.wire_dtype else f)
     if isinstance(method, Pipelined):
         inner_f = _exchange_factory(method.base, pin, pout)
 
@@ -475,43 +597,6 @@ def _exchange_factory(method: AbstractTransposeMethod, pin: Pencil,
 
         return factory
     raise TypeError(f"no explicit exchange factory for method {method!r}")
-
-
-def _transpose_pipelined(data, pin: Pencil, pout: Pencil, R: int,
-                         extra_ndims: int, method: "Pipelined"):
-    """Chunked exchange (:class:`Pipelined`): the base method applied
-    per statically-shaped chunk of an exchange-untouched dim, results
-    concatenated — pure data movement, bit-identical to the base.  The
-    overlap win materializes when a consumer is fused per-chunk into
-    the same program (``PencilFFTPlan(pipeline=K)``)."""
-    return _exchange_transpose(data, pin, pout, R, extra_ndims,
-                               _exchange_factory(method, pin, pout))
-
-
-def _transpose_ring(data, pin: Pencil, pout: Pencil, R: int,
-                    extra_ndims: int):
-    """Like :func:`_transpose_all_to_all`, but the exchange is staged
-    shifted ``ppermute`` rounds of single tiles — and it is RAGGED-AWARE.
-
-    Bytes-on-the-wire model (vs reference ``Transpositions.jl:383-389``,
-    which sends exact per-peer intersection ranges): under XLA SPMD every
-    round's tile must have ONE static shape across devices, while the
-    true intersection extents vary per (source, dest) pair — so exact
-    intersection-size transfers are unrepresentable, and for dense
-    configurations padded-uniform tiles are already optimal.  What IS
-    statically known is which ceil-rule blocks are *entirely empty*:
-    with ``n`` true elements in ``P`` blocks of ``ceil(n/P)``, only the
-    first ``S = ceil(n / ceil(n/P))`` devices own data.  The ring
-    therefore runs ``G-1`` rounds among the first
-    ``G = max(S_a, S_b)`` participants instead of ``P-1``: for the
-    pathological raggedness the padded scheme is worst at (``n`` barely
-    above ``P``), this removes most of the pure-padding traffic —
-    e.g. ``n_a = n_b = 9, P = 8`` runs 4 rounds instead of 7.
-    Structurally-empty destination blocks are zero-filled, keeping the
-    padding-is-zeros invariant and bit-identity with :class:`AllToAll`.
-    """
-    return _exchange_transpose(data, pin, pout, R, extra_ndims,
-                               _ring_factory(pin, pout))
 
 
 # ---------------------------------------------------------------------------
@@ -569,8 +654,17 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
     chunk axis is chosen over the shape INCLUDING the extra dims, the
     same rule the runtime exchange uses, so prediction cannot diverge
     from execution on batched hops.)
+
+    Precision dimension: a method carrying ``wire_dtype`` is priced at
+    the wire format's per-element bytes (``parallel/wire.py``'s
+    :func:`~pencilarrays_tpu.parallel.wire.wire_itemsize` — 2 bytes per
+    real component, so f32/c64 payloads halve) — and the compiled HLO's
+    collective shapes genuinely ARE the wire dtype, so the prediction
+    stays pinned EQUAL to measurement with the wire on.
     """
     import numpy as np
+
+    from .wire import wire_bytes, wire_itemsize
 
     R = assert_compatible(pin, pout)
     if isinstance(method, Auto):
@@ -592,9 +686,14 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
     elems = int(np.prod(ext, dtype=np.int64))
     for e in extra_dims:
         elems *= int(e)
-    isize = np.dtype(dtype if dtype is not None else np.float32).itemsize
+    isize = wire_itemsize(dtype, _method_wire(method))
     if isinstance(method, AllToAll):
-        return {"all-to-all": {"count": 1, "bytes": elems * isize}}
+        # wire_bytes is the ONE per-operand byte definition shared with
+        # collective_costs (via this function) and routing.py
+        return {"all-to-all": {
+            "count": 1,
+            "bytes": wire_bytes(dtype, _method_wire(method),
+                                ext + tuple(extra_dims))}}
     if isinstance(method, Ring):
         n_a = pin.size_global()[a]
         n_b = pin.size_global()[b]
@@ -630,14 +729,15 @@ _MEASURE_TIMINGS: dict = {}
 
 
 def _obs_record_measure_verdict(pin: Pencil, pout: Pencil, R: int,
-                                extra_dims: tuple, dtype) -> None:
+                                extra_dims: tuple, dtype,
+                                wire: Optional[str] = None) -> None:
     """Journal a measure-mode Auto verdict + its candidate timings as
     drift samples, once per (obs run, config).  Reads the cached
     measurement, so late-armed observability still journals configs
     measured earlier in the process."""
     import numpy as np
 
-    key = (pin, pout, R, extra_dims, np.dtype(dtype).str)
+    key = (pin, pout, R, extra_dims, np.dtype(dtype).str, wire)
     report = _MEASURE_REPORTS.get(key)
     if report is None:
         return
@@ -658,9 +758,16 @@ def _obs_record_measure_verdict(pin: Pencil, pout: Pencil, R: int,
 
 
 def _method_label(m: AbstractTransposeMethod) -> str:
-    """Stable human-readable audit label for a candidate method."""
+    """Stable human-readable audit label for a candidate method.  The
+    wire dtype is part of the label (``AllToAll[wire=bf16]``) so drift
+    keys, journal records, ``plan_key()`` fingerprints and the serve
+    coalescing keys all separate reduced- from full-precision traffic;
+    full-precision labels are byte-identical to the historical ones."""
     if isinstance(m, Pipelined):
         return f"Pipelined(chunks={m.chunks}, base={_method_label(m.base)})"
+    wire = _method_wire(m) if isinstance(m, (AllToAll, Ring, Auto)) else None
+    if wire is not None:
+        return f"{type(m).__name__}[wire={wire}]"
     return type(m).__name__
 
 
@@ -743,12 +850,16 @@ def last_measure_reports() -> list:
 
 @lru_cache(maxsize=512)
 def _measured_choice(pin: Pencil, pout: Pencil, R: int, extra_dims: tuple,
-                     dtype_str: str) -> AbstractTransposeMethod:
+                     dtype_str: str, wire: Optional[str] = None
+                     ) -> AbstractTransposeMethod:
     """Time every explicit candidate on the actual configuration and
     cache the winner (FFTW_MEASURE analog): AllToAll, Ring, and — when
     the configuration has a chunkable dim — the Pipelined K in {2,4,8}
-    sweep.  The timed body is a forward+back pair — shape-preserving, so
-    the hardened in-jit K-differenced protocol (``utils/benchtime.py``)
+    sweep.  ``wire`` rides every candidate (the packed exchange is what
+    gets timed AND what the cached winner executes — a reduced-wire
+    config never shares a verdict with its full-precision sibling).
+    The timed body is a forward+back pair — shape-preserving, so the
+    hardened in-jit K-differenced protocol (``utils/benchtime.py``)
     applies directly.  Each decision is recorded with its noise floor in
     :func:`last_measure_reports`."""
     import numpy as np
@@ -769,10 +880,11 @@ def _measured_choice(pin: Pencil, pout: Pencil, R: int, extra_dims: tuple,
     b = pout.decomposition[R]
     blk = tuple(pin.padded_size_local(LogicalOrder)) + tuple(extra_dims)
     c = _pipeline_chunk_axis(blk, a, b)
-    candidates = [AllToAll(), Ring()]
+    candidates = [AllToAll(wire_dtype=wire), Ring(wire_dtype=wire)]
     if c is not None:
-        candidates += [Pipelined(chunks=k) for k in (2, 4, 8)
-                       if len(_chunk_bounds(blk[c], k)) > 1]
+        candidates += [
+            Pipelined(chunks=k, base=AllToAll(wire_dtype=wire))
+            for k in (2, 4, 8) if len(_chunk_bounds(blk[c], k)) > 1]
     candidates = tuple(candidates)
     best, best_t = 0, float("inf")
     times, spreads = [], []
@@ -798,7 +910,7 @@ def _measured_choice(pin: Pencil, pout: Pencil, R: int, extra_dims: tuple,
         s is not None for s in spreads) else None
     report = {
         "config": f"{pin.size_global()}@{pin.topology.dims} R={R} "
-                  f"{dtype_str}",
+                  f"{dtype_str}" + (f" wire={wire}" if wire else ""),
         "candidates": [_method_label(c) for c in candidates],
         "seconds": times,
         "k1_spreads": spreads,
@@ -808,12 +920,12 @@ def _measured_choice(pin: Pencil, pout: Pencil, R: int, extra_dims: tuple,
         "margin_over_noise": (round((loser_t / best_t) / noise, 3)
                               if noise and best_t > 0 else None),
     }
-    _MEASURE_REPORTS[(pin, pout, R, extra_dims, dtype_str)] = report
+    _MEASURE_REPORTS[(pin, pout, R, extra_dims, dtype_str, wire)] = report
     # timings are kept (method objects + seconds) for the obs tap in
     # resolve_method — journaling must NOT live inside this lru_cache,
     # or a config resolved before obs was armed would never appear in a
     # later run's journal (the late-arming contract)
-    _MEASURE_TIMINGS[(pin, pout, R, extra_dims, dtype_str)] = tuple(
+    _MEASURE_TIMINGS[(pin, pout, R, extra_dims, dtype_str, wire)] = tuple(
         zip(candidates, times))
     if jax.process_count() > 1:
         # Multi-controller: every process MUST run the same collective
@@ -844,27 +956,34 @@ def resolve_method(pin: Pencil, pout: Pencil,
     if not isinstance(method, Auto):
         return method
     R = assert_compatible(pin, pout)
+    wire = method.wire_dtype
     if R is None or pin.topology.dims[R] == 1:
-        return AllToAll()  # local permute / trivial axis: method is moot
+        # local permute / trivial axis: method is moot (wire rides along
+        # for label/key fidelity; nothing packs on a zero-wire hop)
+        return AllToAll(wire_dtype=wire)
     if method.mode == "measure":
         import numpy as np
 
         dt = np.dtype(dtype if dtype is not None else np.float32)
-        choice = _measured_choice(pin, pout, R, tuple(extra_dims), dt.str)
+        choice = _measured_choice(pin, pout, R, tuple(extra_dims), dt.str,
+                                  wire)
         if obs.enabled() and not _quiet:
-            _obs_record_measure_verdict(pin, pout, R, tuple(extra_dims), dt)
+            _obs_record_measure_verdict(pin, pout, R, tuple(extra_dims),
+                                        dt, wire)
         return choice
     P = pin.topology.dims[R]
-    ring = transpose_cost(pin, pout, tuple(extra_dims), dtype, Ring())
+    ring = transpose_cost(pin, pout, tuple(extra_dims), dtype,
+                          Ring(wire_dtype=wire))
     if not ring:
-        return AllToAll()  # G <= 1: nothing on the wire either way
+        return AllToAll(wire_dtype=wire)  # G <= 1: nothing on the wire
     rc = ring["collective-permute"]
     tile = rc["bytes"] // rc["count"]
     rounds = rc["count"]  # G - 1
     L = method.latency_bytes
     score_ring = rounds * (L + tile)
     score_a2a = L + (P - 1) * tile
-    winner = Ring() if score_ring < score_a2a else AllToAll()
+    winner = (Ring(wire_dtype=wire) if score_ring < score_a2a
+              else AllToAll(wire_dtype=wire))
     if obs.enabled() and not _quiet:
         config = _hop_label(pin, pout, method, dtype)
         # one journaled verdict per config PER OBS RUN (run ids are
@@ -975,14 +1094,13 @@ def _hop_body(pin: Pencil, pout: Pencil, R: Optional[int],
     the guard can never change the data movement itself."""
     if R is None:
         return lambda data: _transpose_local(data, pin, pout, extra_ndims)
-    if isinstance(method, AllToAll):
-        return lambda data: _transpose_all_to_all(data, pin, pout, R,
-                                                  extra_ndims)
-    if isinstance(method, Ring):
-        return lambda data: _transpose_ring(data, pin, pout, R, extra_ndims)
-    if isinstance(method, Pipelined):
-        return lambda data: _transpose_pipelined(data, pin, pout, R,
-                                                 extra_ndims, method)
+    if isinstance(method, (AllToAll, Ring, Pipelined)):
+        # one path for every explicit exchange: the factory owns the
+        # method's chunking AND its wire pack/unpack, so a Pipelined
+        # base's wire_dtype packs per chunk by construction
+        return lambda data: _exchange_transpose(
+            data, pin, pout, R, extra_ndims,
+            _exchange_factory(method, pin, pout))
     if isinstance(method, Gspmd):
         return lambda data: _reshard_gspmd(data, pin, pout, extra_ndims)
     raise TypeError(f"unknown transpose method {method!r}")
@@ -1071,6 +1189,7 @@ def _dispatch_guarded_hop(pin: Pencil, pout: Pencil, R: Optional[int],
         # device program completes — a hung collective parks THERE,
         # under the armed deadline
         gi.check_hop_probes(hop, pre, post, count, dtype, finite=finite,
+                            wire_dtype=_method_wire(method),
                             ctx={"r": R, "method": _method_label(method)})
     return out
 

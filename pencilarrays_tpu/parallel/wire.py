@@ -1,0 +1,218 @@
+"""Reduced-precision wire format — the sanctioned pack/unpack choke point.
+
+Every transpose hop in the stack is bandwidth-bound by the validated
+byte model (AccFFT, arXiv:1506.07933: redistribution time is wire bytes
+over bisection bandwidth), so the cheapest bytes are the ones never
+sent.  This module owns the OPT-IN reduced-precision exchange payload
+(``wire_dtype="bf16" | "f16"`` on the explicit transpose methods and on
+``PencilFFTPlan``): shards are cast-packed immediately before the
+collective and restored immediately after, *inside the same traced
+program*, so XLA fuses the casts into the exchange boundaries and the
+collective itself moves half the bytes.  Accumulation and transform
+math stay in full precision — only the wire narrows.
+
+Three contracts, all enforced here so no caller can drift:
+
+* **packing** — :func:`pack` / :func:`unpack` are the ONLY functions
+  allowed to change an exchange payload's element type (``pa-lint``'s
+  ``wire-cast`` check forbids direct ``.astype(`` in the
+  exchange-program modules).  Real payloads cast elementwise; complex
+  payloads (c64/c128) use SPLIT-COMPLEX packing — re/im stacked along a
+  new trailing axis — so each component downcasts through a clean
+  real→real cast instead of a complex cast (which XLA would reject or
+  round through an intermediate).  The trailing axis rides the exchange
+  like an extra dim: the split/concat dims' indices are untouched, so
+  the same pack serves ``AllToAll``, ``Ring`` tiles and every
+  ``Pipelined`` chunk;
+* **byte accounting** — :func:`wire_itemsize` / :func:`wire_bytes` are
+  the ONE definition of per-element wire cost shared by
+  ``transpose_cost``, ``PencilFFTPlan.collective_costs`` and the route
+  planner's peak-HBM bound (they used to each re-derive ``itemsize``).
+  bf16/f16 carry 2 bytes per real component, so f32/c64 payloads halve
+  and f64/c128 quarter — and the compiled HLO's collective shapes
+  really are ``bf16[...]``, so the HLO-pinned prediction==measurement
+  equality holds with the wire on;
+* **tolerance model** — :func:`wire_rtol` is the per-dtype quantization
+  error bound the guard's content-sum probes compare against
+  (``guard/integrity.py``): a restored payload may differ from its
+  source by at most ~half a wire-dtype ULP per element.  Exceedance is
+  a typed :class:`~pencilarrays_tpu.guard.errors.WirePrecisionError`,
+  never a silent wrong answer.  Override:
+  ``PENCILARRAYS_TPU_GUARD_WIRE_RTOL``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "WIRE_DTYPES",
+    "canonical_wire_dtype",
+    "pack",
+    "unpack",
+    "wire_itemsize",
+    "wire_bytes",
+    "cast_score_bytes",
+    "wire_rtol",
+]
+
+# canonical name -> numpy-compatible dtype constructor.  bf16 keeps the
+# f32 exponent range (safe default for spectra spanning decades); f16
+# carries 3 more mantissa bits but overflows beyond ~65504.
+WIRE_DTYPES = ("bf16", "f16")
+
+# machine epsilon of each wire format (2^-mantissa_bits): the per-element
+# relative quantization error of one downcast is at most eps/2 (round to
+# nearest even), and the guard's content-sum tolerance scales it.
+_WIRE_EPS = {"bf16": 2.0 ** -8, "f16": 2.0 ** -11}
+
+# Casts are HBM traffic, not ICI traffic: pack reads full + writes wire,
+# unpack reads wire + writes full, and HBM bandwidth is roughly an order
+# of magnitude above ICI on current TPUs — so the router's
+# bytes-equivalent score discounts cast bytes by this factor (they must
+# count, or a zero-cost cast would make the wire strictly free, but they
+# must not be allowed to outweigh the ICI bytes they eliminate).
+CAST_BYTES_WEIGHT = 0.125
+
+
+def canonical_wire_dtype(wire_dtype) -> Optional[str]:
+    """Normalize a ``wire_dtype`` spelling to ``"bf16"``/``"f16"``/
+    ``None``.  Accepts the canonical strings, ``"bfloat16"``/
+    ``"float16"``, and jnp/np dtype objects; anything else is a typed
+    ``ValueError`` (an unsupported wire format must fail at
+    construction, not dispatch)."""
+    if wire_dtype is None:
+        return None
+    if isinstance(wire_dtype, str):
+        name = wire_dtype.strip().lower()
+    else:
+        name = np.dtype(wire_dtype).name  # jnp.bfloat16 has an np dtype
+    name = {"bfloat16": "bf16", "float16": "f16", "half": "f16"}.get(
+        name, name)
+    if name not in WIRE_DTYPES:
+        raise ValueError(
+            f"wire_dtype must be None, 'bf16' or 'f16', got "
+            f"{wire_dtype!r}")
+    return name
+
+
+def _jnp_wire(wire: str):
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if wire == "bf16" else jnp.float16
+
+
+def pack(x, wire_dtype: str):
+    """Cast one exchange payload down to its wire format (traced).
+
+    Real inexact payloads cast elementwise; complex payloads split into
+    re/im along a NEW trailing axis (split-complex packing) so each
+    component downcasts real→real.  Exact dtypes (ints/bool) have no
+    lossless narrow wire form and raise — the caller opted into a
+    float wire for float data, not into corrupting indices.
+
+    The payload ships as the wire format's raw 16-BIT PATTERN
+    (``bitcast_convert_type`` to ``uint16`` — a free reinterpret, no
+    value change): backends without native bf16 collective support
+    (XLA:CPU — the virtual test mesh) would otherwise WIDEN a bf16
+    collective back to f32 through the float-normalization pass,
+    silently unhalving the wire, while an integer collective moves
+    exactly 2 bytes per component on every backend.  :func:`unpack`
+    bitcasts back before the restoring upcast."""
+    import jax
+    import jax.numpy as jnp
+
+    wt = _jnp_wire(wire_dtype)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        parts = jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+        return jax.lax.bitcast_convert_type(jnp.asarray(parts, wt),
+                                            jnp.uint16)
+    if not jnp.issubdtype(x.dtype, jnp.inexact):
+        raise TypeError(
+            f"wire_dtype={wire_dtype!r} needs an inexact payload dtype; "
+            f"got {x.dtype} (exact dtypes have no lossy wire form)")
+    return jax.lax.bitcast_convert_type(jnp.asarray(x, wt), jnp.uint16)
+
+
+def unpack(y, orig_dtype, wire_dtype: str):
+    """Restore a packed payload to its original dtype (traced): the
+    exact inverse of :func:`pack`'s bitcast + shape change — values
+    carry the wire format's quantization, which the guard's tolerance
+    model prices (:func:`wire_rtol`)."""
+    import jax
+    import jax.numpy as jnp
+
+    orig = jnp.dtype(orig_dtype)
+    w = jax.lax.bitcast_convert_type(y, _jnp_wire(wire_dtype))
+    if jnp.issubdtype(orig, jnp.complexfloating):
+        # host-side dtype math only (c64 -> f32, c128 -> f64)
+        real_dt = np.empty(0, np.dtype(orig)).real.dtype
+        parts = jnp.asarray(w, real_dt)
+        return jnp.asarray(
+            jax.lax.complex(parts[..., 0], parts[..., 1]), orig)
+    return jnp.asarray(w, orig)
+
+
+def wire_itemsize(dtype, wire_dtype) -> int:
+    """Per-element wire bytes of one exchanged payload element: the
+    dtype's own itemsize at full precision, 2 bytes per real component
+    on a bf16/f16 wire (so c64/c128 split-complex packs carry 4)."""
+    dt = np.dtype(dtype if dtype is not None else np.float32)
+    if wire_dtype is None:
+        return dt.itemsize
+    canonical_wire_dtype(wire_dtype)  # validate spelling
+    if dt.kind not in "fc":
+        raise TypeError(
+            f"wire_dtype={wire_dtype!r} needs an inexact payload dtype; "
+            f"got {dt} (exact dtypes have no lossy wire form)")
+    return 4 if dt.kind == "c" else 2
+
+
+def wire_bytes(dtype, wire_dtype, shape: Sequence[int]) -> int:
+    """Wire bytes of one exchanged operand of logical ``shape`` — the
+    ONE byte-accounting definition ``transpose_cost``,
+    ``collective_costs`` and ``routing.py`` share (they must never
+    re-derive ``itemsize`` independently)."""
+    elems = 1
+    for n in shape:
+        elems *= int(n)
+    return elems * wire_itemsize(dtype, wire_dtype)
+
+
+def cast_score_bytes(wire_nbytes: int, dtype, wire_dtype) -> int:
+    """Bytes-equivalent toll of one hop's pack+unpack casts, for the
+    planners' scoring currency (``routing._score`` and the FFT
+    planner's ``_schedule_score``): each element is read full + written
+    wire (pack) and read wire + written full (unpack), discounted by
+    :data:`CAST_BYTES_WEIGHT` because the traffic is HBM, not ICI.
+    Zero with the wire off."""
+    if wire_dtype is None or wire_nbytes <= 0:
+        return 0
+    w = wire_itemsize(dtype, wire_dtype)
+    full = np.dtype(dtype if dtype is not None else np.float32).itemsize
+    elems = wire_nbytes // max(1, w)
+    return int(2 * elems * (full + w) * CAST_BYTES_WEIGHT)
+
+
+def wire_rtol(wire_dtype, count: int) -> float:
+    """Relative tolerance of the guard's content-sum compare across one
+    wire round trip: per-element quantization is bounded by half the
+    wire format's epsilon, and the probe compares SUMS of ``count``
+    elements whose errors accumulate against the abs-sum scale — so the
+    bound is ``eps/2`` (worst case all same-signed) with a small
+    reduction-depth safety margin, NOT ``eps * count`` (the errors are
+    already measured against ``abs_sum``, which scales with count).
+    Override: ``PENCILARRAYS_TPU_GUARD_WIRE_RTOL`` (see
+    ``engine/config.py``)."""
+    if wire_dtype is None:
+        return 0.0
+    from ..engine import config as _rtc
+
+    override = _rtc.current().guard_wire_rtol
+    if override is not None:
+        return override
+    eps = _WIRE_EPS[canonical_wire_dtype(wire_dtype)]
+    return 0.5 * eps * (1.0 + 0.25 * math.log2(max(2, count)))

@@ -716,14 +716,22 @@ class PlanService:
 
     def _dispatch_meta(self, batch: Batch) -> dict:
         """What ``certify(engine=True)`` needs to re-verify this
-        dispatch against its ``collective_costs`` prediction."""
+        dispatch against its ``collective_costs`` prediction — wire
+        dtype and priced wire bytes included, so a dispatch whose
+        logged payload size disagrees with the plan's (possibly
+        reduced-precision) schedule fails ``verify_dispatch_log``
+        typed instead of certifying cleanly, and mixed-precision
+        traffic is auditable per dispatch."""
         B = len(batch.entries)
         meta = {"service": self._sid, "kind": batch.kind,
                 "key": batch.key, "n": B, "cost": batch.cost}
         if batch.kind == "fft":
             e0 = batch.entries[0]
+            extra = (B,) if B > 1 else ()
             meta.update(plan=e0.plan, direction=e0.direction,
-                        extra_dims=(B,) if B > 1 else ())
+                        extra_dims=extra,
+                        wire_dtype=e0.plan.wire_dtype,
+                        wire_bytes=e0.plan.predicted_wire_bytes(extra))
         return meta
 
     def _validate_entry(self, batch: Batch, entry: _Entry

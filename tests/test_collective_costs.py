@@ -157,3 +157,38 @@ def test_backward_costs_equal_forward(devices):
     plan = PencilFFTPlan(topo, (16, 12, 20), real=True)
     assert (spmd.trace_plan(plan, (3,), "backward").stats()
             == plan.collective_costs((3,)))
+
+
+def test_r2c_wire_bytes_pinned_no_double_count(devices):
+    """ISSUE 13 satellite: the PR-9 Hermitian-half byte accounting
+    combines with the wire's ÷2 precision factor WITHOUT
+    double-counting — exact figures pinned.
+
+    shape (16, 12, 10) r2c over topo (2, 4): stage 0's rfft shrinks
+    dim 0 to 16//2+1 = 9 (ceil-padded to 10 over P=2), so both
+    exchange hops move 180 c64 elements per chip (hop 1 operand
+    extents (10, 6, 3); hop 2 (5, 12, 3)) — 1440 B each at full
+    precision, 2880 total.  At wire_dtype="bf16" each element ships
+    split-complex as 2 x 2 bytes = 720 B per hop, 1440 total: exactly
+    half, collective counts unchanged, and the compiled HLO (forward
+    AND backward) agrees byte-for-byte."""
+    topo = Topology((2, 4))
+    full = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                         dtype=jnp.float32)
+    wired = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                          dtype=jnp.float32, wire_dtype="bf16")
+    assert full.collective_costs() == {
+        "all-to-all": {"count": 2, "bytes": 2880}}
+    assert wired.collective_costs() == {
+        "all-to-all": {"count": 2, "bytes": 1440}}
+    assert spmd.trace_plan(wired, ()).stats() == wired.collective_costs()
+    assert (spmd.trace_plan(wired, (), "backward").stats()
+            == wired.collective_costs())
+    # batched: bytes scale xB on the wire figure, count fixed
+    batched = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                            dtype=jnp.float32, wire_dtype="bf16",
+                            batch=3)
+    assert batched.collective_costs() == {
+        "all-to-all": {"count": 2, "bytes": 4320}}
+    assert spmd.trace_plan(batched, (3,)).stats() == \
+        batched.collective_costs()

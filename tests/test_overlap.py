@@ -196,3 +196,58 @@ def test_transposition_object_overlap_api(topo):
 
     np.testing.assert_allclose(gather(y), u, rtol=1e-12)
     assert float(other[0, 0]) == 32.0
+
+
+def test_pipelined_wire_packs_per_chunk(topo):
+    """ISSUE 13 satellite: ``Pipelined(chunks=K)`` + ``wire_dtype``
+    compose PER CHUNK — the cast-pack sits inside each chunk's program
+    (one 16-bit pack per exchange, chunk-sized), never as one fused
+    full-array materialization that would serialize the chunks and
+    kill the overlap win.  Pinned on the jaxpr: every chunk exchange
+    moves the packed u16 payload, every pack output is exactly its
+    chunk's operand shape, and no exchange gained a dependency on any
+    FFT stage."""
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                         dtype=jnp.float32, pipeline=2,
+                         wire_dtype="bf16")
+    assert any(s[0] == "ft" for s in plan._steps), "no hop fused"
+    x = plan.allocate_input()
+    jpr = jax.make_jaxpr(
+        lambda d: plan.forward(PencilArray(plan.input_pencil, d)).data
+    )(x.data).jaxpr
+
+    checked = 0
+    for sj in _subjaxprs(jpr):
+        eqns = list(sj.eqns)
+        t_idx = [i for i, e in enumerate(eqns)
+                 if e.primitive.name == "all_to_all"]
+        f_idx = [i for i, e in enumerate(eqns) if _contains_fft(e)]
+        if len(t_idx) < 2 or not f_idx:
+            continue  # not a fused hop body
+        checked += 1
+        # every chunk's exchange moves the PACKED 16-bit wire payload
+        a2a_elems = []
+        for t in t_idx:
+            aval = eqns[t].invars[0].aval
+            assert str(aval.dtype) == "uint16", (
+                "fused chunk exchange is not the packed wire payload")
+            a2a_elems.append(int(np.prod(aval.shape)))
+        # one pack per chunk, each chunk-sized — a single full-array
+        # pack (== sum of the chunks) would be the fused
+        # materialization the satellite forbids
+        packs = [e for e in eqns
+                 if e.primitive.name == "bitcast_convert_type"
+                 and str(e.outvars[0].aval.dtype) == "uint16"]
+        assert len(packs) == len(t_idx)
+        full_block = sum(a2a_elems)
+        for e in packs:
+            n = int(np.prod(e.outvars[0].aval.shape))
+            assert n in a2a_elems and n < full_block
+        # the overlap precondition survives the wire: no exchange
+        # (pack included, it feeds the exchange) waits on any FFT
+        deps = _eqn_deps(eqns)
+        for t in t_idx:
+            for f in f_idx:
+                assert f not in deps[t], (
+                    "wire pack reintroduced the hop->transform barrier")
+    assert checked >= 1, "no fused hop body found in the jaxpr"

@@ -1,0 +1,450 @@
+"""Reduced-precision wire format (ISSUE 13): pack/unpack contracts,
+halved HLO-pinned bytes, wire-aware pricing through Auto/router/guard,
+the typed ``WirePrecisionError`` tolerance contract, and the dispatch
+log's wire-byte certification.
+
+The acceptance pins live here: ``wire_dtype=None`` is BIT-IDENTICAL to
+the historical behavior; ``wire_dtype="bf16"`` halves priced AND
+measured exchange bytes; out-of-tolerance drift on a wire hop raises
+typed — never a silent wrong answer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pencilarrays_tpu import (
+    AllToAll,
+    Gspmd,
+    Pencil,
+    PencilArray,
+    PencilFFTPlan,
+    Ring,
+    Topology,
+    gather,
+    guard,
+    reshard,
+    transpose,
+    transpose_cost,
+)
+from pencilarrays_tpu.analysis import spmd
+from pencilarrays_tpu.guard import IntegrityError, WirePrecisionError
+from pencilarrays_tpu.guard.integrity import check_hop_probes, probes_match
+from pencilarrays_tpu.parallel import wire
+from pencilarrays_tpu.parallel.transpositions import (
+    Auto,
+    Pipelined,
+    _method_label,
+    resolve_method,
+    with_wire,
+)
+
+
+@pytest.fixture
+def topo(devices):
+    return Topology((2, 4))
+
+
+@pytest.fixture
+def hop(topo):
+    pin = Pencil(topo, (16, 12, 20), (1, 2))
+    pout = Pencil(topo, (16, 12, 20), (0, 2))
+    return pin, pout
+
+
+# ---------------------------------------------------------------------------
+# wire.py unit contracts
+# ---------------------------------------------------------------------------
+
+
+def test_canonical_wire_dtype_spellings():
+    for spelling in ("bf16", "bfloat16", jnp.bfloat16):
+        assert wire.canonical_wire_dtype(spelling) == "bf16"
+    for spelling in ("f16", "float16", "half", jnp.float16, np.float16):
+        assert wire.canonical_wire_dtype(spelling) == "f16"
+    assert wire.canonical_wire_dtype(None) is None
+    with pytest.raises(ValueError):
+        wire.canonical_wire_dtype("fp8")
+    with pytest.raises(ValueError):
+        wire.canonical_wire_dtype(np.float32)
+
+
+def test_pack_unpack_real_roundtrip_quantization_bound():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (7, 5)).astype(np.float32))
+    for w, eps in (("bf16", 2.0 ** -8), ("f16", 2.0 ** -11)):
+        p = wire.pack(x, w)
+        # the wire carries the raw 16-bit pattern (u16 — backends
+        # without native bf16 collectives would widen a float wire)
+        assert p.dtype == jnp.uint16 and p.shape == x.shape
+        back = wire.unpack(p, x.dtype, w)
+        assert back.dtype == x.dtype
+        assert float(jnp.max(jnp.abs(back - x))) <= eps * float(
+            jnp.max(jnp.abs(x)))
+
+
+def test_pack_unpack_split_complex():
+    z = jnp.asarray((np.random.default_rng(1).standard_normal((4, 3))
+                     + 1j * np.random.default_rng(2).standard_normal(
+                         (4, 3))).astype(np.complex64))
+    p = wire.pack(z, "bf16")
+    # split-complex: re/im on a NEW trailing axis, 2 bytes each
+    assert p.dtype == jnp.uint16 and p.shape == z.shape + (2,)
+    back = wire.unpack(p, z.dtype, "bf16")
+    assert back.dtype == z.dtype and back.shape == z.shape
+    assert float(jnp.max(jnp.abs(back - z))) <= 2.0 ** -8 * float(
+        jnp.max(jnp.abs(z)))
+
+
+def test_pack_rejects_exact_dtypes():
+    with pytest.raises(TypeError):
+        wire.pack(jnp.arange(4, dtype=jnp.int32), "bf16")
+    with pytest.raises(TypeError):
+        wire.wire_itemsize(np.int32, "bf16")
+
+
+def test_wire_bytes_shared_accounting():
+    assert wire.wire_itemsize(np.float32, None) == 4
+    assert wire.wire_itemsize(np.float32, "bf16") == 2
+    assert wire.wire_itemsize(np.complex64, "bf16") == 4
+    assert wire.wire_itemsize(np.complex128, "f16") == 4
+    assert wire.wire_itemsize(np.float64, "bf16") == 2
+    assert wire.wire_bytes(np.float32, "bf16", (8, 4)) == 64
+    assert wire.cast_score_bytes(0, np.float32, "bf16") == 0
+    assert wire.cast_score_bytes(64, np.float32, None) == 0
+    assert wire.cast_score_bytes(64, np.float32, "bf16") > 0
+
+
+# ---------------------------------------------------------------------------
+# method plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_method_labels_and_with_wire():
+    # full-precision labels are byte-identical to the historical ones
+    assert _method_label(AllToAll()) == "AllToAll"
+    assert _method_label(Pipelined(chunks=2)) == \
+        "Pipelined(chunks=2, base=AllToAll)"
+    assert _method_label(AllToAll(wire_dtype="bf16")) == \
+        "AllToAll[wire=bf16]"
+    assert _method_label(Pipelined(chunks=2,
+                                   base=Ring(wire_dtype="f16"))) == \
+        "Pipelined(chunks=2, base=Ring[wire=f16])"
+    m = with_wire(Pipelined(chunks=4), "bf16")
+    assert m.base.wire_dtype == "bf16"
+    assert with_wire(AllToAll(wire_dtype="bf16"), None) == \
+        AllToAll(wire_dtype="bf16")
+    # spellings canonicalize at construction: equal as cache keys
+    assert AllToAll(wire_dtype="bfloat16") == AllToAll(wire_dtype="bf16")
+    with pytest.raises(ValueError):
+        with_wire(AllToAll(wire_dtype="bf16"), "f16")  # conflict
+    with pytest.raises(ValueError):
+        with_wire(Gspmd(), "bf16")  # partitioner-owned exchange
+    with pytest.raises(ValueError):
+        AllToAll(wire_dtype="fp8")
+
+
+def test_auto_resolves_with_wire(hop):
+    pin, pout = hop
+    m = resolve_method(pin, pout, (), jnp.float32,
+                       Auto(wire_dtype="bf16"))
+    assert getattr(m, "wire_dtype", None) == "bf16"
+    # wire-invariant choice: same winner type as the full-precision hop
+    m0 = resolve_method(pin, pout, (), jnp.float32, Auto())
+    assert type(m) is type(m0)
+
+
+# ---------------------------------------------------------------------------
+# acceptance pins: bit-identity off, halved HLO-pinned bytes on
+# ---------------------------------------------------------------------------
+
+
+def test_wire_none_bit_identical(hop):
+    """wire_dtype=None IS today's behavior: same method object, same
+    executable cache key, bit-identical results."""
+    pin, pout = hop
+    assert AllToAll() == AllToAll(wire_dtype=None)
+    u = np.random.default_rng(3).standard_normal((16, 12, 20))
+    x = PencilArray.from_global(pin, u)
+    a = gather(transpose(x, pout, method=AllToAll()))
+    b = gather(transpose(x, pout, method=AllToAll(wire_dtype=None)))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(a), u)
+
+
+@pytest.mark.parametrize("method_wire", [
+    AllToAll(wire_dtype="bf16"), Ring(wire_dtype="bf16"),
+    Pipelined(chunks=2, base=AllToAll(wire_dtype="bf16"))])
+def test_bf16_halves_priced_and_measured_bytes(hop, method_wire):
+    """THE acceptance pin: priced bytes halve AND the compiled HLO's
+    measured collective bytes equal the prediction (f32 and c64)."""
+    pin, pout = hop
+    full = type(method_wire)() if not isinstance(method_wire, Pipelined) \
+        else Pipelined(chunks=2)
+    for dt in (jnp.float32, jnp.complex64):
+        c_full = transpose_cost(pin, pout, (), dt, full)
+        c_wire = transpose_cost(pin, pout, (), dt, method_wire)
+        for op in c_full:
+            assert c_wire[op]["bytes"] * 2 == c_full[op]["bytes"]
+            assert c_wire[op]["count"] == c_full[op]["count"]
+        measured = spmd.trace_transpose(pin, pout, (), dt,
+                                        method_wire).stats()
+        assert measured == c_wire
+
+
+def test_wire_numerics_within_model(hop):
+    pin, pout = hop
+    u = np.random.default_rng(4).standard_normal(
+        (16, 12, 20)).astype(np.float32)
+    x = PencilArray.from_global(pin, u)
+    for w, eps in (("bf16", 2.0 ** -8), ("f16", 2.0 ** -11)):
+        got = np.asarray(gather(transpose(
+            x, pout, method=AllToAll(wire_dtype=w))))
+        assert np.max(np.abs(got - u)) <= eps * np.max(np.abs(u))
+        assert np.max(np.abs(got - u)) > 0  # it really quantized
+
+
+def test_wire_transpose_cost_rejects_exact_dtype(hop):
+    pin, pout = hop
+    with pytest.raises(TypeError):
+        transpose_cost(pin, pout, (), jnp.int32,
+                       AllToAll(wire_dtype="bf16"))
+
+
+# ---------------------------------------------------------------------------
+# plans: wire through the FFT schedule
+# ---------------------------------------------------------------------------
+
+
+def test_plan_wire_halves_bytes_and_verifies(topo):
+    ref = PencilFFTPlan(topo, (16, 12, 10), real=True, dtype=jnp.float32)
+    w = PencilFFTPlan(topo, (16, 12, 10), real=True, dtype=jnp.float32,
+                      wire_dtype="bf16")
+    assert w.wire_dtype == "bf16"
+    cf, cw = ref.collective_costs(), w.collective_costs()
+    for op in cf:
+        assert cw[op]["bytes"] * 2 == cf[op]["bytes"]
+        assert cw[op]["count"] == cf[op]["count"]
+    # compiled trace == prediction, both directions (the HLO pin)
+    spmd.verify_plan(w)
+    spmd.verify_plan(w, direction="backward")
+    # fingerprints separate reduced- from full-precision traffic
+    assert w.plan_key() != ref.plan_key()
+    w2 = PencilFFTPlan(topo, (16, 12, 10), real=True, dtype=jnp.float32,
+                       wire_dtype="bf16")
+    assert w2.plan_key() == w.plan_key()
+    # the method spelling reaches the same key (one truth)
+    w3 = PencilFFTPlan(topo, (16, 12, 10), real=True, dtype=jnp.float32,
+                       method=AllToAll(wire_dtype="bf16"))
+    assert w3.plan_key() == w.plan_key() and w3.wire_dtype == "bf16"
+
+
+def test_plan_wire_roundtrip_accuracy(topo):
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                         dtype=jnp.float32, wire_dtype="bf16")
+    host = np.random.default_rng(5).standard_normal(
+        (16, 12, 10)).astype(np.float32)
+    x = PencilArray.from_global(plan.input_pencil, host)
+    back = np.asarray(gather(plan.backward(plan.forward(x))))
+    scale = np.max(np.abs(host))
+    err = np.max(np.abs(back - host))
+    # 4 packed exchanges (2 hops each way) at bf16: comfortably inside
+    # a few eps of headroom, and NOT bit-exact
+    assert 0 < err <= 8 * 2.0 ** -8 * scale
+
+
+def test_plan_wire_gspmd_method_rejected(topo):
+    with pytest.raises(ValueError):
+        PencilFFTPlan(topo, (16, 12, 10), method=Gspmd(),
+                      wire_dtype="bf16")
+
+
+# ---------------------------------------------------------------------------
+# guard: tolerance model + typed exceedance
+# ---------------------------------------------------------------------------
+
+
+def test_guarded_wire_hop_passes_and_full_precision_detects(hop, tmp_path):
+    pin, pout = hop
+    u = np.random.default_rng(6).standard_normal(
+        (16, 12, 20)).astype(np.float32)
+    x = PencilArray.from_global(pin, u)
+    with guard._forced("on", str(tmp_path)):
+        y = transpose(x, pout, method=AllToAll(wire_dtype="bf16"))
+        np.testing.assert_allclose(np.asarray(gather(y)), u, atol=0.02)
+        # and the full-precision hop still passes its exact check
+        y0 = transpose(x, pout, method=AllToAll())
+        np.testing.assert_array_equal(np.asarray(gather(y0)), u)
+
+
+def test_wire_drift_beyond_model_raises_typed():
+    pre = np.array([100.0, 0.0, 1000.0, 0.0])
+    drift = np.array([120.0, 0.0, 1000.0, 0.0])   # 2% of abs_sum: way out
+    ok, kind = probes_match(pre, drift, 1000, np.float32,
+                            wire_dtype="bf16")
+    assert (ok, kind) == (False, "wire")
+    with pytest.raises(WirePrecisionError) as ei:
+        check_hop_probes("hop", pre, drift, 1000, np.float32,
+                         wire_dtype="bf16")
+    assert ei.value.wire_dtype == "bf16"
+    assert isinstance(ei.value, IntegrityError)  # existing handlers catch
+
+
+def test_wire_tolerance_widens_only_wire_hops():
+    pre = np.array([100.0, 0.0, 1000.0, 0.0])
+    small = np.array([100.0 + 1.0, 0.0, 1000.0, 0.0])  # 1e-3 of abs_sum
+    assert probes_match(pre, small, 1000, np.float32,
+                        wire_dtype="bf16") == (True, "ok")
+    # the SAME drift on a full-precision hop is corruption
+    assert probes_match(pre, small, 1000, np.float32) == (False, "sum")
+    # more packed exchanges widen the bound linearly: a drift just
+    # past the 1-hop bound (~6.8 abs here) passes the 4-hop bound
+    bigger = np.array([100.0 + 10.0, 0.0, 1000.0, 0.0])
+    assert probes_match(pre, bigger, 1000, np.float32,
+                        wire_dtype="bf16", wire_hops=1)[0] is False
+    assert probes_match(pre, bigger, 1000, np.float32,
+                        wire_dtype="bf16", wire_hops=4)[0] is True
+
+
+def test_wire_rtol_env_override(monkeypatch):
+    assert wire.wire_rtol(None, 100) == 0.0
+    base = wire.wire_rtol("bf16", 100)
+    assert 2.0 ** -9 <= base <= 2.0 ** -6
+    monkeypatch.setenv("PENCILARRAYS_TPU_GUARD_WIRE_RTOL", "0.25")
+    assert wire.wire_rtol("bf16", 100) == 0.25
+    monkeypatch.delenv("PENCILARRAYS_TPU_GUARD_WIRE_RTOL")
+    assert wire.wire_rtol("bf16", 100) == base
+
+
+def test_guarded_routed_reshard_with_wire(topo, tmp_path):
+    pin = Pencil(topo, (16, 12, 20), (1, 2))
+    dest = Pencil(topo, (16, 12, 20), (0, 1))
+    u = np.random.default_rng(8).standard_normal(
+        (16, 12, 20)).astype(np.float32)
+    x = PencilArray.from_global(pin, u)
+    with guard._forced("on", str(tmp_path)):
+        out = reshard(x, dest, method=AllToAll(wire_dtype="bf16"))
+    np.testing.assert_allclose(np.asarray(gather(out)), u, atol=0.02)
+
+
+# ---------------------------------------------------------------------------
+# router: wire-aware pricing and the HBM admission win
+# ---------------------------------------------------------------------------
+
+
+def test_route_planner_admits_wire_edge_under_hbm_limit(topo):
+    """The ROADMAP claim: reduced-precision edges can fit under an
+    ``hbm_limit`` where full-precision ones were pruned — the packed
+    operand is half the HBM high-water mark's exchange share."""
+    from pencilarrays_tpu.parallel.routing import plan_reshard_route
+
+    pin = Pencil(topo, (16, 12, 20), (1, 2))
+    dest = Pencil(topo, (16, 12, 20), (0, 1))
+    full = plan_reshard_route(pin, dest, (), np.float32,
+                              method=AllToAll())
+    wired = plan_reshard_route(pin, dest, (), np.float32,
+                               method=AllToAll(wire_dtype="bf16"))
+    assert wired.peak_hbm_bytes < full.peak_hbm_bytes
+    lim = (full.peak_hbm_bytes + wired.peak_hbm_bytes) // 2
+    pruned = plan_reshard_route(pin, dest, (), np.float32,
+                                method=AllToAll(), hbm_limit=lim)
+    admitted = plan_reshard_route(pin, dest, (), np.float32,
+                                  method=AllToAll(wire_dtype="bf16"),
+                                  hbm_limit=lim)
+    assert not pruned.use_route           # full precision: no route fits
+    assert admitted.use_route             # the wire edge fits
+    assert all(h.method.wire_dtype == "bf16" for h in admitted.hops)
+    # and the fused routed chain's compiled trace matches the per-hop
+    # priced (halved) costs op-for-op
+    spmd.verify_route(admitted, (), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# dispatch log: wire bytes certified (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+
+def test_verify_dispatch_log_rejects_wire_byte_mismatch(topo):
+    from pencilarrays_tpu.analysis.errors import ScheduleMismatchError
+    from pencilarrays_tpu.engine import DispatchRecord
+
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                         dtype=jnp.float32, wire_dtype="bf16")
+    good = plan.predicted_wire_bytes(())
+
+    def rec(seq, wire_bytes):
+        return DispatchRecord(
+            enqueue_seq=seq, issue_seq=seq, label=f"fft:{seq}",
+            outcome="ok", queued_s=0.0, run_s=0.0,
+            meta={"plan": plan, "direction": "forward",
+                  "extra_dims": (), "wire_dtype": "bf16",
+                  "wire_bytes": wire_bytes})
+
+    report = spmd.verify_dispatch_log([rec(1, good)], source="t")
+    assert report["wire_checked"] == 1
+    assert report["verified_traces"] == 1
+    # a dispatch logged at FULL-precision bytes against the reduced
+    # plan's priced schedule must fail typed, not certify cleanly
+    with pytest.raises(ScheduleMismatchError) as ei:
+        spmd.verify_dispatch_log([rec(1, good), rec(2, good * 2)],
+                                 source="t")
+    assert ei.value.op == "wire-bytes"
+    # records without the stamp stay certified the historical way
+    bare = DispatchRecord(enqueue_seq=3, issue_seq=3, label="fft:3",
+                          outcome="ok", queued_s=0.0, run_s=0.0,
+                          meta={"plan": plan, "direction": "forward",
+                                "extra_dims": ()})
+    report = spmd.verify_dispatch_log([bare], source="t")
+    assert report["wire_checked"] == 0 and report["verified_traces"] == 1
+
+
+def test_forward_async_meta_carries_wire(topo):
+    from pencilarrays_tpu.engine import get_engine
+
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                         dtype=jnp.float32, wire_dtype="f16")
+    u = plan.allocate_input()
+    fut = plan.forward_async(u)
+    fut.result(timeout=60)
+    eng = get_engine()
+    mine = [r for r in eng.dispatch_log()
+            if r.meta.get("plan") is plan]
+    assert mine, "dispatch not logged"
+    assert mine[-1].meta["wire_dtype"] == "f16"
+    assert mine[-1].meta["wire_bytes"] == plan.predicted_wire_bytes(())
+    spmd.verify_dispatch_log(mine, source="wire-async")
+
+
+def test_measure_auto_downgrade_keeps_wire(topo):
+    """Regression (review): the planners' measure->estimate Auto
+    downgrade must keep the wire_dtype — a measure-mode wire plan was
+    scored/routed at full-precision bytes."""
+    from pencilarrays_tpu.parallel.routing import plan_reshard_route
+
+    pin = Pencil(topo, (16, 12, 20), (1, 2))
+    dest = Pencil(topo, (16, 12, 20), (0, 1))
+    route = plan_reshard_route(
+        pin, dest, (), np.float32,
+        method=Auto(mode="measure", wire_dtype="bf16"))
+    assert route.hops, "expected a routed plan"
+    assert all(h.method.wire_dtype == "bf16" for h in route.hops)
+    full = plan_reshard_route(pin, dest, (), np.float32, method=Auto())
+    wired_bytes = sum(v["bytes"] for h in route.hops
+                      for v in h.cost.values())
+    full_bytes = sum(v["bytes"] for h in full.hops
+                     for v in h.cost.values())
+    assert wired_bytes * 2 == full_bytes
+    # and the decomposition scorer prices the wire through the same
+    # downgrade (probe plans never benchmark)
+    p = PencilFFTPlan(topo, (16, 12, 10), real=True, dtype=jnp.float32,
+                      method=Auto(mode="measure", wire_dtype="bf16"),
+                      decomposition="auto")
+    assert p.wire_dtype == "bf16"
+    pf = PencilFFTPlan(topo, (16, 12, 10), real=True, dtype=jnp.float32,
+                       method=Auto(mode="measure"),
+                       decomposition="auto")
+    w_score = p.decomposition_verdict["candidates"]
+    f_score = pf.decomposition_verdict["candidates"]
+    by_dims = {tuple(c["dims"]): c["predicted_bytes"] for c in f_score}
+    for c in w_score:
+        assert c["predicted_bytes"] * 2 == by_dims[tuple(c["dims"])]
