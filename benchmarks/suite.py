@@ -1091,13 +1091,20 @@ def main():
     # Registered here but OFF by default (slow-marked smoke test on the
     # pytest side); full artifact via ``python benchmarks/reshard_sweep.py``.
     if args.reshard and len(devs) > 1:
-        from benchmarks.reshard_sweep import measure_reshards, write_artifact
+        from benchmarks.reshard_sweep import (measure_hbm_sweep,
+                                              measure_reshards,
+                                              write_artifact)
 
         reshard_shape = (96, 80, 72)
         points = measure_reshards(topo, reshard_shape)
-        results["reshard_sweep"] = {"points": points}
+        # the hbm-limit synthesis arm: tighten the bound until every
+        # single-shot route dies, record the chunked-route verdicts
+        # (predicted vs compiled peak, seconds, bit-identity)
+        hbm_points = measure_hbm_sweep(topo, reshard_shape)
+        results["reshard_sweep"] = {"points": points,
+                                    "hbm_sweep": hbm_points}
         write_artifact(topo, reshard_shape, points, "RESHARD_SWEEP.json",
-                       devs=devs)
+                       devs=devs, hbm_points=hbm_points)
 
     # -- 7. resilience: checkpoint throughput, checksums on vs off --------
     # Opt-in (wall-clock disk I/O, several hundred MB written): what does

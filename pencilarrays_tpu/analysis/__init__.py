@@ -35,6 +35,7 @@ from .spmd import (
     EXCHANGE_KINDS,
     certify_plan,
     predicted_peak_hbm,
+    step_hop_peak,
     trace_compiled_plan,
     trace_fn,
     trace_hlo,
@@ -73,4 +74,5 @@ __all__ = [
     "verify_dispatch_log",
     "certify_plan",
     "predicted_peak_hbm",
+    "step_hop_peak",
 ]

@@ -42,7 +42,18 @@ can enforce at runtime:
     ``parallel/wire.py`` — an ad-hoc cast there would silently change
     wire bytes out from under the HLO-pinned cost model and dodge the
     guard's wire-tolerance contract (same enforcement pattern as
-    ``thread-spawn``: one audited choke point, empty allowlist).
+    ``thread-spawn``: one audited choke point, empty allowlist);
+``hop-peak``
+    ``routing._hop_peak_bytes`` — the ONE peak-HBM footprint
+    accounting (chunk-aware time-sliced working sets, wire-packed
+    in-flight bytes) shared by the route planner's ``hbm_limit``
+    admission and the static verifier — is referenced ONLY from
+    ``parallel/routing.py`` and ``analysis/spmd.py``.  Everything
+    else (the FFT plan's ``hbm_limit`` synthesis included) bounds
+    through the sanctioned ``analysis.spmd`` entry points
+    (``step_hop_peak`` / ``predicted_peak_hbm`` / ``verify_hbm``), so
+    a second, diverging footprint model cannot grow anywhere (empty
+    allowlist).
 
 Everything is parsed from source with :mod:`ast` — the linter never
 imports the modules it checks, so it runs in milliseconds, cannot be
@@ -88,7 +99,7 @@ _MUTATING_METHODS = frozenset({
 })
 
 CHECKS = ("journal-event", "env-knob", "plan-cache", "fault-point",
-          "unlocked-state", "thread-spawn", "wire-cast")
+          "unlocked-state", "thread-spawn", "wire-cast", "hop-peak")
 
 # the exchange-program sources the wire-cast check audits: whole
 # modules whose traced bodies build exchange programs, plus named
@@ -98,6 +109,11 @@ CHECKS = ("journal-event", "env-knob", "plan-cache", "fault-point",
 # exempt by construction.
 WIRE_CAST_MODULES = ("parallel/transpositions.py", "parallel/routing.py")
 WIRE_CAST_FUNCTIONS = {"ops/fft.py": ("_fused_hop_fn",)}
+
+# the only modules allowed to reference the ONE footprint accounting
+# (hop-peak check); everything else bounds through analysis.spmd
+HOP_PEAK_NAME = "_hop_peak_bytes"
+HOP_PEAK_MODULES = ("parallel/routing.py", "analysis/spmd.py")
 
 
 @dataclass(frozen=True)
@@ -637,6 +653,52 @@ def _check_wire_cast(root: str, trees: Dict[str, ast.Module],
         visit(tree, "<module>", only_fns is None)
 
 
+def _check_hop_peak(root: str, trees: Dict[str, ast.Module],
+                    findings: List[Finding]) -> None:
+    """``_hop_peak_bytes`` stays the ONE footprint accounting: any
+    reference (import, attribute access, bare name) outside
+    ``parallel/routing.py`` / ``analysis/spmd.py`` is a finding — a
+    new caller must route through the sanctioned ``analysis.spmd``
+    entry points instead of re-deriving footprints.  The ident is
+    ``<dotted module>.<enclosing function>`` (the thread-spawn
+    convention, stable across unrelated edits)."""
+    allowed = {os.path.join(root, PACKAGE, *m.split("/"))
+               for m in HOP_PEAK_MODULES}
+    for path, tree in trees.items():
+        if path in allowed:
+            continue
+        dotted = _module_dotted(root, path)
+
+        def visit(node: ast.AST, scope: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                inner = scope
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    inner = child.name
+                hit = (
+                    (isinstance(child, ast.Name)
+                     and child.id == HOP_PEAK_NAME)
+                    or (isinstance(child, ast.Attribute)
+                        and child.attr == HOP_PEAK_NAME)
+                    or (isinstance(child, ast.ImportFrom) and any(
+                        a.name == HOP_PEAK_NAME for a in child.names)))
+                if hit:
+                    ident = f"{dotted}.{scope}"
+                    findings.append(Finding(
+                        "hop-peak", _rel(root, path), child.lineno,
+                        ident,
+                        f"direct {HOP_PEAK_NAME} reference in {ident} "
+                        f"— peak-HBM footprints are computed ONLY by "
+                        f"parallel/routing.py and analysis/spmd.py; "
+                        f"bound schedules through analysis.spmd "
+                        f"(step_hop_peak / predicted_peak_hbm / "
+                        f"verify_hbm) so the router's admission and "
+                        f"the static verifier can never disagree"))
+                visit(child, inner)
+
+        visit(tree, "<module>")
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -666,6 +728,7 @@ def lint_tree(root: str) -> List[Finding]:
     _check_unlocked_state(root, trees, findings)
     _check_thread_spawn(root, trees, findings)
     _check_wire_cast(root, trees, findings)
+    _check_hop_peak(root, trees, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.check, f.ident))
     return findings
 

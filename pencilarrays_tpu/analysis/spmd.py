@@ -61,6 +61,7 @@ __all__ = [
     "verify_dispatch_log",
     "certify_plan",
     "predicted_peak_hbm",
+    "step_hop_peak",
 ]
 
 # The data-movement collectives a transpose schedule owns.  Guard
@@ -373,48 +374,95 @@ def verify_consistent(a: CollectiveTrace, b: CollectiveTrace, *,
                     f"bytes (expected x{bytes_ratio:g})", ba, bb)
 
 
+def step_hop_peak(step, extra_dims: Tuple[int, ...], *, method=None,
+                  wire_dtype=None) -> int:
+    """Chunk- and wire-aware peak-HBM bytes of ONE plan schedule step
+    (a ``"t"`` transpose or a fused ``"ft"`` hop) — the sanctioned
+    entry point ``ops/fft.py`` bounds its schedule through.  The
+    accounting is ``routing._hop_peak_bytes``, the ONE footprint model
+    shared with the reshard route planner (``pa-lint hop-peak``
+    forbids direct callers anywhere else): a chunked hop (a fused
+    step's own bounds, or a ``Pipelined`` per-hop override) is charged
+    its time-sliced footprint, a wire-carrying hop its PACKED in-flight
+    share."""
+    import numpy as np
+
+    from ..parallel.routing import _hop_peak_bytes
+    from ..parallel.transpositions import (AllToAll, Pipelined, Ring,
+                                           _method_wire,
+                                           assert_compatible)
+
+    if step[0] not in ("t", "ft"):
+        raise ValueError(f"not an exchange step: {step[0]!r}")
+    src, dst, hop_dtype = step[1], step[2], step[3]
+    R = assert_compatible(src, dst)
+    if step[0] == "ft":
+        # the fused program owns its chunking: exact bounds + chunk dim
+        base, c, bounds = step[7], step[8], step[9]
+        return _hop_peak_bytes(src, dst, R, tuple(extra_dims),
+                               np.dtype(hop_dtype), base,
+                               chunk_dim=c, bounds=bounds)
+    m = step[4] if len(step) > 4 else method
+    if not isinstance(m, (AllToAll, Ring, Pipelined)):
+        # Auto/Gspmd-planned hops bound at the unchunked model carrying
+        # the plan's wire (the historical accounting)
+        m = AllToAll(wire_dtype=_method_wire(m) if m is not None
+                     else wire_dtype)
+    return _hop_peak_bytes(src, dst, R, tuple(extra_dims),
+                           np.dtype(hop_dtype), m)
+
+
 def predicted_peak_hbm(plan_or_route,
                        extra_dims: Optional[Tuple[int, ...]] = None,
                        dtype=None) -> Tuple[int, str]:
     """Static per-chip peak-HBM prediction of a plan's or route's worst
-    exchange: ``(peak_bytes, hop_label)``.  The same operand+result
-    accounting the route planner's ``hbm_limit`` pruning uses
-    (``routing._hop_peak_bytes``), applied to every hop of the
-    schedule."""
+    exchange: ``(peak_bytes, hop_label)``.  The EXACT accounting the
+    route planner's ``hbm_limit`` admission charges
+    (``routing._hop_peak_bytes`` — chunk-aware time-sliced footprints,
+    wire-packed in-flight bytes, and for routes the pinned-source
+    surcharge the route's recorded ``donate`` assumption implies), so
+    a planned route's per-hop ``peak_hbm_bytes`` and this prediction
+    can never disagree."""
     import numpy as np
 
     from ..parallel.routing import _hop_peak_bytes
-    from ..parallel.transpositions import _method_wire, assert_compatible
+    from ..parallel.transpositions import assert_compatible
 
     peak, label = 0, "<empty>"
     if hasattr(plan_or_route, "hops"):          # ReshardRoute
         route = plan_or_route
         extra = tuple(int(e) for e in (extra_dims or ()))
         dt = np.dtype(dtype if dtype is not None else np.float32)
+        # donation accounting mirrors the planner: a non-donated source
+        # block is resident under the whole chain and charged on every
+        # edge (except a first-hop local permute, which counts it as
+        # its own input already)
+        pinned = 0 if getattr(route, "donate", False) else \
+            route.src.bytes_per_device(extra, isize=dt.itemsize)
         for k, h in enumerate(route.hops):
             R = assert_compatible(h.src, h.dest)
+            surcharge = 0 if (k == 0 and R is None) else pinned
             p = _hop_peak_bytes(h.src, h.dest, R, extra, dt,
-                                _method_wire(h.method))
+                                h.method) + surcharge
             if p > peak:
                 peak, label = p, f"route[{k}] {h.src.decomposition}->" \
                                  f"{h.dest.decomposition}"
         return peak, label
     plan = plan_or_route
-    from ..ops.fft import _iter_priced_hops
-
     if extra_dims is None:
         extra_dims = plan.batch_dims
     extra = tuple(int(e) for e in extra_dims)
     plan_wire = getattr(plan, "wire_dtype", None)
-    for k, (src, dst, hop_dtype, base, _k_mult) in enumerate(
-            _iter_priced_hops(plan._steps)):
-        R = assert_compatible(src, dst)
-        wire = _method_wire(base) if base is not None else plan_wire
-        p = _hop_peak_bytes(src, dst, R, extra, np.dtype(hop_dtype),
-                            wire)
+    k = 0
+    for s in plan._steps:
+        if s[0] not in ("t", "ft"):
+            continue
+        p = step_hop_peak(s, extra, method=getattr(plan, "method", None),
+                          wire_dtype=plan_wire)
         if p > peak:
-            peak, label = p, f"hop[{k}] {src.decomposition}->" \
-                             f"{dst.decomposition}"
+            peak, label = p, f"hop[{k}] {s[1].decomposition}->" \
+                             f"{s[2].decomposition}"
+        k += 1
     return peak, label
 
 

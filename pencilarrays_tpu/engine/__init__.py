@@ -44,6 +44,7 @@ from .executor import (  # noqa: F401
     resume_all,
     shutdown_all,
 )
+from .pipeline import StepPipeline, run_steps_async  # noqa: F401
 from .threads import spawn_thread, spawned  # noqa: F401
 
 __all__ = [
@@ -58,6 +59,8 @@ __all__ = [
     "reform_all",
     "resume_all",
     "shutdown_all",
+    "StepPipeline",
+    "run_steps_async",
     "spawn_thread",
     "spawned",
     "EngineError",

@@ -1,0 +1,127 @@
+"""Step-loop pipelining helpers — the app-side shape of the executor.
+
+PR 12 gave every layer ONE ordered dispatch queue plus a host pool;
+what was still missing (the ROADMAP's carried follow-on) was the
+*application* idiom: a model step loop whose per-step device dispatch
+rides the consumer thread while checkpoint serialization rides the
+host pool, without every caller hand-rolling futures and completion
+callbacks.  :func:`run_steps_async` is that idiom, packaged once:
+
+* each step is submitted as one ordered engine dispatch (DaggerFFT's
+  step-as-future shape, the same grain ``PencilFFTPlan.forward_async``
+  uses) — step *k+1*'s dispatch is enqueued immediately, so the
+  consumer issues it the moment *k* returns;
+* every ``checkpoint_every``-th state is serialized through
+  :meth:`~pencilarrays_tpu.engine.Engine.host_task` (the
+  :meth:`~pencilarrays_tpu.resilience.checkpoint.CheckpointManager.
+  save_async` path): the save OVERLAPS the next steps' device work
+  instead of stalling the loop for the fsync — the hidden-latency win
+  ``BENCH_EXEC.json`` measured for the serve layer, now available to
+  ``models/`` callers natively;
+* saves are chained (each waits the previous save's future first), so
+  one ``CheckpointManager`` never runs two overlapping commits, and
+  each save waits its OWN step's future — it serializes exactly the
+  state it names, never a torn in-flight one.  jax arrays are
+  immutable, so serializing step ``k`` while step ``k+1`` computes
+  reads a stable snapshot.
+
+Consumed by ``NavierStokesSpectral.run_async`` /
+``DiffusionSpectral.run_async`` (``models/``); single-controller
+meshes only, like the serve layer's streaming mode — multi-controller
+ranks drive their loops at agreed points.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from .executor import StepFuture, get_engine
+
+__all__ = ["StepPipeline", "run_steps_async"]
+
+
+class StepPipeline:
+    """Handle on one :func:`run_steps_async` loop: ``final`` resolves
+    to the last step's state, ``saves`` are the chained checkpoint
+    futures (each resolves to its committed directory).  ``result()``
+    blocks for everything — steps AND saves — and returns the final
+    state (typed errors re-raise, engine-style)."""
+
+    def __init__(self, final: StepFuture,
+                 saves: Tuple[StepFuture, ...]):
+        self.final = final
+        self.saves = saves
+
+    def result(self, timeout: Optional[float] = None):
+        """Blocks for the last step AND every save; a failed step
+        re-raises its error here (later steps refuse to advance a
+        stale state — see :func:`run_steps_async` — so the failure
+        reaches ``final`` instead of a short-count state being
+        returned as the full run's)."""
+        out = self.final.result(timeout)
+        for s in self.saves:
+            s.result(timeout)
+        return out
+
+
+def run_steps_async(stepper: Callable, state, n_steps: int, *,
+                    engine=None, checkpoint=None,
+                    checkpoint_every: Optional[int] = None,
+                    state_name: str = "state",
+                    label: str = "model.step") -> StepPipeline:
+    """Drive ``state = stepper(state)`` for ``n_steps`` steps through
+    the engine (module docstring): one ordered dispatch per step, one
+    host-pool checkpoint serialization per ``checkpoint_every`` steps.
+
+    ``stepper`` takes and returns the loop state (bind ``dt`` et al.
+    with a lambda/partial); ``checkpoint`` is a
+    :class:`~pencilarrays_tpu.resilience.checkpoint.CheckpointManager`
+    whose ``save(step, {state_name: state})`` runs on the host pool.
+    Returns a :class:`StepPipeline`."""
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+    if (checkpoint is None) != (checkpoint_every is None):
+        raise ValueError(
+            "pass checkpoint= and checkpoint_every= together (or "
+            "neither)")
+    if checkpoint_every is not None and int(checkpoint_every) < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    eng = engine if engine is not None else get_engine()
+    saves: List[StepFuture] = []
+    prev_save: Optional[StepFuture] = None
+    last: Optional[StepFuture] = None
+    holder = {"state": state, "error": None}
+    for k in range(1, int(n_steps) + 1):
+
+        def run(k=k):
+            if holder["error"] is not None:
+                # a prior step failed: the loop state is stale, and the
+                # engine's drain-on contract would otherwise run every
+                # later step against it — re-raise the ORIGINAL error
+                # on each later future so ``final`` (what result()
+                # waits on) propagates the failure instead of returning
+                # a short-count state labeled as the full run's
+                raise holder["error"]
+            try:
+                holder["state"] = stepper(holder["state"])
+            except BaseException as e:
+                holder["error"] = e
+                raise
+            return holder["state"]
+
+        last = eng.submit(run, label=f"{label}:{k}")
+        if checkpoint is not None and k % int(checkpoint_every) == 0:
+            # the save waits its own step's future (serializing exactly
+            # the state it names) and the previous save (one manager,
+            # one commit at a time), then runs on the host pool —
+            # overlapped with the NEXT steps' device dispatches
+            def save(k=k, step_fut=last, prev=prev_save):
+                if prev is not None:
+                    prev.result()
+                x = step_fut.result()
+                return checkpoint.save(k, {state_name: x})
+
+            prev_save = eng.host_task(save, label=f"ckpt.save:{k}")
+            saves.append(prev_save)
+    return StepPipeline(last, tuple(saves))

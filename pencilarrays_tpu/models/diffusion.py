@@ -62,3 +62,17 @@ class DiffusionSpectral:
         """Physical initial condition -> physical solution at time ``t``
         (one forward transform, one exact decay, one inverse)."""
         return self.to_physical(self.step(self.from_physical(u0), t))
+
+    def run_async(self, uh: PencilArray, dt, n_steps: int, *,
+                  engine=None, checkpoint=None, checkpoint_every=None):
+        """Spectral-state step loop through the engine's ordered
+        dispatch queue with host-pool checkpoint overlap
+        (:func:`~pencilarrays_tpu.engine.run_steps_async` — the same
+        native pipelining ``NavierStokesSpectral.run_async`` gets);
+        returns a :class:`~pencilarrays_tpu.engine.StepPipeline`."""
+        from ..engine import run_steps_async
+
+        return run_steps_async(
+            lambda s: self.step(s, dt), uh, n_steps, engine=engine,
+            checkpoint=checkpoint, checkpoint_every=checkpoint_every,
+            state_name="uh", label="diffusion.step")

@@ -220,6 +220,39 @@ class NavierStokesSpectral:
         final, energies = jax.lax.scan(body, uh, None, length=n_steps)
         return final, (energies if record_energy else None)
 
+    def step_async(self, uh: PencilArray, dt: float, *, engine=None,
+                   stepper=None):
+        """Submit ONE step as an ordered engine dispatch; returns its
+        :class:`~pencilarrays_tpu.engine.StepFuture` (the
+        step-as-future form ``PencilFFTPlan.forward_async`` uses, at
+        the model-step grain) — enqueue step *k+1* while *k* computes
+        and the consumer issues them in order."""
+        from ..engine import get_engine
+
+        eng = engine if engine is not None else get_engine()
+        stepper = self.step if stepper is None else stepper
+        return eng.submit(lambda: stepper(uh, dt), label="ns.step")
+
+    def run_async(self, uh: PencilArray, dt: float, n_steps: int, *,
+                  engine=None, stepper=None, checkpoint=None,
+                  checkpoint_every=None):
+        """Drive ``n_steps`` steps through the engine's ordered
+        dispatch queue, serializing every ``checkpoint_every``-th state
+        through the host pool
+        (:func:`~pencilarrays_tpu.engine.run_steps_async` — checkpoint
+        writes overlap the next step's dispatch instead of stalling the
+        loop; no hand-rolled futures).  Eager per-step dispatch: use
+        :meth:`simulate` (one fused ``lax.scan`` program) when no
+        mid-run host work is needed.  Returns a
+        :class:`~pencilarrays_tpu.engine.StepPipeline`."""
+        from ..engine import run_steps_async
+
+        stepper = self.step if stepper is None else stepper
+        return run_steps_async(
+            lambda s: stepper(s, dt), uh, n_steps, engine=engine,
+            checkpoint=checkpoint, checkpoint_every=checkpoint_every,
+            state_name="uh", label="ns.step")
+
     def energy(self, uh: PencilArray):
         """Mean kinetic energy ``<|u|^2>/2`` over the box (computed in
         physical space; padding masked by the global reduction)."""

@@ -81,7 +81,7 @@ DEFAULT_DIR = "pa_obs"
 # fields ``extra_dims`` (the plan's batch) and ``decomposition`` (the
 # slab/pencil verdict) — see obs/schema.py V3_EVENT_FIELDS.  v1/v2
 # journals again stay lint-clean.
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 # events whose loss would blind a post-mortem: fsync'd under the default
 # "critical" policy.  High-rate events (per-hop dispatch) only flush.

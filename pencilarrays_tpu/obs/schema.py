@@ -14,7 +14,8 @@ from typing import Dict, Iterable, List, Tuple, Union
 
 from .events import SCHEMA_VERSION
 
-__all__ = ["COMMON_FIELDS", "EVENT_TYPES", "lint_event", "lint_journal"]
+__all__ = ["COMMON_FIELDS", "EVENT_TYPES", "V4_EVENT_FIELDS",
+           "lint_event", "lint_journal"]
 
 # fields every record carries (written by events.record_event itself)
 COMMON_FIELDS: Tuple[str, ...] = (
@@ -33,6 +34,17 @@ V2_STAMP_FIELDS: Tuple[str, ...] = ("step_idx", "epoch")
 # versioned, like the v2 correlation stamps.
 V3_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     "plan.build": ("extra_dims", "decomposition"),
+}
+
+# per-event fields required since schema v4 (memory-bounded
+# redistribution synthesis): a v4 ``route.plan`` record must carry the
+# footprint verdict pa-obs renders — the charged peak-HBM bytes, the
+# bound the route was admitted under (``None`` = unbounded), and the
+# donation assumption the pricing charged (the pinned-source
+# surcharge).  Per-candidate ``chunks`` ride the candidates payload.
+# v1-v3 journals stay lint-clean, as with the v2/v3 stamps.
+V4_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
+    "route.plan": ("peak_hbm_bytes", "hbm_limit", "donate"),
 }
 
 # ev -> required payload fields (extra fields are allowed; missing ones
@@ -141,6 +153,12 @@ def lint_event(e: dict) -> List[str]:
                 errors.append(
                     f"v{v} event {ev!r} missing required field {f!r} "
                     f"(batched-throughput fields, schema v3): {e!r}")
+    if isinstance(v, (int, float)) and v >= 4:
+        for f in V4_EVENT_FIELDS.get(ev, ()):
+            if f not in e:
+                errors.append(
+                    f"v{v} event {ev!r} missing required field {f!r} "
+                    f"(memory-bounded routing fields, schema v4): {e!r}")
     return errors
 
 

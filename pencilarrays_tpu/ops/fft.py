@@ -478,7 +478,12 @@ def _iter_priced_hops(steps: tuple):
     chunking the fused program owns (``k_mult`` = chunk count)."""
     for step in steps:
         if step[0] == "t":
-            yield step[1], step[2], step[3], None, 1
+            # a 5-element "t" step carries a per-hop method override
+            # (the ``hbm_limit`` chunk synthesis): yield it as the
+            # base with k_mult=1 — ``transpose_cost`` itself owns a
+            # Pipelined method's count multiplication
+            yield step[1], step[2], step[3], (
+                step[4] if len(step) > 4 else None), 1
         elif step[0] == "ft":
             (_, src, dst, hop_dtype, _post, _ops, _pc, base,
              _c, bounds) = step
@@ -676,6 +681,24 @@ class PencilFFTPlan:
     together), and ``Auto``/``decomposition="auto"``/the reshard route
     planner select with it.
 
+    ``hbm_limit`` bounds every exchange hop's static per-chip peak-HBM
+    footprint at the plan's :attr:`batch_dims` (memory-bounded
+    redistribution, arXiv:2112.01075 — the reshard route planner's
+    chunked-edge synthesis applied to the plan's own schedule): a hop
+    whose chunk-aware footprint busts the limit is rewritten at
+    construction into a time-sliced variant — a fused ``"ft"`` hop
+    re-chunks until its footprint fits, a plain ``"t"`` hop gains a
+    per-hop ``Pipelined`` method override (count ×K, bytes unchanged,
+    bit-identical to the unbounded schedule).  A hop that cannot fit
+    (local permute over the limit, no chunkable dim, chunk extent
+    exhausted, partitioner-owned collectives) raises a typed pre-flight
+    :class:`~pencilarrays_tpu.analysis.errors.HbmBoundError` naming it
+    — at construction, never mid-dispatch.  ``analysis.spmd.
+    verify_hbm(plan, hbm_limit)`` re-certifies the same accounting
+    post-hoc; ``compile()``-time ``extra_dims`` beyond ``batch_dims``
+    are the caller's to re-certify.  (``decomposition="auto"`` scores
+    candidates unbounded; the winner is then bounded.)
+
     ``decomposition="auto" | "slab" | "pencil"`` re-factorizes the
     topology's devices into the cheapest admissible process grid:
     every 1-D (slab) and 2-D (pencil) candidate's full schedule is
@@ -696,7 +719,7 @@ class PencilFFTPlan:
                  normalization: str = "backward",
                  pipeline=None, batch: Optional[int] = None,
                  decomposition: Optional[str] = None,
-                 wire_dtype=None,
+                 wire_dtype=None, hbm_limit: Optional[int] = None,
                  _probe: bool = False):
         global_shape = tuple(int(n) for n in global_shape)
         N = len(global_shape)
@@ -954,6 +977,26 @@ class PencilFFTPlan:
         if k_req > 1:
             self._steps = self._fuse_pipeline_steps(self._steps, k_req)
 
+        # -- memory-bounded schedule synthesis ----------------------------
+        # ``hbm_limit`` rewrites over-budget hops into time-sliced
+        # variants (chunked fused hops / per-hop Pipelined overrides)
+        # or fails typed at construction — see _bound_steps_hbm.
+        self.hbm_limit = None
+        if hbm_limit is not None:
+            # same coercion as reshard()/plan_reshard_route: np.int64
+            # from device-memory math is as good as a builtin int
+            try:
+                lim = (None if isinstance(hbm_limit, bool)
+                       else int(hbm_limit))
+            except (TypeError, ValueError):
+                lim = None
+            if lim is None or lim < 1:
+                raise ValueError(
+                    f"hbm_limit must be None or a positive int (bytes "
+                    f"per chip), got {hbm_limit!r}")
+            self.hbm_limit = lim
+            self._steps = self._bound_steps_hbm(self._steps, lim)
+
         # conceptual full chain (stage d pencil at its pre-stage shape),
         # for introspection/tests; the schedule above may visit fewer.
         self._pencils: List[Pencil] = []
@@ -1061,6 +1104,105 @@ class PencilFFTPlan:
         return ("ft", src, tgt, hop_dtype, post, tuple(ops), pre_complex,
                 base, c, bounds)
 
+    def _bound_steps_hbm(self, steps: tuple, limit: int) -> tuple:
+        """Memory-bounded schedule synthesis (the reshard route
+        planner's chunked-edge rule applied to the plan's own hops,
+        arXiv:2112.01075): every exchange step whose chunk-aware
+        peak-HBM footprint (``analysis.spmd.step_hop_peak`` — the ONE
+        accounting shared with the router) busts ``limit`` at the
+        plan's :attr:`batch_dims` is rewritten to a time-sliced
+        variant, bit-identical to the original (chunking along an
+        exchange-untouched dim commutes with the exchange; only the
+        collective count multiplies).  A hop that cannot fit raises a
+        typed pre-flight :class:`~pencilarrays_tpu.analysis.errors.
+        HbmBoundError` naming it."""
+        from ..analysis.errors import HbmBoundError
+        from ..analysis.spmd import step_hop_peak
+
+        extra = self.batch_dims
+        out = []
+        for idx, s in enumerate(steps):
+            if s[0] not in ("t", "ft"):
+                out.append(s)
+                continue
+            peak = step_hop_peak(s, extra, method=self.method,
+                                 wire_dtype=self.wire_dtype)
+            if peak <= limit:
+                out.append(s)
+                continue
+            fixed = self._chunk_step_to_fit(s, extra, limit)
+            if fixed is None:
+                raise HbmBoundError(
+                    "plan",
+                    f"hop[{idx}] {s[1].decomposition}->"
+                    f"{s[2].decomposition}", peak, limit)
+            out.append(fixed)
+        return tuple(out)
+
+    def _chunk_step_to_fit(self, s: tuple, extra: tuple, limit: int):
+        """Smallest time-slicing of ONE over-budget step that fits
+        ``limit`` (K doubling from the current chunking, then the chunk
+        dim's full extent), or ``None`` when nothing chunkable fits:
+        fused ``"ft"`` steps re-chunk their own bounds; plain ``"t"``
+        steps gain a per-hop ``Pipelined`` method override."""
+        from ..analysis.spmd import step_hop_peak
+
+        src, dst = s[1], s[2]
+        R = assert_compatible(src, dst)
+        if R is None or src.topology.dims[R] == 1:
+            return None     # nothing on the wire to time-slice
+        ext = _exchange_operand_extents(src, dst, R)
+
+        def k_sweep(k0: int, n: int):
+            k = k0
+            while k < n:
+                yield k
+                k *= 2
+            yield n          # maximal slicing: one row per chunk
+
+        if s[0] == "ft":
+            _, _, _, _, post, ops, pre_complex, base, c, bounds = s
+            n = int(ext[c])
+            for K in k_sweep(len(bounds) * 2, n):
+                nb = _chunk_bounds(n, K)
+                if len(nb) <= len(bounds):
+                    continue
+                cand = s[:9] + (nb,)
+                if step_hop_peak(cand, extra) <= limit:
+                    return cand
+            return None
+        # plain "t" hop: resolve the plan's method to a concrete base
+        # (cheap + deterministic — the _try_fuse_hop convention) and
+        # sweep Pipelined chunk factors over it
+        hop_dtype = s[3]
+        method = s[4] if len(s) > 4 else self.method
+        if isinstance(method, Auto):
+            if method.mode == "measure":
+                from dataclasses import replace
+
+                method = replace(method, mode="estimate")
+            method = resolve_method(src, dst, extra, hop_dtype, method,
+                                    _quiet=True)
+        k0 = 2
+        if isinstance(method, Pipelined):
+            k0, method = method.chunks * 2, method.base
+        if not isinstance(method, (AllToAll, Ring)):
+            return None     # Gspmd: partitioner-owned, unboundable
+        shape = tuple(ext) + tuple(extra)
+        c = _pipeline_chunk_axis(shape, src.decomposition[R],
+                                 dst.decomposition[R])
+        if c is None:
+            return None
+        n = int(shape[c])
+        for K in k_sweep(k0, n):
+            if len(_chunk_bounds(n, K)) <= 1:
+                continue
+            cand = ("t", src, dst, hop_dtype,
+                    Pipelined(chunks=K, base=method))
+            if step_hop_peak(cand, extra) <= limit:
+                return cand
+        return None
+
     def plan_key(self) -> str:
         """Stable fingerprint of this plan's full static configuration
         — the PUBLIC registry/correlation key (12 hex chars of the
@@ -1101,11 +1243,17 @@ class PencilFFTPlan:
         steps = []
         for s in self._steps:
             if s[0] == "t":
-                _, src, tgt, hop_dtype = s
-                steps.append({"kind": "t",
-                              "hop": f"{src.decomposition}->"
-                                     f"{tgt.decomposition}",
-                              "dtype": str(jnp.dtype(hop_dtype))})
+                src, tgt, hop_dtype = s[1], s[2], s[3]
+                entry = {"kind": "t",
+                         "hop": f"{src.decomposition}->"
+                                f"{tgt.decomposition}",
+                         "dtype": str(jnp.dtype(hop_dtype))}
+                if len(s) > 4:
+                    # hbm_limit chunk override: part of the summary, so
+                    # a memory-bounded plan fingerprints apart from its
+                    # unbounded sibling (serve coalescing separates them)
+                    entry["method"] = _method_label(s[4])
+                steps.append(entry)
             elif s[0] == "ft":
                 (_, src, tgt, hop_dtype, _post, ops, _pc, base, c,
                  bounds) = s
@@ -1223,9 +1371,11 @@ class PencilFFTPlan:
                 add(src, dst, hop_dtype, method)
                 continue
             m = base if method is self.method else method
-            if isinstance(m, Pipelined):
+            if isinstance(m, Pipelined) and k_mult > 1:
                 # the fused hop owns the chunking (k_mult) — unwrap an
-                # override so the count is not multiplied twice
+                # override so the count is not multiplied twice.  A
+                # k_mult == 1 base is an hbm_limit "t"-hop Pipelined
+                # override whose count transpose_cost multiplies itself
                 m = m.base
             add(src, dst, hop_dtype, m, k_mult=k_mult)
         return total
@@ -1379,7 +1529,9 @@ class PencilFFTPlan:
         owned = donate
         for step in self._steps:
             if step[0] == "t":
-                x = transpose(x, step[2], method=self.method,
+                x = transpose(x, step[2],
+                              method=(step[4] if len(step) > 4
+                                      else self.method),
                               donate=self._hop_donate(x, owned))
             elif step[0] == "ft":
                 # fused pipelined hop: chunked exchange interleaved with
@@ -1461,7 +1613,9 @@ class PencilFFTPlan:
         owned = donate
         for step in reversed(self._steps):
             if step[0] == "t":
-                x = transpose(x, step[1], method=self.method,
+                x = transpose(x, step[1],
+                              method=(step[4] if len(step) > 4
+                                      else self.method),
                               donate=self._hop_donate(x, owned))
             elif step[0] == "ft":
                 # mirrored fused hop: per-chunk inverse transform, then

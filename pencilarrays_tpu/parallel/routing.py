@@ -23,8 +23,18 @@ configurations:
   drifting to 2x its modeled time gets its bytes doubled in the search);
 * **search** — Dijkstra from ``src.decomposition`` to
   ``dest.decomposition`` with a per-hop peak-HBM bound (the exchange
-  operand + result must fit; routes whose intermediates spill are
-  pruned);
+  operand + result must fit); an edge that busts the bound is not
+  simply pruned: the planner first tries to **synthesize** a feasible
+  variant by time-slicing the exchange into K smaller collectives
+  (``Pipelined(chunks=K)`` along an exchange-untouched dim — the
+  reference's memory-bounded redistribution move, arXiv:2112.01075
+  §4), priced at its true time-sliced footprint (live input slice +
+  one in-flight wire chunk + accumulated output) and its true cost
+  (count ×K, bytes unchanged).  Donation is part of edge pricing: a
+  non-donated source block stays resident under the whole fused chain
+  and is charged on every edge, while ``donate=True`` retires it into
+  the first hop chunk-by-chunk — so donating admits routes that
+  non-donating pricing still prunes;
 * **baseline** — the GSPMD reshard, priced from its own partitioned HLO
   (:func:`~pencilarrays_tpu.parallel.transpositions.gspmd_reshard_cost`),
   so the verdict is a like-for-like byte comparison.  The planner never
@@ -71,6 +81,7 @@ from .transpositions import (
     Gspmd,
     Pipelined,
     Ring,
+    _chunk_bounds,
     _exchange_factory,
     _exchange_operand_extents,
     _exchange_transpose,
@@ -78,6 +89,7 @@ from .transpositions import (
     _method_label,
     _method_wire,
     _metered_cached,
+    _pipeline_chunk_axis,
     _transpose_local,
     assert_compatible,
     gspmd_reshard_cost,
@@ -178,11 +190,19 @@ class ReshardRoute:
     ``verdict`` is one of ``"routed"`` (route wins the Auto price
     comparison), ``"routed:forced"`` (an explicit non-Auto method asked
     for explicit exchanges — no GSPMD substitution, no baseline
-    pricing), ``"gspmd"`` (route found but not cheaper),
+    pricing), ``"routed:hbm"`` (an ``hbm_limit`` was given and an
+    admissible — possibly chunk-synthesized — route exists: a bounded
+    plan never falls back to the partitioner, whose peak is
+    unknowable), ``"gspmd"`` (route found but not cheaper),
     ``"gspmd:no-route"`` (search exhausted — e.g. fully-decomposed
-    topologies have no single-slot moves) or ``"gspmd:unpriced"``
-    (route found, GSPMD baseline could not be priced — the priced
-    route wins by default)."""
+    topologies have no single-slot moves, or no chunking fits the
+    ``hbm_limit``) or ``"gspmd:unpriced"`` (route found, GSPMD
+    baseline could not be priced — the priced route wins by default).
+
+    ``donate`` and ``hbm_limit`` record the pricing assumptions the
+    per-hop ``peak_hbm_bytes`` were charged under, so the static
+    verifier (``analysis.spmd.predicted_peak_hbm``) reproduces the
+    exact same accounting."""
 
     src: Pencil
     dest: Pencil
@@ -194,6 +214,8 @@ class ReshardRoute:
     use_route: bool
     verdict: str
     searched_nodes: int
+    donate: bool = False
+    hbm_limit: Optional[int] = None
 
     @property
     def pencils(self) -> Tuple[Pencil, ...]:
@@ -218,15 +240,38 @@ def _score(cost: dict, latency_bytes: int, drift: float = 1.0,
 
 def _hop_peak_bytes(pin: Pencil, pout: Pencil, R: Optional[int],
                     extra_dims: Tuple[int, ...], dtype,
-                    wire_dtype: Optional[str] = None) -> int:
-    """Per-chip HBM high-water mark of one hop: the exchanged operand
-    (logical local block with the to-be-split dim padded — the shape the
-    byte model prices) plus its same-sized result, both live across the
-    collective.  Local permutes charge in+out blocks.  A reduced-wire
-    hop's exchanged operand is the PACKED block (half the bytes), its
-    restored result full precision — which is how a reduced-precision
-    edge can fit under an ``hbm_limit`` that pruned its full-precision
-    sibling."""
+                    method: Optional[AbstractTransposeMethod] = None, *,
+                    chunk_dim: Optional[int] = None,
+                    bounds: Optional[Tuple[Tuple[int, int], ...]] = None
+                    ) -> int:
+    """Per-chip HBM high-water mark of one hop — the ONE footprint
+    accounting shared by the route planner's ``hbm_limit`` admission
+    and the static verifier (``analysis/spmd.py``), its only other
+    sanctioned caller (enforced by ``pa-lint hop-peak``).
+
+    Exchange hops charge ``elems * itemsize + chunk_elems * wire``:
+
+    * ``elems * itemsize`` — the restored full-precision result plus
+      the retiring input: at time-slice ``k`` of a chunked exchange the
+      not-yet-packed input slices and the already-accumulated output
+      chunks together never exceed one full operand (the input retires
+      chunk-by-chunk as it packs; the planner adds a pinned-source
+      surcharge when the caller does NOT donate — see
+      :func:`plan_reshard_route`);
+    * ``chunk_elems * wire`` — the one in-flight wire-packed chunk.
+      Unchunked (``chunk_elems == elems``) this reproduces the
+      historical operand+result bound ``elems * (wire + itemsize)``
+      exactly, and a reduced-wire hop's in-flight share is the PACKED
+      bytes — which is how wire edges fit under an ``hbm_limit`` that
+      pruned their full-precision siblings (PR 13).
+
+    ``method`` supplies both the wire dtype and the chunking (a
+    :class:`~pencilarrays_tpu.parallel.transpositions.Pipelined`
+    method's K slices along the same exchange-untouched dim the
+    runtime factory chunks); ``chunk_dim``/``bounds`` override the
+    method-derived choice for fused plan hops whose program owns its
+    own chunk dim (``ops/fft.py`` ``"ft"`` steps).  Local permutes
+    charge in+out blocks, as before."""
     import numpy as np
 
     isize = np.dtype(dtype if dtype is not None else np.float32).itemsize
@@ -234,10 +279,54 @@ def _hop_peak_bytes(pin: Pencil, pout: Pencil, R: Optional[int],
         return (pin.bytes_per_device(extra_dims, isize=isize)
                 + pout.bytes_per_device(extra_dims, isize=isize))
     ext = _exchange_operand_extents(pin, pout, R)
-    elems = int(np.prod(ext, dtype=np.int64))
-    for e in extra_dims:
-        elems *= int(e)
-    return elems * (wire_itemsize(dtype, wire_dtype) + isize)
+    shape = tuple(ext) + tuple(extra_dims)
+    elems = int(np.prod(shape, dtype=np.int64))
+    w = wire_itemsize(dtype, _method_wire(method))
+    if bounds is None and isinstance(method, Pipelined):
+        chunk_dim = _pipeline_chunk_axis(
+            shape, pin.decomposition[R], pout.decomposition[R])
+        if chunk_dim is not None:
+            bounds = _chunk_bounds(shape[chunk_dim], method.chunks)
+    chunk_elems = elems
+    if chunk_dim is not None and bounds is not None and len(bounds) > 1:
+        widest = max(s1 - s0 for s0, s1 in bounds)
+        chunk_elems = elems // shape[chunk_dim] * widest
+    return elems * isize + chunk_elems * w
+
+
+def _synthesize_chunked(psrc: Pencil, pdst: Pencil, R: int,
+                        extra_dims: Tuple[int, ...], dtype,
+                        m: AbstractTransposeMethod, budget: int):
+    """Memory-bounded edge synthesis (arXiv:2112.01075): time-slice one
+    over-budget exchange into the SMALLEST ``Pipelined(chunks=K)``
+    variant (K doubling, then the chunk dim's full extent) whose
+    time-sliced footprint fits ``budget``.  Returns ``(method, peak)``
+    or ``(None, 0)`` when nothing chunkable fits — data movement of
+    every candidate is bit-identical to ``m`` (chunking along an
+    exchange-untouched dim commutes with the exchange); only the
+    collective count (×K) and the footprint change."""
+    base = m.base if isinstance(m, Pipelined) else m
+    shape = (tuple(_exchange_operand_extents(psrc, pdst, R))
+             + tuple(extra_dims))
+    c = _pipeline_chunk_axis(shape, psrc.decomposition[R],
+                             pdst.decomposition[R])
+    if c is None or budget <= 0:
+        return None, 0
+    n = int(shape[c])
+    ks = []
+    k = (m.chunks if isinstance(m, Pipelined) else 1) * 2
+    while k < n:
+        ks.append(k)
+        k *= 2
+    ks.append(n)  # maximal slicing: one chunk per row
+    for k in ks:
+        if len(_chunk_bounds(n, k)) <= 1:
+            continue
+        cand = Pipelined(chunks=k, base=base)
+        peak = _hop_peak_bytes(psrc, pdst, R, extra_dims, dtype, cand)
+        if peak <= budget:
+            return cand, peak
+    return None, 0
 
 
 def _node_pencil(node: Tuple[int, ...], pin: Pencil, dest: Pencil) -> Pencil:
@@ -259,7 +348,7 @@ def _node_pencil(node: Tuple[int, ...], pin: Pencil, dest: Pencil) -> Pencil:
 def _plan_cached(pin: Pencil, dest: Pencil, extra_dims: Tuple[int, ...],
                  dtype_str: str, method: AbstractTransposeMethod,
                  latency_bytes: int, hbm_limit: Optional[int],
-                 _drift_v: int) -> ReshardRoute:
+                 donate: bool, _drift_v: int) -> ReshardRoute:
     """The search proper, cached per static configuration.  ``_drift_v``
     is the drift tracker's version counter: new timing samples invalidate
     cached plans (the compiled route executors have their own cache, so
@@ -272,14 +361,35 @@ def _plan_cached(pin: Pencil, dest: Pencil, extra_dims: Tuple[int, ...],
     drift_hops: Dict[str, dict] = {}
     if _drift_v:
         drift_hops = drift_tracker.report()["hops"]
+    # donation accounting: a non-donated source block stays resident
+    # under the ENTIRE fused chain (the caller still owns it), so every
+    # edge is charged it on top of its own working set; donate=True
+    # retires it into the first hop (chunk-by-chunk when chunked) and
+    # the surcharge disappears — which is exactly how reshard(
+    # donate=True) admits routes non-donating pricing still prunes
+    pinned = 0 if donate else pin.bytes_per_device(
+        extra_dims, isize=dtype.itemsize)
 
-    def edge(psrc: Pencil, pdst: Pencil):
+    def edge(psrc: Pencil, pdst: Pencil, first: bool = False):
         m = resolve_method(psrc, pdst, extra_dims, dtype, method)
+        R = assert_compatible(psrc, pdst)
+        # a first-hop local permute's input IS the source block: the
+        # in+out charge already counts it, so no surcharge there
+        surcharge = 0 if (first and R is None) else pinned
+        peak = _hop_peak_bytes(psrc, pdst, R, extra_dims, dtype, m) \
+            + surcharge
+        if (hbm_limit is not None and peak > hbm_limit and R is not None
+                and psrc.topology.dims[R] > 1
+                and isinstance(m, (AllToAll, Ring, Pipelined))):
+            # memory-bounded synthesis: time-slice the over-budget
+            # exchange instead of pruning it outright
+            m2, p2 = _synthesize_chunked(psrc, pdst, R, extra_dims,
+                                         dtype, m, hbm_limit - surcharge)
+            if m2 is not None:
+                m, peak = m2, p2 + surcharge
         cost = transpose_cost(psrc, pdst, extra_dims, dtype, m)
         drift = trusted_drift(drift_hops, _hop_label(psrc, pdst, m, dtype))
-        R = assert_compatible(psrc, pdst)
         wire = _method_wire(m)
-        peak = _hop_peak_bytes(psrc, pdst, R, extra_dims, dtype, wire)
         return RouteHop(psrc, pdst, m, cost,
                         _score(cost, latency_bytes, drift, dtype, wire),
                         peak)
@@ -288,7 +398,7 @@ def _plan_cached(pin: Pencil, dest: Pencil, extra_dims: Tuple[int, ...],
     searched = 0
     if pin.decomposition == dest.decomposition:
         # permutation-only change: a single local-permute "hop"
-        hops = (edge(pin, dest),)
+        hops = (edge(pin, dest, first=True),)
         searched = 1
     else:
         # Dijkstra over ordered decomposition tuples (slot i <-> mesh
@@ -314,9 +424,10 @@ def _plan_cached(pin: Pencil, dest: Pencil, extra_dims: Tuple[int, ...],
                     v = u[:slot] + (nd,) + u[slot + 1:]
                     if nd == u[slot] or v not in nodes or v in done:
                         continue
-                    h = edge(pu, _node_pencil(v, pin, dest))
+                    h = edge(pu, _node_pencil(v, pin, dest),
+                             first=u == start)
                     if hbm_limit is not None and h.peak_hbm_bytes > hbm_limit:
-                        continue  # this exchange would not fit: prune
+                        continue  # no chunking fits either: prune
                     nd_score = d + h.score_bytes
                     if nd_score < best_score.get(v, 2 ** 62):
                         best_score[v] = nd_score
@@ -330,35 +441,49 @@ def _plan_cached(pin: Pencil, dest: Pencil, extra_dims: Tuple[int, ...],
                 chain.append(h)
             hops = tuple(reversed(chain))
 
-    if not hops:
+    if not hops or (hbm_limit is not None
+                    and max(h.peak_hbm_bytes for h in hops) > hbm_limit):
+        # search exhausted — or the only "route" is a local permute
+        # whose in+out blocks bust the bound (nothing to time-slice)
         return ReshardRoute(pin, dest, (), None, None, None, None, False,
-                            "gspmd:no-route", searched)
+                            "gspmd:no-route", searched, donate, hbm_limit)
 
     score = sum(h.score_bytes for h in hops)
     peak = max(h.peak_hbm_bytes for h in hops)
+    if hbm_limit is not None:
+        # a bounded plan never falls back to the partitioner: GSPMD's
+        # peak allocation is partitioner-owned and unboundable, so an
+        # admissible (possibly chunk-synthesized) route IS the verdict
+        # (explicit methods are honored per edge — the chunk synthesis
+        # only ever WRAPS them in Pipelined, bit-identical — so the
+        # bound verdict subsumes "routed:forced")
+        return ReshardRoute(pin, dest, hops, score, peak, None, None, True,
+                            "routed:hbm", searched, donate, hbm_limit)
     if not isinstance(method, Auto):
         # an EXPLICIT method is a user decision (pin collectives, dodge
         # a partitioner bug): never silently substitute the GSPMD
         # exchange for it — the baseline comparison is Auto's job
         return ReshardRoute(pin, dest, hops, score, peak, None, None, True,
-                            "routed:forced", searched)
+                            "routed:forced", searched, donate, hbm_limit)
     try:
         gcost = gspmd_reshard_cost(pin, dest, extra_dims, dtype)
     except Exception:  # pricing is best-effort: a lowering quirk must
         gcost = None   # never make reshard() itself fail
     if gcost is None:
         return ReshardRoute(pin, dest, hops, score, peak, None, None, True,
-                            "gspmd:unpriced", searched)
+                            "gspmd:unpriced", searched, donate, hbm_limit)
     gscore = _score(gcost, latency_bytes)
     use = score < gscore
     return ReshardRoute(pin, dest, hops, score, peak, gcost, gscore, use,
-                        "routed" if use else "gspmd", searched)
+                        "routed" if use else "gspmd", searched, donate,
+                        hbm_limit)
 
 
 def plan_reshard_route(pin: Pencil, dest: Pencil,
                        extra_dims: Tuple[int, ...] = (), dtype=None, *,
                        method: AbstractTransposeMethod = Auto(),
-                       hbm_limit: Optional[int] = None) -> ReshardRoute:
+                       hbm_limit: Optional[int] = None,
+                       donate: bool = False) -> ReshardRoute:
     """Plan the redistribution ``pin -> dest``: search the pencil graph
     for the cheapest admissible single-axis hop chain and compare it
     against the priced GSPMD baseline.  See the module docstring for
@@ -366,14 +491,32 @@ def plan_reshard_route(pin: Pencil, dest: Pencil,
 
     ``method`` resolves each edge (:class:`Auto` per hop; measure-mode
     Auto plans with the estimate rule — planning must stay cheap and
-    deterministic).  ``hbm_limit`` bounds each hop's per-chip
-    operand+result bytes; routes needing more are pruned.
+    deterministic).
+
+    ``hbm_limit`` bounds each hop's charged per-chip footprint
+    (``_hop_peak_bytes``'s time-sliced working set, plus the resident
+    source block on every edge when ``donate=False``).  An over-budget
+    edge is not pruned outright: the planner first synthesizes a
+    ``Pipelined(chunks=K)`` time-sliced variant (smallest fitting K —
+    doubling, then maximal) whose footprint fits, priced at count ×K /
+    bytes unchanged and bit-identical to the unchunked exchange.  With
+    a limit set the planner never falls back to GSPMD (whose peak is
+    partitioner-owned and unboundable): an admissible route carries
+    verdict ``"routed:hbm"``, an exhausted search ``"gspmd:no-route"``.
+
+    ``donate`` declares that the source buffer will be donated to the
+    executed chain (``reshard(donate=True)`` plans with it): the
+    pinned-source surcharge disappears, so donating callers are
+    admitted under limits that prune non-donating ones.  Plan and
+    execution must agree — ``execute_route(donate=)`` should match the
+    planned ``route.donate`` when the route was hbm-bounded.
 
     ``analysis.spmd.verify_route`` statically proves a planned route's
     fused executable compiles to EXACTLY the per-hop priced
     collectives, and ``analysis.spmd.verify_hbm``/``verify_donation``
-    check the same peak-HBM accounting and the donation elision the
-    pricing assumes — the pre-flight sibling of this planner.
+    check the same (chunk- and donation-aware) peak-HBM accounting and
+    the donation elision the pricing assumes — the pre-flight sibling
+    of this planner.
     """
     import numpy as np
 
@@ -398,7 +541,9 @@ def plan_reshard_route(pin: Pencil, dest: Pencil,
     # a pure function of the static config (see module docstring)
     v = drift_tracker.version() if jax.process_count() == 1 else 0
     return _plan_cached(pin, dest, tuple(int(e) for e in extra_dims),
-                        dt.str, method, int(latency), hbm_limit, v)
+                        dt.str, method, int(latency),
+                        int(hbm_limit) if hbm_limit is not None else None,
+                        bool(donate), v)
 
 
 # ---------------------------------------------------------------------------
@@ -600,7 +745,9 @@ def _obs_record_route_plan(route: ReshardRoute, extra_dims: tuple,
     dt = np.dtype(dtype if dtype is not None else np.float32)
     config = (f"{route.src.size_global()}@{route.src.topology.dims} "
               f"{route.src.decomposition}->{route.dest.decomposition} "
-              f"{dt.name} extra={tuple(extra_dims)}")
+              f"{dt.name} extra={tuple(extra_dims)}"
+              + (f" hbm={route.hbm_limit} donate={route.donate}"
+                 if route.hbm_limit is not None else ""))
     key = (obs.run_id(), config)
     if key in _ROUTE_LOGGED:
         return
@@ -611,6 +758,14 @@ def _obs_record_route_plan(route: ReshardRoute, extra_dims: tuple,
             "kind": "routed",
             "route": [list(h.dest.decomposition) for h in route.hops],
             "methods": [_method_label(h.method) for h in route.hops],
+            # per-hop chunk factors + charged footprints: what a
+            # post-mortem (pa-obs) needs to see WHY a whale request was
+            # admitted — the synthesized time-slicing and the bound it
+            # was priced against
+            "chunks": [h.method.chunks
+                       if isinstance(h.method, Pipelined) else 1
+                       for h in route.hops],
+            "hop_peak_hbm_bytes": [h.peak_hbm_bytes for h in route.hops],
             "predicted_bytes": sum(
                 v["bytes"] for h in route.hops for v in h.cost.values()),
             "score_bytes": route.score_bytes,
@@ -633,5 +788,7 @@ def _obs_record_route_plan(route: ReshardRoute, extra_dims: tuple,
         topo=list(route.src.topology.dims), dtype=dt.name,
         verdict=route.verdict, candidates=candidates,
         predicted_bytes=(winner or {}).get("predicted_bytes", 0),
+        peak_hbm_bytes=route.peak_hbm_bytes,
+        hbm_limit=route.hbm_limit, donate=route.donate,
         searched_nodes=route.searched_nodes)
     obs.counter("route.plans", verdict=route.verdict).inc()

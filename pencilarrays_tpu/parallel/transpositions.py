@@ -1275,7 +1275,8 @@ def transpose(src: PencilArray, dest: Pencil, *,
 
 def reshard(src: PencilArray, dest: Pencil, *,
             method: AbstractTransposeMethod = Auto(),
-            donate: bool = False) -> PencilArray:
+            donate: bool = False,
+            hbm_limit: Optional[int] = None) -> PencilArray:
     """Unrestricted redistribution between *any* two pencils sharing a
     topology and global shape — capability beyond the reference's
     single-slot transpose.
@@ -1295,6 +1296,17 @@ def reshard(src: PencilArray, dest: Pencil, *,
 
     ``donate=True`` donates the source buffer to the executable (``src``
     becomes invalid), as with ``transpose(donate=True)``.
+
+    ``hbm_limit`` bounds every hop's charged per-chip footprint
+    (memory-bounded redistribution, ``arXiv:2112.01075``): hops that
+    would bust the limit are time-sliced into chunked collectives by
+    the planner (bit-identical, count ×K), ``donate=True`` shrinks the
+    charge further (the retiring-source accounting — see
+    :func:`~pencilarrays_tpu.parallel.routing.plan_reshard_route`),
+    and the bound is honored or the call fails typed: when no
+    admissible route exists at all, a
+    :class:`~pencilarrays_tpu.analysis.errors.HbmBoundError` is raised
+    instead of silently running the unbounded GSPMD exchange.
     """
     import jax.core
 
@@ -1303,6 +1315,12 @@ def reshard(src: PencilArray, dest: Pencil, *,
         raise ValueError("reshard: pencil topologies differ")
     if pin.size_global() != dest.size_global():
         raise ValueError("reshard: global shapes differ")
+    if hbm_limit is not None and isinstance(method, Gspmd):
+        raise ValueError(
+            "reshard(hbm_limit=) cannot bound method=Gspmd(): the "
+            "partitioner owns its collectives and intermediates, so no "
+            "peak-HBM claim is checkable; use Auto() or an explicit "
+            "exchange method")
     if pin == dest:
         return src  # nothing to move (transpose() passthrough parity)
     eager = not isinstance(src.data, jax.core.Tracer)
@@ -1312,14 +1330,31 @@ def reshard(src: PencilArray, dest: Pencil, *,
                               plan_reshard_route)
 
         route = plan_reshard_route(pin, dest, src.extra_dims, src.dtype,
-                                   method=method)
+                                   method=method, hbm_limit=hbm_limit,
+                                   donate=don)
         if obs.enabled() and eager:
             _obs_record_route_plan(route, src.extra_dims, src.dtype)
-            obs.counter("reshard.dispatches",
-                        path="routed" if route.use_route else "gspmd").inc()
         if route.use_route:
+            if obs.enabled() and eager:
+                obs.counter("reshard.dispatches", path="routed").inc()
             return execute_route(src, route, donate=don)
-    elif obs.enabled() and eager:
+        if hbm_limit is not None:
+            # the caller asked for a bound the planner cannot honor:
+            # the GSPMD fallback's peak is unboundable, so fail typed
+            # (report the cheapest unbounded route's footprint so the
+            # error names the actual need, not just the miss)
+            from ..analysis.errors import HbmBoundError
+
+            unbounded = plan_reshard_route(pin, dest, src.extra_dims,
+                                           src.dtype, method=method,
+                                           donate=don)
+            raise HbmBoundError(
+                "reshard",
+                f"{pin.decomposition}->{dest.decomposition}",
+                unbounded.peak_hbm_bytes or 0, int(hbm_limit))
+    # only an ACTUAL gspmd dispatch is counted (the typed hbm raise
+    # above dispatches nothing, and must not leave phantom metrics)
+    if obs.enabled() and eager:
         obs.counter("reshard.dispatches", path="gspmd").inc()
     # the GSPMD fallback is pure data movement too: with the guard
     # armed, eager dispatches run probe-instrumented (same invariant,

@@ -291,6 +291,34 @@ class CheckpointManager:
                              seconds=dt)
         return final
 
+    def save_async(self, step: int, state: Mapping, *,
+                   chunks: bool = False, engine=None):
+        """Serialize checkpoint ``step`` on the engine's HOST pool
+        (:meth:`~pencilarrays_tpu.engine.Engine.host_task`) —
+        :meth:`save`, overlapped with whatever the ordered dispatch
+        queue runs next (the PR-12 host/device overlap, applied to the
+        save path natively instead of callers hand-rolling futures).
+        Returns a :class:`~pencilarrays_tpu.engine.StepFuture`
+        resolving to the committed directory; failures surface as
+        typed errors on the future.
+
+        The ``state`` mapping is snapshotted shallowly at submit (jax
+        arrays are immutable, so the serialized values are a stable
+        snapshot even while later steps compute).  Concurrent saves on
+        ONE manager are the caller's to order — chain on the returned
+        future, or drive the loop through
+        :func:`~pencilarrays_tpu.engine.run_steps_async`, which chains
+        saves for you.  Single-controller meshes only (the save path
+        barriers internally; a host-pool save on a multi-controller
+        rank would barrier off the main thread)."""
+        from ..engine import get_engine
+
+        eng = engine if engine is not None else get_engine()
+        state = dict(state)
+        return eng.host_task(
+            lambda: self.save(step, state, chunks=chunks),
+            label=f"ckpt.save:{step}")
+
     def _recover_replaced(self) -> None:
         """A re-save of step N moves the old committed directory to
         ``.tmp-step-N-replaced`` before the new COMMIT lands; if the

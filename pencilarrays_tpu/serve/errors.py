@@ -20,10 +20,13 @@ class ServeError(RuntimeError):
 class AdmissionError(ServeError):
     """A tenant's request was rejected at admission (quota exceeded).
 
-    Carries ``tenant`` and ``reason`` (``"queue-depth"`` or
-    ``"inflight-bytes"``) so a client can distinguish back-off from a
-    bug.  Admission rejections never enter the queue: they cost the
-    service one counter bump and the caller one typed exception.
+    Carries ``tenant`` and ``reason`` (``"queue-depth"``,
+    ``"inflight-bytes"``, or ``"hbm-limit"`` — a whale reshard for
+    which even the chunk-synthesized route planner found no admissible
+    route under the service's per-chip peak-HBM bound) so a client can
+    distinguish back-off from a bug.  Admission rejections never enter
+    the queue: they cost the service one counter bump and the caller
+    one typed exception.
     """
 
     def __init__(self, msg: str, *, tenant: str, reason: str):

@@ -135,12 +135,19 @@ class AdmissionQueue:
     def __init__(self, *, max_batch: int = 8, max_wait_s: float = 0.002,
                  starve_after_s: float = 1.0,
                  default_quota: Optional[TenantQuota] = None,
-                 quotas: Optional[Dict[str, TenantQuota]] = None):
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 hbm_limit: Optional[int] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_s)
         self.starve_after_s = float(starve_after_s)
+        # per-chip peak-HBM bound the service's reshard traffic is
+        # planned under (PlanService(hbm_limit=)): batch pricing plans
+        # with it so the cost the scheduler orders by is the cost of
+        # the route that will actually dispatch (chunk-synthesized
+        # whale routes price their count xK)
+        self.hbm_limit = int(hbm_limit) if hbm_limit is not None else None
         self.default_quota = default_quota or TenantQuota()
         self.quotas = dict(quotas or {})
         self._lock = threading.Lock()
@@ -255,8 +262,7 @@ class AdmissionQueue:
                      reason=reason, seq=e0.seq)
 
     # -- pricing -----------------------------------------------------------
-    @staticmethod
-    def _batch_cost(batch: Batch) -> int:
+    def _batch_cost(self, batch: Batch) -> int:
         """Bytes-equivalent dispatch cost of the whole batch — the
         mixed-traffic ordering currency (the route-planner score at the
         coalesced ``extra_dims``: ``count * latency_bytes +
@@ -268,12 +274,11 @@ class AdmissionQueue:
         dispatch loop (``take_ready`` is on the service's only
         scheduling path)."""
         try:
-            return AdmissionQueue._batch_cost_inner(batch)
+            return self._batch_cost_inner(batch)
         except Exception:
             return 0
 
-    @staticmethod
-    def _batch_cost_inner(batch: Batch) -> int:
+    def _batch_cost_inner(self, batch: Batch) -> int:
         from ..parallel.transpositions import Auto
 
         B = len(batch.entries)
@@ -295,11 +300,14 @@ class AdmissionQueue:
                                     trusted_drift_hops())
             return int(entry["score_bytes"])
         # reshard: the route planner's own score (drift-corrected,
-        # HBM-bounded), or the priced GSPMD baseline on fallback
+        # HBM-bounded when the service carries a limit — a whale
+        # batch's chunk-synthesized route prices its count xK), or the
+        # priced GSPMD baseline on fallback
         from ..parallel.routing import plan_reshard_route
 
         route = plan_reshard_route(e0.payload.pencil, e0.dest, extra,
-                                   e0.payload.dtype, method=e0.method)
+                                   e0.payload.dtype, method=e0.method,
+                                   hbm_limit=self.hbm_limit)
         if route.use_route and route.score_bytes is not None:
             return int(route.score_bytes)
         return int(route.gspmd_score_bytes or 0)

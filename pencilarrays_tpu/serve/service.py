@@ -71,12 +71,15 @@ from typing import Callable, Dict, List, Optional
 
 from functools import lru_cache
 
-from .errors import ServeError, ServiceClosedError, StaleRequestError
+from .errors import (AdmissionError, ServeError, ServiceClosedError,
+                     StaleRequestError)
 from .queue import AdmissionQueue, Batch, TenantQuota, Ticket, _Entry
 from .registry import PlanRegistry
 
 __all__ = ["PlanService"]
 
+_solo_ids = itertools.count(1)      # per-request coalesce-key suffixes
+# for hbm-bounded reshards (admitted at B=1, served at B=1)
 _service_ids = itertools.count(1)   # dispatch-log attribution tokens:
 # NEVER id(self) — a recycled address would pull a dead service's
 # records into another service's certify(engine=True)
@@ -115,6 +118,18 @@ class PlanService:
         through (default: the process's shared ``"default"`` engine —
         one mesh, ONE ordered dispatch queue, so concurrent services
         and app step loops cannot interleave collective launches).
+    hbm_limit:
+        Per-chip peak-HBM bound (bytes) the service's reshard traffic
+        must fit under.  Whale requests whose every single-shot route
+        busts the bound are no longer rejected: the route planner
+        *synthesizes* a time-sliced chunked route
+        (memory-bounded redistribution, arXiv:2112.01075 — see
+        ``parallel/routing.py``) at admission, and the dispatch
+        executes it.  Only a request for which even maximal chunking
+        finds no admissible route fails, typed
+        (:class:`~pencilarrays_tpu.serve.errors.AdmissionError`,
+        ``reason="hbm-limit"``) at submit — never after queuing.
+        ``None`` (default) keeps admission unbounded.
     """
 
     def __init__(self, *, max_batch: int = 8, max_wait_s: float = 0.002,
@@ -122,12 +137,13 @@ class PlanService:
                  quota: Optional[TenantQuota] = None,
                  quotas: Optional[Dict[str, TenantQuota]] = None,
                  retry=None, registry: Optional[PlanRegistry] = None,
-                 engine=None):
+                 engine=None, hbm_limit: Optional[int] = None):
         self.registry = registry or PlanRegistry()
+        self.hbm_limit = int(hbm_limit) if hbm_limit is not None else None
         self.queue = AdmissionQueue(
             max_batch=max_batch, max_wait_s=max_wait_s,
             starve_after_s=starve_after_s, default_quota=quota,
-            quotas=quotas)
+            quotas=quotas, hbm_limit=self.hbm_limit)
         self.retry = retry
         self._lock = threading.Lock()
         self._named: Dict[str, object] = {}
@@ -257,10 +273,22 @@ class PlanService:
         :class:`PencilArray`, ``extra_dims == ()``) onto pencil
         ``dest`` via the cost-driven route planner (``method`` defaults
         to :class:`~pencilarrays_tpu.parallel.transpositions.Auto`).
-        Same-route submissions coalesce like FFT traffic."""
+        Same-route submissions coalesce like FFT traffic.
+
+        With a service ``hbm_limit``, admission prices the request
+        against the memory-bounded route planner: a whale whose
+        single-shot routes all bust the bound is admitted on its
+        *synthesized* chunked route; only a request with no admissible
+        route at all (even maximally time-sliced) is rejected typed
+        (:class:`~pencilarrays_tpu.serve.errors.AdmissionError`,
+        ``reason="hbm-limit"``).  hbm-bounded reshards dispatch one
+        per batch (no coalescing): a coalesced stack would multiply
+        the un-chunkable footprint floor by B and could bust at
+        dispatch what each request fit at admission."""
+        from .. import obs
         from ..parallel.arrays import PencilArray
         from ..parallel.routing import reshard_key
-        from ..parallel.transpositions import Auto
+        from ..parallel.transpositions import Auto, Gspmd
 
         if not isinstance(u, PencilArray):
             raise ServeError(
@@ -268,7 +296,36 @@ class PlanService:
                 "is defined by where the data currently lives)")
         self._check_payload(u)
         method = method if method is not None else Auto()
+        if self.hbm_limit is not None:
+            from ..parallel.routing import plan_reshard_route
+
+            if isinstance(method, Gspmd):
+                raise ServeError(
+                    "hbm-limited services cannot take method=Gspmd() "
+                    "reshards: the partitioner's peak allocation is "
+                    "unboundable")
+            route = plan_reshard_route(u.pencil, dest, (), u.dtype,
+                                       method=method,
+                                       hbm_limit=self.hbm_limit)
+            if not route.use_route:
+                if obs.enabled():
+                    obs.counter("serve.rejected", tenant=tenant,
+                                reason="hbm-limit").inc()
+                raise AdmissionError(
+                    f"tenant {tenant!r}: no admissible reshard route "
+                    f"under hbm_limit={self.hbm_limit} (even maximal "
+                    f"time-slicing busts the bound)", tenant=tenant,
+                    reason="hbm-limit")
         key = f"reshard:{reshard_key(u.pencil, dest, u.dtype, method)}"
+        if self.hbm_limit is not None:
+            # hbm-bounded reshards never coalesce: stacking B samples
+            # multiplies the un-chunkable ``elems x itemsize`` floor by
+            # B, so a batch of individually-admissible whales could
+            # bust the bound at DISPATCH — violating the "rejected
+            # typed at submit, never after queuing" contract the
+            # admission check above just enforced.  One whale, one
+            # batch (the key stays fingerprint-prefixed for journals)
+            key += f"#solo{next(_solo_ids)}"
         nbytes = (math.prod(u.pencil.size_global())
                   * u.dtype.itemsize)
         ticket = Ticket(tenant, "reshard", key)
@@ -777,7 +834,13 @@ class PlanService:
 
             xs = [self._materialize_reshard(e) for e in entries]
             arr = xs[0] if B == 1 else self._stack(xs)
-            out = reshard(arr, entries[0].dest, method=entries[0].method)
+            # the service's hbm_limit rides the dispatch: a coalesced
+            # whale batch replans at its coalesced extra_dims, so the
+            # synthesized chunking scales with the batch (and a batch
+            # for which nothing fits fails THESE tickets typed — the
+            # isolation contract, not an unbounded dispatch)
+            out = reshard(arr, entries[0].dest, method=entries[0].method,
+                          hbm_limit=self.hbm_limit)
             return self._split(out, B)
         e0 = entries[0]
         plan, direction = e0.plan, e0.direction

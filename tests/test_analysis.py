@@ -535,6 +535,55 @@ def test_lint_thread_spawn_outside_engine(tmp_path):
     assert [f for f in findings if f.check == "thread-spawn"] == []
 
 
+def test_lint_hop_peak_outside_sanctioned_modules(tmp_path):
+    """``_hop_peak_bytes`` references (import, attribute, bare call)
+    anywhere but ``parallel/routing.py``/``analysis/spmd.py`` are
+    findings — the footprint accounting stays ONE function; everyone
+    else bounds through analysis.spmd."""
+    rogue_import = """
+        from ..parallel.routing import _hop_peak_bytes
+
+        def my_own_bound(pin, pout, R):
+            return _hop_peak_bytes(pin, pout, R, (), None)
+        """
+    rogue_attr = """
+        def sneaky(routing, pin, pout):
+            return routing._hop_peak_bytes(pin, pout, None, (), None)
+        """
+    sanctioned = """
+        def _hop_peak_bytes(pin, pout, R, extra, dtype, method=None):
+            return 0
+
+        def edge(pin, pout):
+            return _hop_peak_bytes(pin, pout, 0, (), None)
+        """
+    clean = """
+        def bound(plan, limit):
+            from ..analysis.spmd import step_hop_peak
+
+            return step_hop_peak(plan, ())
+        """
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/ops/rogue_fft.py", rogue_import),
+        ("pencilarrays_tpu/serve/sneak.py", rogue_attr),
+        ("pencilarrays_tpu/parallel/routing.py", sanctioned),
+        ("pencilarrays_tpu/analysis/spmd.py", sanctioned),
+        ("pencilarrays_tpu/io/ok.py", clean)])
+    found = sorted(f.ident for f in lint_tree(root)
+                   if f.check == "hop-peak")
+    # the import AND the call site are each findings (stable idents)
+    assert found == ["ops.rogue_fft.<module>",
+                     "ops.rogue_fft.my_own_bound",
+                     "serve.sneak.sneaky"]
+    allow = _write(root, "pa-lint.allow", """
+        hop-peak ops.rogue_fft.<module>  # migration, tracked
+        hop-peak ops.rogue_fft.my_own_bound  # migration, tracked
+        hop-peak serve.sneak.sneaky  # migration, tracked
+        """)
+    findings, _ = run_lint(root, Allowlist.load(allow))
+    assert [f for f in findings if f.check == "hop-peak"] == []
+
+
 def test_allowlist_roundtrip(tmp_path):
     """Allowlist round-trip: a justified entry suppresses its finding,
     stale entries are reported unused, unjustified/malformed lines are

@@ -677,3 +677,115 @@ def test_spawn_thread_inventory():
     names = spawned()
     assert any(n.startswith("pa-engine-inv-dispatch") for n in names)
     engine.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14 satellite: native step-loop pipelining (models + checkpoint)
+# ---------------------------------------------------------------------------
+
+
+def test_run_steps_async_overlaps_checkpoint_saves(devices, tmp_path):
+    """``run_steps_async`` drives a model step loop through the ordered
+    dispatch queue with host-pool checkpoint serialization: results are
+    bit-identical to the sync loop, every requested checkpoint commits,
+    and the saves ran on the HOST pool (engine stats), not the consumer
+    — no caller-side future plumbing."""
+    from pencilarrays_tpu.models.diffusion import DiffusionSpectral
+    from pencilarrays_tpu.resilience.checkpoint import CheckpointManager
+
+    topo = pa.Topology((2,), devices=devices[:2])
+    model = DiffusionSpectral(topo, (8, 6, 4))
+    rng = np.random.default_rng(7)
+    u0 = pa.PencilArray.from_global(
+        model.plan.input_pencil,
+        rng.standard_normal((8, 6, 4)).astype(np.float32))
+    uh = model.from_physical(u0)
+    engine = Engine("pipe-test")
+    try:
+        ck = CheckpointManager(str(tmp_path / "ck"))
+        before = engine.stats()["host_tasks"]
+        pipe = model.run_async(uh, 0.01, 5, engine=engine,
+                               checkpoint=ck, checkpoint_every=2)
+        final = pipe.result(60)
+        assert len(pipe.saves) == 2
+        assert ck.steps() == [2, 4]
+        assert engine.stats()["host_tasks"] - before >= 2
+        ref = uh
+        for _ in range(5):
+            ref = model.step(ref, 0.01)
+        np.testing.assert_array_equal(
+            np.asarray(pa.gather(final)), np.asarray(pa.gather(ref)))
+        # the serialized state is the step it names: restoring step 2
+        # equals the 2-step sync state
+        restored = ck.restore(2).read("uh", model.plan.output_pencil)
+        ref2 = model.step(model.step(uh, 0.01), 0.01)
+        np.testing.assert_array_equal(
+            np.asarray(pa.gather(restored)),
+            np.asarray(pa.gather(ref2)))
+    finally:
+        engine.close()
+
+
+def test_save_async_runs_on_host_pool(devices, tmp_path):
+    from pencilarrays_tpu.resilience.checkpoint import CheckpointManager
+
+    topo = _topo2(devices)
+    pen = pa.Pencil(topo, (8, 6, 4), (0,))
+    x = pa.PencilArray.from_global(
+        pen, np.arange(192, dtype=np.float32).reshape(8, 6, 4))
+    engine = Engine("save-async")
+    try:
+        ck = CheckpointManager(str(tmp_path / "ck"))
+        fut = ck.save_async(3, {"u": x}, engine=engine)
+        path = fut.result(60)
+        assert path.endswith("step-00000003")
+        assert ck.steps() == [3]
+        assert engine.stats()["host_tasks"] >= 1
+    finally:
+        engine.close()
+
+
+def test_models_step_async_matches_sync(devices):
+    from pencilarrays_tpu.models.spectral import (NavierStokesSpectral,
+                                                  taylor_green)
+
+    topo = pa.Topology((2, 2), devices=devices[:4])
+    model = NavierStokesSpectral(topo, 8)
+    uh = taylor_green(model)
+    engine = Engine("ns-async")
+    try:
+        fut = model.step_async(uh, 1e-3, engine=engine)
+        out = fut.result(120)
+        ref = model.step(uh, 1e-3)
+        np.testing.assert_array_equal(
+            np.asarray(pa.gather(out)), np.asarray(pa.gather(ref)))
+    finally:
+        engine.close()
+
+
+def test_run_steps_async_propagates_step_failure(devices):
+    """A stepper failure at step k must reach the pipeline's final
+    future — later steps refuse to advance the stale state (review
+    finding: the old loop silently returned a short-count state)."""
+    from pencilarrays_tpu.engine import run_steps_async
+
+    calls = {"n": 0}
+
+    class Boom(RuntimeError):
+        pass
+
+    def stepper(s):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise Boom("step 3 dies")
+        return s + 1
+
+    engine = Engine("fail-prop")
+    try:
+        pipe = run_steps_async(stepper, 0, 5, engine=engine)
+        with pytest.raises(Boom):
+            pipe.result(60)
+        # the stepper never advanced past the failure
+        assert calls["n"] == 3
+    finally:
+        engine.close()

@@ -1,0 +1,520 @@
+"""Memory-bounded redistribution synthesis (ISSUE 14).
+
+The tentpole contracts under test:
+
+* **synthesis** — a reshard whose EVERY single-shot route is pruned by
+  ``hbm_limit`` now plans a chunked route (``Pipelined(chunks=K)``
+  edges, verdict ``routed:hbm``) instead of falling back;
+* **bit-identity** — chunked routes equal their unchunked siblings
+  across (2,4)/(4,2)/(2,2) topologies × even/ragged extents × permuted
+  index orders × ``wire_dtype=None|bf16`` (chunking along an
+  exchange-untouched dim commutes with the exchange);
+* **footprint model** — a hand-computed known-optimal case pins the
+  time-sliced accounting (``elems*itemsize + chunk_elems*wire``) and
+  the exact admission boundary: one byte below the chunked footprint
+  and the search is exhausted;
+* **donation pricing** — the pinned-source surcharge: ``donate=True``
+  admits routes that non-donating pricing prunes at the same limit;
+* **verification** — chunk-aware ``analysis.spmd.verify_hbm`` agrees
+  with the planner byte-for-byte, and the compiled chunked chain's
+  collective stats equal the priced schedule (HLO-pinned, count ×K);
+* **end-to-end** — ``reshard(hbm_limit=)`` executes the synthesized
+  route or fails typed; ``PencilFFTPlan(hbm_limit=)`` rewrites its own
+  schedule the same way; ``serve/`` admits a previously-rejected whale
+  request on the synthesized route with tenant isolation intact.
+"""
+
+import numpy as np
+import pytest
+
+from pencilarrays_tpu import (
+    AllToAll,
+    Gspmd,
+    Pencil,
+    PencilArray,
+    PencilFFTPlan,
+    Permutation,
+    Topology,
+    gather,
+    plan_reshard_route,
+    reshard,
+)
+from pencilarrays_tpu.analysis import spmd
+from pencilarrays_tpu.analysis.errors import HbmBoundError
+from pencilarrays_tpu.obs import drift as obs_drift
+from pencilarrays_tpu.parallel.routing import execute_route
+from pencilarrays_tpu.parallel.transpositions import Pipelined
+
+pytestmark = pytest.mark.usefixtures("devices")
+
+
+@pytest.fixture(autouse=True)
+def _clean_drift():
+    obs_drift.drift_tracker.reset()
+    yield
+    obs_drift.drift_tracker.reset()
+
+
+def _ref(shape, dtype=np.float32):
+    n = int(np.prod(shape, dtype=int))
+    return (np.arange(n, dtype=dtype).reshape(shape) + 1.0) / 3.0
+
+
+def _tight_limit(pin, dest, dtype, wire=None):
+    """A limit below the donated unconstrained route's peak — every
+    single-shot edge is inadmissible under it."""
+    method = AllToAll(wire_dtype=wire)
+    un = plan_reshard_route(pin, dest, (), dtype, method=method,
+                            donate=True)
+    assert un.hops
+    return un.peak_hbm_bytes - 1
+
+
+# ---------------------------------------------------------------------------
+# synthesis + bit-identity matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dims", [(2, 4), (4, 2), (2, 2)])
+@pytest.mark.parametrize("shape,perm_in,perm_out", [
+    ((16, 12, 8), None, None),                       # even shards
+    ((13, 10, 9), None, None),                       # ragged everywhere
+    ((16, 12, 8), Permutation(2, 0, 1), Permutation(1, 2, 0)),
+    ((13, 10, 9), Permutation(2, 0, 1), None),       # ragged + permuted
+])
+@pytest.mark.parametrize("wire", [None, "bf16"])
+def test_chunked_route_bit_identity(devices, dims, shape, perm_in,
+                                    perm_out, wire):
+    """Chunked (hbm-synthesized) routes return bit-identical results to
+    the unconstrained route across topologies, raggedness, permuted
+    memory orders and wire formats."""
+    topo = Topology(dims, devices=devices[: int(np.prod(dims))])
+    pin = Pencil(topo, shape, (1, 2), permutation=perm_in)
+    dest = Pencil(topo, shape, (0, 1), permutation=perm_out)
+    method = AllToAll(wire_dtype=wire)
+    un = plan_reshard_route(pin, dest, (), np.float32, method=method,
+                            donate=True)
+    lim = un.peak_hbm_bytes - 1
+    plan = plan_reshard_route(pin, dest, (), np.float32, method=method,
+                              hbm_limit=lim, donate=True)
+    assert plan.use_route and plan.verdict == "routed:hbm"
+    assert plan.peak_hbm_bytes <= lim < un.peak_hbm_bytes
+    assert any(isinstance(h.method, Pipelined) for h in plan.hops), \
+        "a limit below the single-shot peak must force chunking"
+    x = PencilArray.from_global(pin, _ref(shape))
+    out_un = execute_route(x, un)
+    out_ch = execute_route(x, plan)
+    np.testing.assert_array_equal(np.asarray(gather(out_ch)),
+                                  np.asarray(gather(out_un)))
+    # the chunk-aware verifier certifies the same accounting the
+    # planner charged, byte-for-byte
+    assert spmd.verify_hbm(plan, lim) == plan.peak_hbm_bytes
+
+
+def test_chunked_route_hlo_pinned(devices):
+    """The compiled chunked chain's collective stats equal the priced
+    schedule op-for-op — count ×K, bytes unchanged."""
+    topo = Topology((2, 4))
+    pin = Pencil(topo, (16, 12, 8), (1, 2))
+    dest = Pencil(topo, (16, 12, 8), (0, 1))
+    lim = _tight_limit(pin, dest, np.float32)
+    plan = plan_reshard_route(pin, dest, (), np.float32,
+                              method=AllToAll(), hbm_limit=lim,
+                              donate=True)
+    assert any(isinstance(h.method, Pipelined) for h in plan.hops)
+    trace = spmd.verify_route(plan, (), np.float32)
+    # the chunked schedule genuinely multiplies collective launches
+    total = sum(v["count"] for v in trace.stats().values())
+    assert total == sum(v["count"] for h in plan.hops
+                        for v in h.cost.values())
+    assert total > len(plan.hops)
+
+
+# ---------------------------------------------------------------------------
+# hand-computed known-optimal case
+# ---------------------------------------------------------------------------
+
+
+def test_hand_computed_chunked_admission(devices):
+    """(16,12,8) on a (2,4) mesh, (1,2)->(0,1), f32, donate=True.
+
+    Every exchange operand holds 192 elements per chip (e.g. the first
+    hop (1,2)->(0,2) exchanges the (16, 12/2, 8/4) block), so the
+    single-shot footprint is ``192*4 + 192*4 = 1536`` bytes.  The
+    first hop's only chunkable dim is the extent-2 trailing dim ->
+    K=2 is the ONLY admissible slicing, with footprint
+    ``192*4 + 96*4 = 1152``.  Under ``hbm_limit=1535`` only the
+    chunked route exists; at 1151 the search must be exhausted."""
+    topo = Topology((2, 4))
+    pin = Pencil(topo, (16, 12, 8), (1, 2))
+    dest = Pencil(topo, (16, 12, 8), (0, 1))
+
+    un = plan_reshard_route(pin, dest, (), np.float32,
+                            method=AllToAll(), donate=True)
+    assert un.peak_hbm_bytes == 192 * 4 + 192 * 4 == 1536
+
+    plan = plan_reshard_route(pin, dest, (), np.float32,
+                              method=AllToAll(), hbm_limit=1535,
+                              donate=True)
+    assert plan.use_route and plan.verdict == "routed:hbm"
+    assert [h.dest.decomposition for h in plan.hops] == [(0, 2), (0, 1)]
+    assert [h.method.chunks for h in plan.hops] == [2, 2]
+    assert plan.peak_hbm_bytes == 192 * 4 + 96 * 4 == 1152
+    assert all(h.peak_hbm_bytes == 1152 for h in plan.hops)
+
+    # exactly at the chunked footprint: admitted
+    at = plan_reshard_route(pin, dest, (), np.float32,
+                            method=AllToAll(), hbm_limit=1152,
+                            donate=True)
+    assert at.use_route and at.peak_hbm_bytes == 1152
+
+    # one byte below, the 2-hop routes are exhausted (the (1,2)->(0,2)
+    # edge's only chunkable dim has extent 2) and the planner DETOURS:
+    # the 4-hop chain (1,0)->(2,0)->(2,1)->(0,1) trades hops for
+    # deeper-chunkable edges — its worst edge is the final one, whose
+    # chunk dim has extent 3 (192/3 * 1 = 64 elems per slice):
+    # 192*4 + 64*4 = 1024 bytes.  That IS the graph's floor: at 1024
+    # the detour is admitted, at 1023 the search is exhausted.
+    below = plan_reshard_route(pin, dest, (), np.float32,
+                               method=AllToAll(), hbm_limit=1151,
+                               donate=True)
+    assert below.use_route and len(below.hops) == 4
+    assert [h.dest.decomposition for h in below.hops] == [
+        (1, 0), (2, 0), (2, 1), (0, 1)]
+    assert below.peak_hbm_bytes == 192 * 4 + 64 * 4 == 1024
+    floor = plan_reshard_route(pin, dest, (), np.float32,
+                               method=AllToAll(), hbm_limit=1024,
+                               donate=True)
+    assert floor.use_route and floor.peak_hbm_bytes == 1024
+    exhausted = plan_reshard_route(pin, dest, (), np.float32,
+                                   method=AllToAll(), hbm_limit=1023,
+                                   donate=True)
+    assert not exhausted.use_route
+    assert exhausted.verdict == "gspmd:no-route"
+
+    # wire interplay: under 1535 the bf16 edge fits SINGLE-SHOT
+    # (192*4 + 192*2 = 1152 — the PR-13 packed-operand headroom), so
+    # no chunking is synthesized; tighten below that and the in-flight
+    # chunk is charged at its PACKED share (192*4 + 96*2 = 960)
+    wired = plan_reshard_route(pin, dest, (), np.float32,
+                               method=AllToAll(wire_dtype="bf16"),
+                               hbm_limit=1535, donate=True)
+    assert wired.use_route
+    assert wired.peak_hbm_bytes == 192 * 4 + 192 * 2 == 1152
+    assert not any(isinstance(h.method, Pipelined) for h in wired.hops)
+    wired_tight = plan_reshard_route(pin, dest, (), np.float32,
+                                     method=AllToAll(wire_dtype="bf16"),
+                                     hbm_limit=1151, donate=True)
+    assert wired_tight.use_route
+    assert wired_tight.peak_hbm_bytes == 192 * 4 + 96 * 2 == 960
+    assert [h.method.chunks for h in wired_tight.hops] == [2, 2]
+
+
+def test_donation_is_part_of_edge_pricing(devices):
+    """The pinned-source surcharge: a non-donated source block rides
+    every edge's charge, so donate=True admits at limits donate=False
+    prunes — and the static verifier reproduces both accountings."""
+    topo = Topology((2, 4))
+    pin = Pencil(topo, (16, 12, 8), (1, 2))
+    dest = Pencil(topo, (16, 12, 8), (0, 1))
+    S = pin.bytes_per_device((), np.float32)
+    assert S == 192 * 4
+
+    donated = plan_reshard_route(pin, dest, (), np.float32,
+                                 method=AllToAll(), hbm_limit=1152,
+                                 donate=True)
+    assert donated.use_route
+    kept = plan_reshard_route(pin, dest, (), np.float32,
+                              method=AllToAll(), hbm_limit=1152,
+                              donate=False)
+    assert not kept.use_route, \
+        "non-donating pricing must charge the resident source block"
+    # at chunked-footprint + S the non-donating route is admitted, and
+    # its per-hop charge is exactly the donated charge + S
+    kept2 = plan_reshard_route(pin, dest, (), np.float32,
+                               method=AllToAll(), hbm_limit=1152 + S,
+                               donate=False)
+    assert kept2.use_route
+    assert kept2.peak_hbm_bytes == 1152 + S
+    assert spmd.predicted_peak_hbm(kept2)[0] == 1152 + S
+    assert spmd.predicted_peak_hbm(donated)[0] == 1152
+
+
+# ---------------------------------------------------------------------------
+# reshard() end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_hbm_limit_end_to_end(devices):
+    topo = Topology((2, 4))
+    shape = (16, 12, 8)
+    pin = Pencil(topo, shape, (1, 2))
+    dest = Pencil(topo, shape, (0, 1))
+    u = _ref(shape)
+    baseline = np.asarray(gather(reshard(
+        PencilArray.from_global(pin, u), dest, method=Gspmd())))
+
+    # admissible with the pinned-source surcharge: 1152 + 768 = 1920
+    out = reshard(PencilArray.from_global(pin, u), dest, hbm_limit=1920)
+    np.testing.assert_array_equal(np.asarray(gather(out)), baseline)
+
+    # donation buys the surcharge back: 1152 suffices with donate=True
+    out2 = reshard(PencilArray.from_global(pin, u), dest,
+                   hbm_limit=1152, donate=True)
+    np.testing.assert_array_equal(np.asarray(gather(out2)), baseline)
+
+    # below the graph floor (1024 donated): typed pre-flight error,
+    # never an unbounded GSPMD fallback
+    with pytest.raises(HbmBoundError):
+        reshard(PencilArray.from_global(pin, u), dest, hbm_limit=1023,
+                donate=True)
+    # and Gspmd cannot be bounded at all
+    with pytest.raises(ValueError, match="cannot bound"):
+        reshard(PencilArray.from_global(pin, u), dest,
+                method=Gspmd(), hbm_limit=1 << 30)
+
+
+def test_route_plan_journal_carries_chunk_verdict(devices, tmp_path,
+                                                  monkeypatch):
+    """The ``route.plan`` record carries the synthesis verdict: chunk
+    factors, per-hop footprints, the bound and the donation assumption
+    (schema v4) — and lints clean."""
+    from pencilarrays_tpu import obs
+    from pencilarrays_tpu.obs import events as obs_events
+    from pencilarrays_tpu.obs import metrics as obs_metrics
+
+    jdir = str(tmp_path / "obs")
+    monkeypatch.setenv(obs.ENV_VAR, jdir)
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    try:
+        topo = Topology((2, 4))
+        shape = (16, 12, 8)
+        pin = Pencil(topo, shape, (1, 2))
+        dest = Pencil(topo, shape, (0, 1))
+        x = PencilArray.from_global(pin, _ref(shape))
+        reshard(x, dest, hbm_limit=1152, donate=True)
+        events = obs.read_journal(jdir)
+        assert obs.lint_journal(events) == []
+        plans = [e for e in events if e["ev"] == "route.plan"]
+        assert len(plans) == 1
+        e = plans[0]
+        assert e["verdict"] == "routed:hbm"
+        assert e["hbm_limit"] == 1152 and e["donate"] is True
+        assert e["peak_hbm_bytes"] == 1152
+        routed = next(c for c in e["candidates"]
+                      if c["kind"] == "routed")
+        assert routed["chunks"] == [2, 2]
+        assert routed["hop_peak_hbm_bytes"] == [1152, 1152]
+    finally:
+        obs_events._reset_for_tests()
+        obs_metrics.registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# PencilFFTPlan(hbm_limit=)
+# ---------------------------------------------------------------------------
+
+
+def test_fft_plan_hbm_limit_synthesizes_and_stays_bit_identical(devices):
+    topo = Topology((2, 4))
+    shape = (16, 12, 8)
+    plan = PencilFFTPlan(topo, shape, real=True)
+    peak, _ = spmd.predicted_peak_hbm(plan)
+    bounded = PencilFFTPlan(topo, shape, real=True, hbm_limit=peak - 1)
+    bpeak, _ = spmd.predicted_peak_hbm(bounded)
+    assert bpeak <= peak - 1
+    assert spmd.verify_hbm(bounded, peak - 1) == bpeak
+    # at least one hop gained a Pipelined override
+    assert any(len(s) > 4 and isinstance(s[4], Pipelined)
+               for s in bounded._steps if s[0] == "t")
+    # prediction == compiled schedule, both directions, chunking priced
+    spmd.verify_plan(bounded, (), "forward")
+    spmd.verify_plan(bounded, (), "backward")
+    # bit-identity + distinct fingerprints (serve coalescing must never
+    # mix bounded and unbounded executables)
+    u = _ref(shape)
+    a = np.asarray(gather(plan.forward(
+        PencilArray.from_global(plan.input_pencil, u))))
+    b = np.asarray(gather(bounded.forward(
+        PencilArray.from_global(bounded.input_pencil, u))))
+    np.testing.assert_array_equal(a, b)
+    assert plan.plan_key() != bounded.plan_key()
+
+
+def test_fft_plan_hbm_limit_rechunks_fused_hops(devices):
+    topo = Topology((2, 4))
+    shape = (16, 12, 8)
+    plan = PencilFFTPlan(topo, shape, real=True, pipeline=2)
+    peak, _ = spmd.predicted_peak_hbm(plan)
+    bounded = PencilFFTPlan(topo, shape, real=True, pipeline=2,
+                            hbm_limit=peak - 1)
+    assert spmd.predicted_peak_hbm(bounded)[0] <= peak - 1
+    # the fused steps' own bounds grew; schedule still verifies
+    k_before = [len(s[9]) for s in plan._steps if s[0] == "ft"]
+    k_after = [len(s[9]) for s in bounded._steps if s[0] == "ft"]
+    assert k_after and max(k_after) > max(k_before)
+    spmd.verify_plan(bounded, (), "forward")
+    u = _ref(shape)
+    a = np.asarray(gather(plan.forward(
+        PencilArray.from_global(plan.input_pencil, u))))
+    b = np.asarray(gather(bounded.forward(
+        PencilArray.from_global(bounded.input_pencil, u))))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_fft_plan_hbm_limit_impossible_is_typed(devices):
+    topo = Topology((2, 4))
+    with pytest.raises(HbmBoundError, match="hop"):
+        PencilFFTPlan(topo, (16, 12, 8), real=True, hbm_limit=64)
+    with pytest.raises(ValueError, match="hbm_limit"):
+        PencilFFTPlan(topo, (16, 12, 8), real=True, hbm_limit=0)
+
+
+# ---------------------------------------------------------------------------
+# serve: whale admission
+# ---------------------------------------------------------------------------
+
+
+def test_serve_admits_whale_via_synthesized_route(devices):
+    """A reshard whose every single-shot route busts the service's
+    ``hbm_limit`` is admitted on the synthesized chunked route and
+    served correctly — with another tenant's FFT traffic riding the
+    same service untouched (tenant isolation intact)."""
+    from pencilarrays_tpu.serve import PlanService
+
+    topo = Topology((2, 4))
+    shape = (16, 12, 8)
+    pin = Pencil(topo, shape, (1, 2))
+    dest = Pencil(topo, shape, (0, 1))
+    # 1920 = chunked footprint (1152) + pinned source (768): below the
+    # 2304 single-shot charge, so only the synthesized route fits
+    svc = PlanService(max_batch=1, hbm_limit=1920)
+    try:
+        u = _ref(shape)
+        x = PencilArray.from_global(pin, u)
+        t_whale = svc.submit_reshard("whale", x, dest)
+        plan = PencilFFTPlan(topo, shape, real=True)
+        t_small = svc.submit("small", _ref(shape), plan=plan)
+        svc.drain()
+        got = np.asarray(gather(t_whale.result(timeout=60)))
+        ref = np.asarray(gather(reshard(x, dest, method=Gspmd())))
+        np.testing.assert_array_equal(got, ref)
+        # the small tenant's transform is untouched by the whale
+        small = t_small.result(timeout=60)
+        exp = np.asarray(gather(plan.forward(
+            PencilArray.from_global(plan.input_pencil, u))))
+        np.testing.assert_allclose(np.asarray(gather(small)), exp,
+                                   rtol=1e-5, atol=1e-5)
+    finally:
+        svc.close()
+
+
+def test_serve_rejects_infeasible_whale_typed(devices):
+    from pencilarrays_tpu.serve import PlanService
+    from pencilarrays_tpu.serve.errors import AdmissionError
+
+    topo = Topology((2, 4))
+    pin = Pencil(topo, (16, 12, 8), (1, 2))
+    dest = Pencil(topo, (16, 12, 8), (0, 1))
+    # the non-donated graph floor is 1024 + the 768-byte pinned source
+    # = 1792; one byte under it nothing is admissible
+    svc = PlanService(max_batch=1, hbm_limit=1791)
+    try:
+        x = PencilArray.from_global(pin, _ref((16, 12, 8)))
+        with pytest.raises(AdmissionError) as ei:
+            svc.submit_reshard("whale", x, dest)
+        assert ei.value.reason == "hbm-limit"
+        assert svc.queue.depth() == 0   # never entered the queue
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# bench smoke (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_hbm_sweep_smoke(devices):
+    """The benchmark's hbm-limit arm runs end to end on a small config
+    and reports at least one synthesized (chunked) point with a clean
+    verify_hbm verdict and bit-identity."""
+    from benchmarks.reshard_sweep import measure_hbm_sweep
+
+    topo = Topology((2, 4))
+    points = measure_hbm_sweep(topo, (16, 12, 8), k1=2, repeats=1)
+    routed = [p for p in points if p.get("verdict") == "routed:hbm"]
+    assert routed
+    assert all(p["verify_hbm_ok"] for p in routed)
+    assert all(p["bit_identical"] for p in routed)
+    assert all(max(p["chunks"]) > 1 for p in routed)
+    # the sweep terminates at the floor with an exhausted search
+    assert points[-1]["verdict"] in ("gspmd:no-route",) or routed
+
+
+def test_serve_hbm_whales_do_not_coalesce(devices):
+    """Two individually-admissible whales must not stack into one
+    batch whose doubled footprint floor busts the bound at dispatch
+    (review finding): hbm-bounded reshards serve one per batch, and
+    both results are correct."""
+    from pencilarrays_tpu.serve import PlanService
+
+    topo = Topology((2, 4))
+    shape = (16, 12, 8)
+    pin = Pencil(topo, shape, (1, 2))
+    dest = Pencil(topo, shape, (0, 1))
+    svc = PlanService(max_batch=8, max_wait_s=10.0, hbm_limit=1920)
+    try:
+        u1, u2 = _ref(shape), _ref(shape) + 1.0
+        t1 = svc.submit_reshard("a", PencilArray.from_global(pin, u1),
+                                dest)
+        t2 = svc.submit_reshard("b", PencilArray.from_global(pin, u2),
+                                dest)
+        assert t1.key != t2.key     # solo coalesce keys
+        svc.drain()
+        np.testing.assert_array_equal(
+            np.asarray(gather(t1.result(60))), u1)
+        np.testing.assert_array_equal(
+            np.asarray(gather(t2.result(60))), u2)
+        assert svc.stats()["dispatches"] == 2
+    finally:
+        svc.close()
+
+
+def test_reshard_hbm_raise_leaves_no_phantom_dispatch_metric(
+        devices, tmp_path, monkeypatch):
+    """The typed HbmBoundError path dispatches nothing — and must not
+    count a reshard.dispatches{path=gspmd} (review finding)."""
+    from pencilarrays_tpu import obs
+    from pencilarrays_tpu.obs import events as obs_events
+    from pencilarrays_tpu.obs import metrics as obs_metrics
+
+    monkeypatch.setenv(obs.ENV_VAR, str(tmp_path / "obs"))
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    try:
+        topo = Topology((2, 4))
+        pin = Pencil(topo, (16, 12, 8), (1, 2))
+        dest = Pencil(topo, (16, 12, 8), (0, 1))
+        x = PencilArray.from_global(pin, _ref((16, 12, 8)))
+        with pytest.raises(HbmBoundError):
+            reshard(x, dest, hbm_limit=1023, donate=True)
+        snap = obs.snapshot()
+        assert not any(k.startswith("reshard.dispatches")
+                       for k in snap["counters"]), snap["counters"]
+    finally:
+        obs_events._reset_for_tests()
+        obs_metrics.registry.reset()
+
+
+def test_fft_plan_hbm_limit_accepts_numpy_int(devices):
+    topo = Topology((2, 4))
+    plan = PencilFFTPlan(topo, (16, 12, 8), real=True)
+    peak, _ = spmd.predicted_peak_hbm(plan)
+    b = PencilFFTPlan(topo, (16, 12, 8), real=True,
+                      hbm_limit=np.int64(peak - 1))
+    assert b.hbm_limit == peak - 1
+    assert spmd.predicted_peak_hbm(b)[0] <= peak - 1
+    with pytest.raises(ValueError):
+        PencilFFTPlan(topo, (16, 12, 8), real=True, hbm_limit=True)

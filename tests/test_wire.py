@@ -333,30 +333,46 @@ def test_guarded_routed_reshard_with_wire(topo, tmp_path):
 
 
 def test_route_planner_admits_wire_edge_under_hbm_limit(topo):
-    """The ROADMAP claim: reduced-precision edges can fit under an
-    ``hbm_limit`` where full-precision ones were pruned — the packed
-    operand is half the HBM high-water mark's exchange share."""
+    """The ROADMAP claim: reduced-precision edges fit SINGLE-SHOT under
+    an ``hbm_limit`` where full-precision ones do not — the packed
+    operand is half the HBM high-water mark's exchange share.  Since
+    ISSUE 14 the full-precision plan is no longer pruned outright at
+    that limit: the planner *synthesizes* a time-sliced (chunked)
+    route for it instead — the wire's win becomes single-shot
+    admission (count ×1) vs the chunked schedule's count ×K."""
     from pencilarrays_tpu.parallel.routing import plan_reshard_route
+    from pencilarrays_tpu.parallel.transpositions import Pipelined
 
     pin = Pencil(topo, (16, 12, 20), (1, 2))
     dest = Pencil(topo, (16, 12, 20), (0, 1))
+    # donate=True isolates the operand accounting (no pinned-source
+    # surcharge), as the original PR-13 pin did
     full = plan_reshard_route(pin, dest, (), np.float32,
-                              method=AllToAll())
+                              method=AllToAll(), donate=True)
     wired = plan_reshard_route(pin, dest, (), np.float32,
-                               method=AllToAll(wire_dtype="bf16"))
+                               method=AllToAll(wire_dtype="bf16"),
+                               donate=True)
     assert wired.peak_hbm_bytes < full.peak_hbm_bytes
     lim = (full.peak_hbm_bytes + wired.peak_hbm_bytes) // 2
-    pruned = plan_reshard_route(pin, dest, (), np.float32,
-                                method=AllToAll(), hbm_limit=lim)
+    chunked = plan_reshard_route(pin, dest, (), np.float32,
+                                 method=AllToAll(), hbm_limit=lim,
+                                 donate=True)
     admitted = plan_reshard_route(pin, dest, (), np.float32,
                                   method=AllToAll(wire_dtype="bf16"),
-                                  hbm_limit=lim)
-    assert not pruned.use_route           # full precision: no route fits
-    assert admitted.use_route             # the wire edge fits
+                                  hbm_limit=lim, donate=True)
+    # full precision: only a SYNTHESIZED chunked route fits the limit
+    assert chunked.use_route and chunked.verdict == "routed:hbm"
+    assert any(isinstance(h.method, Pipelined) for h in chunked.hops)
+    assert chunked.peak_hbm_bytes <= lim
+    # the wire edge fits single-shot — no chunking, half the bytes
+    assert admitted.use_route
+    assert not any(isinstance(h.method, Pipelined)
+                   for h in admitted.hops)
     assert all(h.method.wire_dtype == "bf16" for h in admitted.hops)
-    # and the fused routed chain's compiled trace matches the per-hop
-    # priced (halved) costs op-for-op
+    # and the fused routed chains' compiled traces match the per-hop
+    # priced costs op-for-op (halved bytes / multiplied counts)
     spmd.verify_route(admitted, (), np.float32)
+    spmd.verify_route(chunked, (), np.float32)
 
 
 # ---------------------------------------------------------------------------
