@@ -741,6 +741,18 @@ def main():
                              "commit the BENCH_ANALYSIS.json artifact)")
     parser.add_argument("--serve-n", type=int, default=16,
                         help="requests per tenant in the serving arm")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="also run the overload-survival arm "
+                             "(benchmarks/autoscale_bench.py): shed "
+                             "precision/recall + protected-tenant p99 "
+                             "under storm vs unloaded, pre-warmed-join "
+                             "compile seconds with vs without the "
+                             "persistent cache, no-SLO disabled path "
+                             "within noise, autoscaler tick cost; "
+                             "writes BENCH_AUTOSCALE.json")
+    parser.add_argument("--autoscale-only", action="store_true",
+                        help="run ONLY the --autoscale arm (used to "
+                             "commit the BENCH_AUTOSCALE.json artifact)")
     parser.add_argument("--engine", action="store_true",
                         help="also run the async-executor arm "
                              "(benchmarks/exec_bench.py): pipelined "
@@ -895,6 +907,33 @@ def main():
                         "n_devices": len(devs)}, "BENCH_SERVE.json",
                        devs=devs)
         if args.serve_only:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+            print(json.dumps(results, indent=1))
+            return
+
+    # -- 17. autoscale: the overload-survival plane (opt-in) ---------------
+    # The ISSUE 15 headline: the shedding gate sacrifices exactly the
+    # sheddable tiers (precision/recall 1.0) while the protected
+    # tenant's p99 stays at its unloaded level; the pre-warmed join is
+    # measurably faster through the persistent compile cache; and the
+    # no-SLO service stays within noise of the PR-10/14 serving path —
+    # committed as BENCH_AUTOSCALE.json.
+    if args.autoscale or args.autoscale_only:
+        import tempfile
+
+        from benchmarks.autoscale_bench import run_autoscale_suite
+        from benchmarks.autoscale_bench import (
+            write_artifact as write_autoscale,
+        )
+
+        with tempfile.TemporaryDirectory() as wd:
+            results["autoscale"] = run_autoscale_suite(devs, workdir=wd)
+        write_autoscale({**results["autoscale"],
+                         "platform": devs[0].platform,
+                         "n_devices": len(devs)},
+                        "BENCH_AUTOSCALE.json", devs=devs)
+        if args.autoscale_only:
             with open(args.out, "w") as f:
                 json.dump(results, f, indent=1)
             print(json.dumps(results, indent=1))

@@ -93,6 +93,7 @@ __all__ = [
     "enable",
     "disable",
     "agree_membership",
+    "pending_join_slots",
     "reform",
     "request_join",
     "register_plan",
@@ -200,6 +201,17 @@ def _base_ns(ns: str) -> str:
     Join requests and reform rounds live under the BASE namespace, so a
     joiner needs no knowledge of the current generation."""
     return ns.split(".g", 1)[0]
+
+
+def pending_join_slots(kv, namespace: str = "pa") -> List[str]:
+    """Join slots currently waiting under the base namespace — the
+    ``request_join`` queue the next reformation admits.  THE one
+    parser of the ``<base>/join/s<slot>`` key shape (the membership
+    round and the autoscaler's scale-up probe must never disagree
+    about what a pending joiner looks like)."""
+    base = _base_ns(namespace)
+    return sorted(k.rsplit("/", 1)[1][1:]
+                  for k in kv.list_dir(f"{base}/join"))
 
 
 def _gen_of(ns: str) -> int:
@@ -369,8 +381,7 @@ def agree_membership(coord, *, reason: str = "reform",
     for _ in range(rounds):
         gen += 1
         prefix = f"{base}/reform/g{gen:06d}"
-        pending = sorted(kv.list_dir(f"{base}/join"))
-        my_joiners = sorted(k.rsplit("/", 1)[1][1:] for k in pending)
+        my_joiners = pending_join_slots(kv, base)
         view = {"rank": coord.rank, "live": sorted(live),
                 "joiners": my_joiners, "epoch": _epoch.current(),
                 "reason": reason}
@@ -602,19 +613,10 @@ def reform(coordinator=None, *, reason: str = "reform",
                 _plans[name] = factory(ctx)
             if rebuild is not None:
                 rebuild(ctx)
-            # -- engine reform: the reindexed coordinator gets fresh
-            # engines — queued dispatches (compiled for the dead mesh)
-            # fail typed EngineReformedError, timers drop, a fresh
-            # RuntimeConfig snapshot is taken, and a new generation of
-            # consumer/pool threads starts on demand.  Admission-queued
-            # serve requests are untouched: they re-bind to the plans
-            # the factories above just rebuilt.
-            reformed_engines = _engine.reform_all()
             timings["replan_s"] = time.monotonic() - t0
             _journal_reform("replan", m.gen, rank=m.new_rank,
                             plans=sorted(n for n, _ in factories),
-                            dropped_executables=dropped,
-                            engines=reformed_engines)
+                            dropped_executables=dropped)
 
             # -- restore: the agreed step, across the changed world
             restored: Optional[int] = None
@@ -637,6 +639,27 @@ def reform(coordinator=None, *, reason: str = "reform",
                 timings["restore_s"] = time.monotonic() - t0
                 _journal_reform("restore", m.gen, rank=m.new_rank,
                                 step=restored)
+
+            # -- engine reform: ONLY after the restore rung committed —
+            # the quiesce site above HELD every queued dispatch with
+            # the promise that a failed reformation resumes them
+            # untouched, and the restore rung is the last stage that
+            # can fail.  Reforming here keeps that promise: on success
+            # the reindexed coordinator gets fresh engines (held
+            # dispatches fail typed EngineReformedError — the programs
+            # they would issue target the dead mesh — timers drop, a
+            # fresh RuntimeConfig snapshot is taken, a new generation
+            # of consumer/pool threads starts on demand); on a
+            # restore-stage failure the old mesh resumes with its held
+            # queue intact (drill-pinned: a held dispatch survives the
+            # failed reformation and executes on resume).
+            # Admission-queued serve requests are untouched either
+            # way: they re-bind to the plans the factories rebuilt.
+            t0 = time.monotonic()
+            reformed_engines = _engine.reform_all()
+            timings["engine_s"] = time.monotonic() - t0
+            _journal_reform("engine", m.gen, rank=m.new_rank,
+                            engines=reformed_engines)
         # success: only NOW retire the old coordinator — until here it
         # kept heartbeating, so a FAILED reformation leaves the caller
         # with a live coordinator (and cluster.coordinator()'s cache

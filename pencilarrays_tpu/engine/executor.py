@@ -247,6 +247,20 @@ class Engine:
         with self._cv:
             return len(self._tasks) + (1 if self._busy else 0)
 
+    def on_consumer_thread(self) -> bool:
+        """True when the calling thread is (or WAS) one of this
+        engine's dispatch consumers — the reentrancy probe: an
+        in-flight task that needs to quiesce/reform its own engine (the
+        serve layer's ``elastic_step`` reforming mid-batch) must not
+        deadlock waiting for itself, and its clients must not resubmit
+        work that would dispatch concurrently with it.  Checked via a
+        marker stamped on the thread itself, NOT ``_dispatch_thread``:
+        ``reform()`` nulls that slot mid-reform, and a retired
+        generation's consumer finishing its interrupted task is still
+        "the consumer" for concurrency purposes."""
+        return getattr(threading.current_thread(),
+                       "_pa_engine_consumer", None) is self
+
     def dispatch_log(self) -> List[DispatchRecord]:
         """Issue-ordered dispatch records — a BOUNDED history (the last
         ``log_capacity`` dispatches; check :meth:`stats`'s
@@ -401,6 +415,16 @@ class Engine:
         with self._cv:
             self._paused = True
             self._cv.notify_all()
+            if getattr(threading.current_thread(),
+                       "_pa_engine_consumer", None) is self:
+                # the consumer quiescing itself: the busy flag it would
+                # wait on is its OWN in-flight task (an elastic_step
+                # reforming the mesh from inside a dispatch).  That
+                # task is, by construction, not mid-device-program — it
+                # is in the recovery ladder — so there is nothing to
+                # wait out, and waiting would burn the full timeout
+                # against ourselves
+                return True
             while self._busy:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -517,6 +541,10 @@ class Engine:
             self._dispatch_thread = spawn_thread(
                 self._loop_dispatch, args=(gen,),
                 name=f"pa-engine-{self.name}-dispatch-g{gen}")
+            # the on_consumer_thread marker: survives reform() nulling
+            # _dispatch_thread (the retired consumer may still be
+            # finishing an interrupted task)
+            self._dispatch_thread._pa_engine_consumer = self
         self._host_threads = [t for t in self._host_threads
                               if t.is_alive()]
         want = self._workers

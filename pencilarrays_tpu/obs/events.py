@@ -103,6 +103,11 @@ CRITICAL_EVENTS = frozenset({
     # a flagged straggler gates a scheduling/ops decision and the
     # flagging rank may be about to act on it
     "cluster.straggler",
+    # the overload-survival plane: an SLO breach, a shedding-gate
+    # transition and a scale decision each gate client-visible
+    # behavior (failures, capacity moves) — the record must survive
+    # the crash that often follows the overload that caused it
+    "serve.slo_violation", "serve.pressure", "serve.scale",
 })
 
 _lock = threading.Lock()

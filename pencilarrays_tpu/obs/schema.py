@@ -101,6 +101,14 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     "serve.coalesce": ("key", "n", "reqs", "reason", "wait_s"),
     "serve.dispatch": ("key", "n", "tenants", "score_bytes", "reason"),
     "serve.complete": ("tenant", "req", "outcome", "seconds", "key"),
+    # the overload-survival plane (serve/slo.py, shed.py, autoscale.py):
+    # a completion that busted its tenant's SLO deadline (the answer
+    # was returned, the violation is on the record — fsync-critical),
+    # a pressure-gate state transition with the projection that drove
+    # it, and an autoscaler grow/shrink decision with its inputs
+    "serve.slo_violation": ("tenant", "req", "deadline_s", "late_s"),
+    "serve.pressure": ("state", "prev", "drain_s"),
+    "serve.scale": ("direction", "reason", "projection"),
     # per-mesh task-graph executor (engine/): one record per engine
     # reformation boundary (queued dispatches dropped typed, fresh
     # RuntimeConfig snapshot, new generation)

@@ -332,6 +332,8 @@ _TRACK_OF = {
     "cluster.reform": "cluster", "cluster.member": "cluster",
     "serve.request": "serve", "serve.coalesce": "serve",
     "serve.dispatch": "serve", "serve.complete": "serve",
+    "serve.slo_violation": "serve", "serve.pressure": "serve",
+    "serve.scale": "serve",
 }
 
 # events exported as complete ("X") spans: payload field holding the
@@ -392,6 +394,25 @@ def _span_name(e: dict) -> str:
     if ev == "serve.complete":
         return (f"serve {e.get('tenant', '?')}#{e.get('req', '?')}:"
                 f"{e.get('outcome', '?')}")
+    if ev == "serve.slo_violation":
+        late = e.get("late_s")
+        suffix = (f" late={late:.3f}s"
+                  if isinstance(late, (int, float)) else "")
+        return (f"SLO-VIOLATION {e.get('tenant', '?')}"
+                f"#{e.get('req', '?')}{suffix}")
+    if ev == "serve.pressure":
+        d = e.get("drain_s")
+        drain = f" drain={d:.3f}s" if isinstance(d, (int, float)) else ""
+        return (f"pressure {e.get('prev', '?')}->"
+                f"{e.get('state', '?')}{drain}")
+    if ev == "serve.scale":
+        # the autoscaler's verdict, with whether capacity actually
+        # moved — the projection inputs ride the record's args
+        acted = "" if e.get("acted") else " (signal)"
+        det = e.get("detail")
+        return (f"scale {e.get('direction', '?')} "
+                f"[{e.get('reason', '?')}]"
+                f"{f' {det}' if det else ''}{acted}")
     return ev
 
 
@@ -517,7 +538,11 @@ def render(tl: MergedTimeline, *, max_groups: int = 200) -> str:
                           "guard.recover", "cluster.verdict",
                           "cluster.straggler", "guard.epoch",
                           "guard.bundle", "retry",
-                          "cluster.reform", "cluster.member"):
+                          "cluster.reform", "cluster.member",
+                          # the overload plane's decisions gate
+                          # client-visible behavior: spell them out
+                          "serve.slo_violation", "serve.pressure",
+                          "serve.scale"):
                     loud.append(_span_name(e))
                 elif (ev == "plan.build"
                       and isinstance(e.get("decomposition"), dict)

@@ -24,6 +24,11 @@ Registered points (see ``docs/Resilience.md``):
 ``hop.exchange``          an eager transpose / routed-reshard dispatch
                           (``corrupt`` pokes the hop's output — the SDC
                           drill the ``guard`` probes must catch)
+``serve.submit``          the plan service's admission boundary (every
+                          ``submit``/``submit_reshard``, before quota/
+                          SLO checks — ``error`` fails THIS submitter
+                          typed, ``delay`` drags admission: the
+                          overload and flaky-client drills)
 ========================  ====================================================
 
 Rules are **counter-based, never random** — the same spec replays the
@@ -104,6 +109,7 @@ POINTS = frozenset({
     "dist.initialize",
     "barrier",
     "hop.exchange",
+    "serve.submit",
 })
 
 MODES = frozenset({"error", "kill", "torn", "corrupt", "delay"})

@@ -1,4 +1,4 @@
-"""serve/ — the multi-tenant plan service (PR 10).
+"""serve/ — the multi-tenant plan service (PR 10, overload plane PR 15).
 
 The transpose engine, the batched plan layer, the guard's recovery
 ladder and the obs plane all exist to be *used* — this package is the
@@ -12,16 +12,25 @@ logical tenants, executed on one resident mesh.
   deterministic across processes and restarts);
 * :class:`AdmissionQueue` / :class:`TenantQuota` / :class:`Ticket` —
   the scheduling core and the client-side future;
+* the overload-survival plane: :class:`SLO` (per-tenant deadlines +
+  shed priorities, enforced at admission/take/completion),
+  :class:`PressurePolicy` + the hysteretic load-shedding gate
+  (``serve/shed.py``), and the :class:`Autoscaler` closing the
+  serve↔elastic loop (grow/shrink the mesh from the queue's own load
+  projection — ``serve/autoscale.py``);
 * typed errors: :class:`ServeError`, :class:`AdmissionError`,
-  :class:`StaleRequestError`, :class:`ServiceClosedError`.
+  :class:`DeadlineError`, :class:`StaleRequestError`,
+  :class:`ServiceClosedError`.
 
 Everything here is plain Python over the public plan APIs: importing
 the package is cheap (jax is only touched when a request dispatches),
 and a process that never serves pays nothing.
 """
 
+from .autoscale import Autoscaler, AutoscalePolicy, ScaleDecision  # noqa: F401
 from .errors import (  # noqa: F401
     AdmissionError,
+    DeadlineError,
     ServeError,
     ServiceClosedError,
     StaleRequestError,
@@ -29,6 +38,8 @@ from .errors import (  # noqa: F401
 from .queue import AdmissionQueue, Batch, TenantQuota, Ticket  # noqa: F401
 from .registry import PlanRegistry  # noqa: F401
 from .service import PlanService  # noqa: F401
+from .shed import PressureGate, PressurePolicy  # noqa: F401
+from .slo import SLO, LoadTracker  # noqa: F401
 
 __all__ = [
     "PlanService",
@@ -37,8 +48,16 @@ __all__ = [
     "TenantQuota",
     "Ticket",
     "Batch",
+    "SLO",
+    "LoadTracker",
+    "PressurePolicy",
+    "PressureGate",
+    "Autoscaler",
+    "AutoscalePolicy",
+    "ScaleDecision",
     "ServeError",
     "AdmissionError",
+    "DeadlineError",
     "StaleRequestError",
     "ServiceClosedError",
 ]

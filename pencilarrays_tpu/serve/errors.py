@@ -9,8 +9,8 @@ other tenant's future.
 
 from __future__ import annotations
 
-__all__ = ["ServeError", "AdmissionError", "StaleRequestError",
-           "ServiceClosedError"]
+__all__ = ["ServeError", "AdmissionError", "DeadlineError",
+           "StaleRequestError", "ServiceClosedError"]
 
 
 class ServeError(RuntimeError):
@@ -21,18 +21,51 @@ class AdmissionError(ServeError):
     """A tenant's request was rejected at admission (quota exceeded).
 
     Carries ``tenant`` and ``reason`` (``"queue-depth"``,
-    ``"inflight-bytes"``, or ``"hbm-limit"`` — a whale reshard for
+    ``"inflight-bytes"``, ``"hbm-limit"`` — a whale reshard for
     which even the chunk-synthesized route planner found no admissible
-    route under the service's per-chip peak-HBM bound) so a client can
+    route under the service's per-chip peak-HBM bound — or ``"shed"``:
+    the overload gate sacrificed this sheddable-priority request, at
+    submit or by evicting it from the queue, see
+    :mod:`~pencilarrays_tpu.serve.shed`) so a client can
     distinguish back-off from a bug.  Admission rejections never enter
     the queue: they cost the service one counter bump and the caller
-    one typed exception.
+    one typed exception.  The one exception is ``reason="shed"`` on an
+    *evicted* request, which WAS queued — its ticket fails typed with
+    this error instead of ever dispatching.
     """
 
     def __init__(self, msg: str, *, tenant: str, reason: str):
         super().__init__(msg)
         self.tenant = tenant
         self.reason = reason
+
+
+class DeadlineError(ServeError):
+    """A request cannot (or could not) meet its tenant's SLO deadline
+    (:class:`~pencilarrays_tpu.serve.slo.SLO`).
+
+    ``reason`` says which enforcement point fired:
+
+    * ``"projected"`` — at admission: the queue's own load projection
+      (measured service rate over the priced cost queued ahead) says
+      the request would complete after its deadline, so it is rejected
+      up front — never a silent late answer;
+    * ``"expired"`` — at take: the request's deadline passed while it
+      sat in the queue; it is shed before dispatch (its ticket fails
+      with this error) instead of burning mesh time on an answer
+      nobody can use.
+
+    Carries ``tenant``, ``reason``, ``deadline_s`` (the tenant's
+    budget) and ``projected_s`` (the projection that condemned it;
+    ``None`` on the expired path)."""
+
+    def __init__(self, msg: str, *, tenant: str, reason: str,
+                 deadline_s: float, projected_s=None):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.reason = reason
+        self.deadline_s = deadline_s
+        self.projected_s = projected_s
 
 
 class StaleRequestError(ServeError):

@@ -35,6 +35,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from .errors import AdmissionError, ServiceClosedError
+from .slo import LoadTracker
 
 __all__ = ["Ticket", "TenantQuota", "Batch", "AdmissionQueue"]
 
@@ -110,6 +111,9 @@ class _Entry:
     dest: object = None           # reshard destination Pencil
     method: object = None         # reshard method
     seq: int = 0                  # admission order (deterministic ties)
+    deadline: Optional[float] = None  # absolute monotonic SLO deadline
+    shed_priority: int = 0        # the tenant's SLO shed tier
+    cost_bytes: int = 0           # priced B=1 cost (projection currency)
 
 
 @dataclass
@@ -122,6 +126,9 @@ class Batch:
     reason: str                   # "full" | "deadline" | "flush"
     cost: int = 0                 # bytes-equivalent score (set by queue)
     seq: int = 0                  # first entry's admission order
+    resubmits: int = 0            # engine-reformation resubmission count
+    # (a taken batch dropped typed by Engine.reform re-enters the
+    # reformed engine instead of stranding its tickets — bounded)
 
     @property
     def tickets(self) -> List[Ticket]:
@@ -159,6 +166,16 @@ class AdmissionQueue:
         # completed (queued + executing)
         self._tenant_requests: Dict[str, int] = {}
         self._tenant_bytes: Dict[str, int] = {}
+        # the queue's own arrival/cost/service history — THE load
+        # projection admission deadlines, the shedding gate and the
+        # autoscaler all read (serve/slo.py)
+        self.load = LoadTracker()
+        # per-coalesce-key B=1 price cache (the projection currency is
+        # priced once per distinct traffic shape, not once per request)
+        self._key_cost: Dict[str, int] = {}
+        # entries shed at the take point (SLO deadline expired while
+        # queued) — the service pops these and fails their tickets typed
+        self._expired: List[_Entry] = []
 
     # -- admission ---------------------------------------------------------
     def quota_for(self, tenant: str) -> TenantQuota:
@@ -196,6 +213,7 @@ class AdmissionQueue:
             self._tenant_bytes[t] = b + entry.nbytes
             group = self._pending.setdefault(entry.ticket.key, [])
             group.append(entry)
+            self.load.note_arrival(entry.cost_bytes)
             return len(group) >= self.max_batch
 
     def close_gate(self) -> None:
@@ -225,12 +243,27 @@ class AdmissionQueue:
         final batch of a drain).  Ordering: starved batches first (in
         admission order), then ascending priced cost, admission order
         breaking ties — deterministic for identical submission
-        sequences regardless of wall clocks."""
+        sequences regardless of wall clocks.
+
+        SLO take-point enforcement: entries whose deadline expired
+        while queued are shed BEFORE batch formation (an expired
+        request must not burn mesh time that makes its neighbors late
+        too) — the service pops them via :meth:`pop_expired` and fails
+        their tickets typed ``DeadlineError(reason="expired")``."""
         now = time.monotonic() if now is None else now
         out: List[Batch] = []
         with self._lock:
             for key in list(self._pending):
                 entries = self._pending[key]
+                live = [e for e in entries
+                        if e.deadline is None or now <= e.deadline]
+                if len(live) != len(entries):
+                    for e in entries:
+                        if e.deadline is not None and now > e.deadline:
+                            self._expired.append(e)
+                            self.load.note_removed(e.cost_bytes)
+                    entries = live
+                    self._pending[key] = entries
                 while len(entries) >= self.max_batch:
                     take, entries = (entries[: self.max_batch],
                                      entries[self.max_batch:])
@@ -245,6 +278,8 @@ class AdmissionQueue:
                     del self._pending[key]
         for b in out:
             b.cost = self._batch_cost(b)
+            for e in b.entries:
+                self.load.note_taken(e.cost_bytes)
 
         def order(b: Batch):
             starved = (now - b.entries[0].ticket.t_submit
@@ -260,6 +295,74 @@ class AdmissionQueue:
         kind = "reshard" if e0.plan is None else "fft"
         return Batch(key=key, kind=kind, entries=list(entries),
                      reason=reason, seq=e0.seq)
+
+    def pop_expired(self) -> List[_Entry]:
+        """Entries shed at the take point since the last pop (admission
+        order) — the service fails their tickets typed."""
+        with self._lock:
+            out, self._expired = self._expired, []
+        out.sort(key=lambda e: e.seq)
+        return out
+
+    def evict_sheddable(self, protected_priority: int) -> List[_Entry]:
+        """The pressure gate's second rung: remove every queued entry
+        whose ``shed_priority`` is strictly below the protected tier
+        and return them in admission-sequence order — deterministic in
+        the submission sequence (identical submissions evict identical
+        sets; the clock only gates WHEN the rung fires).  The service
+        fails their tickets typed ``AdmissionError(reason="shed")``."""
+        evicted: List[_Entry] = []
+        with self._lock:
+            for key in list(self._pending):
+                entries = self._pending[key]
+                keep = [e for e in entries
+                        if e.shed_priority >= protected_priority]
+                if len(keep) != len(entries):
+                    for e in entries:
+                        if e.shed_priority < protected_priority:
+                            evicted.append(e)
+                            self.load.note_removed(e.cost_bytes)
+                    if keep:
+                        self._pending[key] = keep
+                    else:
+                        del self._pending[key]
+        evicted.sort(key=lambda e: e.seq)
+        return evicted
+
+    def note_batch_done(self, batch: Batch, execute_s: float) -> None:
+        """Feed one finished dispatch into the load tracker (ok or
+        failed — the wall time was equally real either way)."""
+        cost = sum(e.cost_bytes for e in batch.entries)
+        self.load.note_completed(cost, len(batch.entries), execute_s)
+
+    def note_entry_done(self, entry: _Entry) -> None:
+        """Clear ONE taken entry's in-flight accounting without a rate
+        sample (a validation loser fails before any device time is
+        spent; leaving its cost in flight would inflate every drain
+        projection forever)."""
+        self.load.note_completed(entry.cost_bytes, 1, 0.0)
+
+    def entry_cost(self, entry: _Entry) -> int:
+        """Price one request in the projection currency (the B=1 batch
+        score), cached per coalesce key — hbm-bounded solo reshards
+        share their fingerprint prefix's price.  Traffic the router
+        prices at zero (a single-device mesh moves no wire bytes)
+        falls back to the logical payload bytes: the PROJECTION must
+        stay meaningful on any mesh, while dispatch ordering keeps the
+        router score untouched (zero-cost batches still tie
+        head-of-line there)."""
+        key = entry.ticket.key.split("#solo", 1)[0]
+        with self._lock:
+            cached = self._key_cost.get(key)
+        if cached is not None:
+            return cached
+        cost = self._batch_cost(self._mk_batch(
+            entry.ticket.key, [entry], "price"))
+        if cost <= 0:
+            cost = max(1, entry.nbytes)
+        with self._lock:
+            self._key_cost[key] = cost
+        return cost
 
     # -- pricing -----------------------------------------------------------
     def _batch_cost(self, batch: Batch) -> int:
@@ -319,14 +422,21 @@ class AdmissionQueue:
         deadline (0.0 when already due; None when nothing is
         pending) — the streaming pump re-arms at this instead of a
         fresh full ``max_wait_s``, so a group admitted just after a
-        tick never waits ~2x its deadline."""
+        tick never waits ~2x its deadline.  SLO deadlines feed the
+        same bound (the deadline-aware pump tick): a queued entry
+        about to expire wakes the pump so the take-point shed fails
+        its ticket promptly instead of after a full coalescing wait."""
         now = time.monotonic() if now is None else now
         with self._lock:
             if not self._pending:
                 return None
-            oldest = min(v[0].ticket.t_submit
-                         for v in self._pending.values() if v)
-        return max(0.0, oldest + self.max_wait_s - now)
+            due = min(v[0].ticket.t_submit + self.max_wait_s
+                      for v in self._pending.values() if v)
+            slo = [e.deadline for v in self._pending.values()
+                   for e in v if e.deadline is not None]
+            if slo:
+                due = min(due, min(slo))
+        return max(0.0, due - now)
 
     def depth(self, tenant: Optional[str] = None) -> int:
         with self._lock:

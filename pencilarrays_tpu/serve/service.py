@@ -71,10 +71,12 @@ from typing import Callable, Dict, List, Optional
 
 from functools import lru_cache
 
-from .errors import (AdmissionError, ServeError, ServiceClosedError,
-                     StaleRequestError)
+from .errors import (AdmissionError, DeadlineError, ServeError,
+                     ServiceClosedError, StaleRequestError)
 from .queue import AdmissionQueue, Batch, TenantQuota, Ticket, _Entry
 from .registry import PlanRegistry
+from .shed import PressureGate, PressurePolicy
+from .slo import SLO
 
 __all__ = ["PlanService"]
 
@@ -130,6 +132,22 @@ class PlanService:
         (:class:`~pencilarrays_tpu.serve.errors.AdmissionError`,
         ``reason="hbm-limit"``) at submit — never after queuing.
         ``None`` (default) keeps admission unbounded.
+    slos:
+        Per-tenant :class:`~pencilarrays_tpu.serve.slo.SLO` objectives
+        (also settable later via :meth:`set_slo`).  A tenant with a
+        ``deadline_s`` gets all three enforcement points (admission
+        projection, take-point expiry shed, completion violation
+        journaling — ``docs/Serving.md``); ``shed_priority`` orders the
+        overload gate's sacrifices.  With no SLOs and no ``pressure``
+        policy the service behaves exactly as before (the disabled
+        path: no per-request pricing, no projections —
+        ``BENCH_AUTOSCALE.json`` pins it within noise of PR-10/14
+        serving).
+    pressure:
+        A :class:`~pencilarrays_tpu.serve.shed.PressurePolicy` arming
+        the load-shedding gate (water marks on the projected queue
+        drain time).  ``None`` (default): no shedding, PR-10 admission
+        semantics.
     """
 
     def __init__(self, *, max_batch: int = 8, max_wait_s: float = 0.002,
@@ -137,7 +155,9 @@ class PlanService:
                  quota: Optional[TenantQuota] = None,
                  quotas: Optional[Dict[str, TenantQuota]] = None,
                  retry=None, registry: Optional[PlanRegistry] = None,
-                 engine=None, hbm_limit: Optional[int] = None):
+                 engine=None, hbm_limit: Optional[int] = None,
+                 slos: Optional[Dict[str, SLO]] = None,
+                 pressure: Optional[PressurePolicy] = None):
         self.registry = registry or PlanRegistry()
         self.hbm_limit = int(hbm_limit) if hbm_limit is not None else None
         self.queue = AdmissionQueue(
@@ -149,6 +169,27 @@ class PlanService:
         self._named: Dict[str, object] = {}
         self._elastic_names: set = set()
         self._closed = False
+        self._slos: Dict[str, SLO] = dict(slos or {})
+        for t, s in self._slos.items():
+            if not isinstance(s, SLO):
+                raise TypeError(f"slos[{t!r}] is not an SLO: {s!r}")
+        self._gate = PressureGate(pressure) if pressure is not None \
+            else None
+        self._force_priced = False      # ensure_priced(): an attached
+        # Autoscaler needs the projection even with no SLOs/gate
+        self._protected = max(
+            (s.shed_priority for s in self._slos.values()), default=0)
+        # batches taken from the queue but not yet finished: an elastic
+        # rebind must re-point THESE plan references too (a reformation
+        # can interrupt a batch mid-dispatch and rerun it)
+        self._inflight: List[Batch] = []
+        # batches dropped typed by an engine reformation, awaiting
+        # resubmission onto the reformed engine — flushed only from
+        # safe points (a finished dispatch, an explicit step/drain, the
+        # engine's own post-reform hook off the consumer thread), so a
+        # resubmitted batch can never dispatch concurrently with an
+        # in-flight one (see _park_or_finish)
+        self._parked: List[Batch] = []
         self._sid = next(_service_ids)
         self._engine_obj = engine
         self._streaming = False
@@ -167,6 +208,7 @@ class PlanService:
         # accumulates dead services' hooks
         self._dispatches = 0
         self._completed: Dict[str, int] = {}
+        self._slo_violations = 0
 
     def engine(self):
         """The engine this service dispatches through (the explicit
@@ -216,9 +258,15 @@ class PlanService:
         # name= submissions: a plan= submission resolves to the same
         # canonical object (registry dedupe) and shares the coalesce
         # key, so leaving it on the dead-mesh plan would poison the
-        # whole post-reform batch
+        # whole post-reform batch.  In-flight and reformation-parked
+        # batches re-bind too: an elastic reformation can interrupt a
+        # batch mid-dispatch and rerun it (elastic_step's reform rung),
+        # and the rerun must execute on the rebuilt plan
         key = plan.plan_key()
-        for e in self.queue.pending_entries():
+        with self._lock:
+            taken = [e for b in self._inflight for e in b.entries] + \
+                    [e for b in self._parked for e in b.entries]
+        for e in self.queue.pending_entries() + taken:
             if e.plan is not None and (
                     e.plan_name == name or e.plan.plan_key() == key):
                 e.plan = plan
@@ -227,6 +275,48 @@ class PlanService:
         """The current plan registered under ``name`` (post-reform this
         is the rebuilt one)."""
         return self._named.get(name)
+
+    # -- SLOs + the load projection ----------------------------------------
+    def set_slo(self, tenant: str, slo: SLO) -> None:
+        """Attach (or replace) one tenant's
+        :class:`~pencilarrays_tpu.serve.slo.SLO` — deadlines enforce
+        from the next submission on."""
+        if not isinstance(slo, SLO):
+            raise TypeError(f"set_slo needs an SLO, got {slo!r}")
+        with self._lock:
+            self._slos[tenant] = slo
+            self._protected = max(
+                s.shed_priority for s in self._slos.values())
+
+    def slo(self, tenant: str) -> Optional[SLO]:
+        return self._slos.get(tenant)
+
+    @property
+    def _slo_armed(self) -> bool:
+        """Any SLO, a pressure policy, or :meth:`ensure_priced` arms
+        the projection machinery; without them, submissions skip
+        pricing entirely (the disabled path — PR-10 behavior and
+        overhead, bit-for-bit)."""
+        return (bool(self._slos) or self._gate is not None
+                or self._force_priced)
+
+    def ensure_priced(self) -> None:
+        """Arm request pricing + the load projection even with no SLOs
+        and no pressure gate — the :class:`~pencilarrays_tpu.serve.
+        autoscale.Autoscaler` calls this at attach: a controller
+        watching a projection that is never fed would be permanently
+        blind to overload (it could scale down but never up)."""
+        self._force_priced = True
+
+    def load_projection(self) -> dict:
+        """The queue's live load projection (serve/slo.py snapshot plus
+        the gate state) — what the shedding gate and the autoscaler
+        read, exposed for operators and the bench."""
+        snap = self.queue.load.snapshot()
+        snap["queue_depth"] = self.queue.depth()
+        snap["pressure"] = (self._gate.state if self._gate is not None
+                            else None)
+        return snap
 
     # -- submission --------------------------------------------------------
     def submit(self, tenant: str, u, *, plan=None, name: Optional[str] = None,
@@ -264,6 +354,7 @@ class PlanService:
         ticket = Ticket(tenant, "fft", key)
         entry = _Entry(ticket=ticket, plan=plan, direction=direction,
                        payload=u, nbytes=nbytes, plan_name=plan_name)
+        self._stamp_slo(entry)
         self._admit(entry, direction=direction)
         return ticket
 
@@ -332,8 +423,19 @@ class PlanService:
         entry = _Entry(ticket=ticket, plan=None, direction="forward",
                        payload=u, nbytes=nbytes, plan_name=None,
                        dest=dest, method=method)
+        self._stamp_slo(entry)
         self._admit(entry)
         return ticket
+
+    def _stamp_slo(self, entry: _Entry) -> None:
+        slo = self._slos.get(entry.ticket.tenant)
+        if slo is None:
+            return
+        entry.shed_priority = slo.shed_priority
+        if slo.deadline_s is not None:
+            # the admission-time deadline every later enforcement point
+            # (take shed, completion accounting) measures against
+            entry.deadline = entry.ticket.t_submit + slo.deadline_s
 
     @staticmethod
     def _check_payload(u) -> None:
@@ -386,11 +488,18 @@ class PlanService:
     def _admit(self, entry: _Entry, *, direction: Optional[str] = None
                ) -> None:
         from .. import obs
+        from ..resilience import faults
 
         if self._closed:
             raise ServiceClosedError("service is closed")
         t = entry.ticket.tenant
+        # the admission-boundary injection point: overload and
+        # flaky-client drills inject here like at every other layer
+        # (error raises InjectedFault to THIS submitter, delay drags
+        # the admission path — docs/Resilience.md)
+        faults.fire("serve.submit", tenant=t, kind=entry.ticket.kind)
         try:
+            self._enforce_slo(entry)
             full = self.queue.offer(entry)
         except ServeError as e:
             if obs.enabled():
@@ -421,6 +530,95 @@ class PlanService:
             else:
                 self._schedule_pump()
 
+    # -- SLO / pressure enforcement ----------------------------------------
+    def _enforce_slo(self, entry: _Entry) -> None:
+        """The admission enforcement point (raises typed): feed the
+        pressure gate, evict under its second rung, shed sheddable
+        priorities, and reject requests whose projected wait already
+        busts their deadline.  A no-SLO no-pressure service returns on
+        the first line — the disabled path does no pricing at all."""
+        if not self._slo_armed:
+            return
+        t = entry.ticket.tenant
+        entry.cost_bytes = self.queue.entry_cost(entry)
+        load = self.queue.load
+        if self._gate is not None:
+            self._feed_gate()
+            if self._gate.sheds(entry.shed_priority, self._protected):
+                raise AdmissionError(
+                    f"tenant {t!r}: shed under load (priority "
+                    f"{entry.shed_priority} below the protected tier "
+                    f"{self._protected}, gate {self._gate.state!r})",
+                    tenant=t, reason="shed")
+        if entry.deadline is not None:
+            projected = load.projected_wait_s()
+            budget = entry.deadline - entry.ticket.t_submit
+            # boundary contract (test-pinned): a projection EQUAL to
+            # the deadline still admits — only a wait the model says
+            # is strictly too long is rejected up front
+            if projected is not None and projected > budget:
+                raise DeadlineError(
+                    f"tenant {t!r}: projected wait {projected:.3f}s "
+                    f"exceeds the {budget:.3f}s deadline — rejected at "
+                    f"admission, not answered late", tenant=t,
+                    reason="projected", deadline_s=budget,
+                    projected_s=projected)
+
+    def _slo_maintenance(self) -> None:
+        """The take-side enforcement: re-feed the gate (pressure can
+        cross a mark between admissions), run the evict rung, and fail
+        take-point-expired entries typed.  Called by every dispatch
+        path (step / streaming pump) around ``take_ready``."""
+        if not self._slo_armed:
+            return
+        if self._gate is not None:
+            self._feed_gate()
+
+    def _feed_gate(self) -> None:
+        """THE one gate-feed sequence (admission and take enforcement
+        points must never diverge): update with the live drain
+        projection, then run the evict rung if the gate escalated."""
+        load = self.queue.load
+        self._gate.update(load.drain_s(), load.snapshot)
+        if self._gate.evicting():
+            self._evict_sheddable()
+
+    def _shed_expired(self) -> None:
+        """Fail every entry ``take_ready`` shed as deadline-expired:
+        typed ``DeadlineError(reason="expired")`` on its own ticket —
+        never a silent late answer, never a dispatched corpse."""
+        from .. import obs
+
+        for e in self.queue.pop_expired():
+            budget = (e.deadline - e.ticket.t_submit
+                      if e.deadline is not None else 0.0)
+            if obs.enabled():
+                obs.counter("serve.shed", tenant=e.ticket.tenant,
+                            reason="expired").inc()
+            self._finish_one(
+                e.ticket.key, e, error=DeadlineError(
+                    f"tenant {e.ticket.tenant!r}: deadline "
+                    f"({budget:.3f}s) expired while queued — shed "
+                    f"before dispatch", tenant=e.ticket.tenant,
+                    reason="expired", deadline_s=budget))
+
+    def _evict_sheddable(self) -> None:
+        """The pressure gate's second rung: evict queued sheddable
+        entries (admission-sequence order, deterministic) and fail
+        their tickets typed ``AdmissionError(reason="shed")``."""
+        from .. import obs
+
+        for e in self.queue.evict_sheddable(self._protected):
+            if obs.enabled():
+                obs.counter("serve.shed", tenant=e.ticket.tenant,
+                            reason="evicted").inc()
+            self._finish_one(
+                e.ticket.key, e, error=AdmissionError(
+                    f"tenant {e.ticket.tenant!r}: evicted from the "
+                    f"queue under overload (priority {e.shed_priority} "
+                    f"below the protected tier {self._protected})",
+                    tenant=e.ticket.tenant, reason="shed"))
+
     # -- dispatch ----------------------------------------------------------
     def step(self, *, flush: bool = False) -> int:
         """Dispatch every ready batch through the engine (coalescing
@@ -434,7 +632,13 @@ class PlanService:
         is identical to the pre-engine serialized loop (certifiable:
         :meth:`certify` with ``engine=True``).  Client-thread API —
         never call from inside engine-executed work."""
-        batches = self.queue.take_ready(flush=flush)
+        self._slo_maintenance()
+        taken = self.queue.take_ready(flush=flush)
+        self._shed_expired()
+        # batches dropped typed by an engine reformation resubmit ahead
+        # of fresh traffic (they are older) — not re-counted: they were
+        # already counted by the step/pump that first took them
+        batches = self._take_parked() + taken
         futs = []
         interrupt = None
         for b in batches:
@@ -456,14 +660,22 @@ class PlanService:
                 interrupt = err
         if interrupt is not None:
             raise interrupt
-        return len(batches)
+        return len(taken)
 
     def drain(self) -> int:
-        """Flush-dispatch until the queue is empty; returns batches
-        taken (see :meth:`step`).  The deterministic entry point:
-        tests and multi-controller meshes submit, then drain."""
+        """Flush-dispatch until the queue AND the reformation-parked
+        backlog are empty; returns batches taken (see :meth:`step`).
+        The deterministic entry point: tests and multi-controller
+        meshes submit, then drain.  Parked batches count: a batch
+        dropped typed by an engine reformation still holds unresolved
+        tickets, and drain()'s contract is that nobody waits forever
+        after it returns."""
         n = 0
-        while self.queue.depth():
+        while True:
+            with self._lock:
+                parked = bool(self._parked)
+            if not (self.queue.depth() or parked):
+                break
             n += self.step(flush=True)
         return n
 
@@ -504,8 +716,14 @@ class PlanService:
             return      # quiesced/reforming: the engine's reform/
             # resume hook (or the next submit) re-pumps
         if delay_s is None:
-            delay_s = max(self.queue.max_wait_s,
-                          getattr(self, "_min_tick_s", 0.001))
+            # the deadline-aware tick: bound by the oldest pending
+            # group's coalescing deadline AND any queued SLO deadline
+            # (next_ready_in folds both) — a request whose deadline is
+            # far inside the coalesce window must be shed at ITS
+            # deadline, not discovered expired a full window later
+            wait = self.queue.next_ready_in()
+            delay_s = self.queue.max_wait_s if wait is None else wait
+            delay_s = max(delay_s, getattr(self, "_min_tick_s", 0.001))
         token = (eng, eng.generation)
         now = time.monotonic()
         with self._lock:
@@ -549,8 +767,19 @@ class PlanService:
 
         def _rearm(_eng):
             svc = ref()
-            if svc is not None and svc._streaming and not svc._closed \
-                    and svc.queue.depth():
+            if svc is None:
+                return
+            # NOTHING dispatches from this hook while it runs on the
+            # engine's own consumer thread (an elastic_step reforming
+            # from inside an in-flight dispatch): neither a parked
+            # flush nor a pump tick may put the new generation to work
+            # concurrently with the old consumer's still-rerunning
+            # interrupted batch — that dispatch's completion (_finish)
+            # flushes and re-arms instead
+            if _eng.on_consumer_thread():
+                return
+            svc._flush_parked()
+            if svc._streaming and not svc._closed and svc.queue.depth():
                 svc._schedule_pump()
 
         unhook = eng.on_reform(_rearm)
@@ -582,12 +811,14 @@ class PlanService:
         if not self._streaming or self._closed:
             return
         try:
+            self._slo_maintenance()
             batches = self.queue.take_ready()
+            self._shed_expired()
         except Exception:
             batches = []
             if obs.enabled():
                 obs.counter("serve.loop_errors").inc()
-        for b in batches:
+        for b in self._take_parked() + batches:
             self._submit_or_fail(b)
         if self.queue.depth():
             # re-arm at the oldest pending group's own deadline — a
@@ -610,6 +841,9 @@ class PlanService:
         self.queue.close_gate()         # the airtight one
         if drain:
             self.drain()
+        # reformation-parked batches must not strand their tickets in a
+        # dead service: resubmit (or fail typed, if the engine is gone)
+        self._flush_parked()
         from ..cluster import elastic
         with self._lock:
             names, self._elastic_names = self._elastic_names, set()
@@ -678,16 +912,20 @@ class PlanService:
         dispatches).  Tickets are fulfilled by the future's completion
         callback, so streaming mode needs no waiter."""
         from .. import obs
-        from ..guard.recover import guarded_step
+        from ..guard.recover import elastic_step
 
         B = len(batch.entries)
+        resubmit = batch.resubmits > 0
         t_dispatch = time.monotonic()
         for e in batch.entries:
             e.ticket.t_dispatch = t_dispatch
         wait_s = t_dispatch - batch.entries[0].ticket.t_submit
-        if obs.enabled():
+        if obs.enabled() and not resubmit:
             # the formation record: what the queue coalesced (validation
-            # losses below journal their own non-ok serve.complete)
+            # losses below journal their own non-ok serve.complete).
+            # ONE logical dispatch = one coalesce/dispatch record —
+            # a reformation-parked resubmission re-enters here but
+            # must not double-journal or double-count
             obs.record_event(
                 "serve.coalesce", key=batch.key, n=B,
                 reqs=[e.ticket.id for e in batch.entries],
@@ -705,27 +943,40 @@ class PlanService:
             if err is None:
                 survivors.append(e)
             else:
-                self._finish_one(batch, e, error=err)
+                # take_ready counted this entry in flight: clear it
+                # (no rate sample — nothing dispatched for it), or the
+                # drain projection inflates forever and the pressure
+                # gate / autoscaler wedge on phantom load
+                self.queue.note_entry_done(e)
+                self._finish_one(batch.key, e, error=err)
         if not survivors:
             return None     # nothing actually dispatches: no
             # serve.dispatch record, no dispatch count
         batch.entries = survivors
         tenants = sorted({e.ticket.tenant for e in survivors})
-        if obs.enabled():
+        if obs.enabled() and not resubmit:
             obs.record_event(
                 "serve.dispatch", key=batch.key, n=len(survivors),
                 tenants=tenants, score_bytes=batch.cost,
                 reason=batch.reason)
         with self._lock:
-            self._dispatches += 1
+            if not resubmit:
+                self._dispatches += 1
+            self._inflight.append(batch)
         pack = self._host_pack_fn(batch)
         timing = {"s": 0.0}
         meta = self._dispatch_meta(batch)
 
         def run(host_operand=None):
+            # elastic_step, not guarded_step: when the elastic layer is
+            # armed a PeerFailureError/PeerLeftError mid-batch reforms
+            # the mesh (the service's registered factories rebuild its
+            # plans, _rebind re-points this batch's entries) and the
+            # batch reruns under the reformed mesh — with the gate off
+            # this IS guarded_step, bit-for-bit (elastic test pin)
             t0 = time.perf_counter()
             try:
-                return guarded_step(
+                return elastic_step(
                     lambda: self._run_batch(batch, host_operand),
                     retry=self.retry, label=f"serve:{batch.key}",
                     meta={"tenants": tenants,
@@ -736,9 +987,47 @@ class PlanService:
         fut = self.engine().submit(
             run, pack=pack, label=f"serve:{batch.key}", meta=meta)
         fut.add_done_callback(
-            lambda f: self._finish(batch, f._result, f.error(),
-                                   timing["s"]))
+            lambda f: self._complete_or_park(batch, f, timing))
         return fut
+
+    def _complete_or_park(self, batch: Batch, f, timing: dict) -> None:
+        """A batch whose queued engine task was dropped typed by an
+        engine reformation (:class:`EngineReformedError`) is PARKED for
+        resubmission onto the reformed engine instead of failing its
+        tickets — host payloads re-bind to the rebuilt plans, so the
+        program it will dispatch is a live-mesh one.  Parked batches
+        are flushed only from safe points (a finished dispatch's
+        completion, an explicit step/drain, the engine's post-reform
+        hook off the consumer thread), so a resubmission can never
+        dispatch concurrently with a still-running in-flight batch.
+        Bounded: the 4th consecutive reformation drop fails the batch
+        typed — reformation storms must not hide tickets forever."""
+        from .. import obs
+        from ..engine.errors import EngineReformedError
+
+        err = f.error()
+        if (isinstance(err, EngineReformedError) and not self._closed
+                and batch.resubmits < 3):
+            batch.resubmits += 1
+            with self._lock:
+                # parked ≠ in flight: resubmission re-appends it, and
+                # _rebind already walks _parked separately
+                self._inflight = [b for b in self._inflight
+                                  if b is not batch]
+                self._parked.append(batch)
+            if obs.enabled():
+                obs.counter("serve.reform_requeues").inc()
+            return
+        self._finish(batch, f._result, err, timing["s"])
+
+    def _take_parked(self) -> List[Batch]:
+        with self._lock:
+            out, self._parked = self._parked, []
+        return out
+
+    def _flush_parked(self) -> None:
+        for b in self._take_parked():
+            self._submit_or_fail(b)
 
     def _host_pack_fn(self, batch: Batch):
         """The batch's host-pool pack stage: for an all-host FFT batch,
@@ -946,15 +1235,30 @@ class PlanService:
                 err: Optional[BaseException], execute_s: float) -> None:
         from .. import obs
 
+        with self._lock:
+            self._inflight = [b for b in self._inflight
+                              if b is not batch]
         for i, e in enumerate(batch.entries):
-            self._finish_one(batch, e,
+            self._finish_one(batch.key, e,
                              result=None if err is not None else outs[i],
                              error=err)
+        # feed the load tracker: the dispatch's measured wall time IS
+        # the service-rate sample every projection reads (ok or failed
+        # — the time was equally real)
+        self.queue.note_batch_done(batch, execute_s)
         if obs.enabled():
             obs.histogram("serve.execute_seconds",
                           kind=batch.kind).observe(execute_s)
+        # a reformation may have parked dropped batches while this one
+        # was in flight: with the dispatch done, resubmission is safe —
+        # and a streaming pump disarmed by a consumer-thread
+        # self-reform (the _rearm hook refuses to act there) is
+        # re-armed HERE, where the in-flight dispatch provably ended
+        self._flush_parked()
+        if self._streaming and not self._closed and self.queue.depth():
+            self._schedule_pump()
 
-    def _finish_one(self, batch: Batch, e: _Entry, *, result=None,
+    def _finish_one(self, batch_key: str, e: _Entry, *, result=None,
                     error: Optional[BaseException] = None) -> None:
         from .. import obs
 
@@ -965,6 +1269,8 @@ class PlanService:
             t._fulfill(result)
         else:
             t._fail(error)
+        late = (error is None and e.deadline is not None
+                and t.t_done > e.deadline)
         if obs.enabled():
             obs.counter("serve.completed", tenant=t.tenant,
                         outcome=outcome).inc()
@@ -977,10 +1283,23 @@ class PlanService:
             obs.record_event(
                 "serve.complete", _fsync=(error is not None),
                 tenant=t.tenant, req=t.id, outcome=outcome,
-                seconds=t.t_done - t.t_submit, key=batch.key,
+                seconds=t.t_done - t.t_submit, key=batch_key,
                 **({"error": str(error)} if error is not None else {}))
+            if late:
+                # the completion enforcement point: the answer is
+                # returned (the work is done) but the violation is on
+                # the record, fsync-critical — an SLO breach must
+                # survive even a crash right after it
+                obs.counter("serve.slo_violations",
+                            tenant=t.tenant).inc()
+                obs.record_event(
+                    "serve.slo_violation", tenant=t.tenant, req=t.id,
+                    deadline_s=e.deadline - t.t_submit,
+                    late_s=t.t_done - e.deadline, key=batch_key)
         with self._lock:
             self._completed[outcome] = self._completed.get(outcome, 0) + 1
+            if late:
+                self._slo_violations += 1
 
     # -- pre-flight certification ------------------------------------------
     def certify(self, *, hbm_limit: Optional[int] = None,
@@ -1071,11 +1390,17 @@ class PlanService:
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:
         """Service snapshot: registry hit/miss, per-tenant accounting,
-        queue depth, dispatch/completion counts."""
+        queue depth, dispatch/completion counts, SLO violation count
+        and the pressure-gate state (``None`` when no gate is
+        armed)."""
         with self._lock:
             completed = dict(self._completed)
+            violations = self._slo_violations
         return {"registry": self.registry.stats(),
                 "tenants": self.queue.tenants(),
                 "queue_depth": self.queue.depth(),
                 "dispatches": self._dispatches,
-                "completed": completed}
+                "completed": completed,
+                "slo_violations": violations,
+                "pressure": (self._gate.state
+                             if self._gate is not None else None)}

@@ -1,0 +1,232 @@
+"""Service-level objectives — per-tenant deadlines, priorities, and the
+load projection they are enforced against.
+
+A tenant with a latency budget has, until now, no way to express it:
+an overload storm just grows the admission queue until quota rejections,
+and a request that will *obviously* miss its deadline still burns a
+dispatch.  This module adds the vocabulary:
+
+* :class:`SLO` — what a tenant declares at registration
+  (:meth:`~pencilarrays_tpu.serve.PlanService.set_slo`): a per-request
+  completion ``deadline_s``, an advisory ``p99_budget_s``, and the
+  ``shed_priority`` the load-shedding gate
+  (:mod:`~pencilarrays_tpu.serve.shed`) orders sacrifices by;
+* :class:`LoadTracker` — the admission queue's own arrival / cost /
+  service history in the router's **bytes-equivalent currency** (the
+  same ``count x latency_bytes + bytes`` score the cost-ordered
+  scheduler already prices batches with).  Everything downstream — the
+  admission-time deadline projection, the shedding gate's drain
+  estimate, the autoscaler's grow/shrink windows — reads ONE
+  projection, so they can never disagree about how loaded the service
+  is.
+
+Deadlines are enforced at THREE points (see ``docs/Serving.md``):
+
+1. **admission** — a request whose *projected* wait (queued cost ahead
+   of it divided by the measured service rate) already exceeds its
+   deadline is rejected typed
+   (:class:`~pencilarrays_tpu.serve.errors.DeadlineError`,
+   ``reason="projected"``) — never a silent late answer;
+2. **take** — entries that expired while queued are shed before
+   dispatch (``reason="expired"``): an expired request must not burn
+   the mesh time that would make its *neighbors* late too;
+3. **completion** — a request that was dispatched in time but finished
+   late journals a fsync-critical ``serve.slo_violation`` record and
+   ticks ``serve.slo_violations{tenant=}`` — the result is still
+   returned (the work is done), but the violation is on the record.
+
+The tracker is deliberately conservative while blind: with no completed
+dispatch in its window it projects ``None`` and admission lets
+everything through — a service that has never measured itself has no
+basis to reject, and the completion-point accounting will seed the
+window within one batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = ["SLO", "LoadTracker"]
+
+
+@dataclass(frozen=True)
+class SLO:
+    """One tenant's service-level objective.
+
+    Parameters
+    ----------
+    deadline_s:
+        Per-request completion budget, measured from admission
+        (``None``: no deadline — the tenant keeps PR-10 semantics).
+    p99_budget_s:
+        Advisory p99 latency budget.  Not enforced per request (a p99
+        is a population property); it rides the tenant's
+        ``serve.slo_violation`` accounting and the autoscale bench
+        report so operators can tune capacity against it.
+    shed_priority:
+        Load-shedding order: under pressure the gate sheds lower
+        priorities first, and tenants of the HIGHEST registered
+        priority are never shed (see
+        :class:`~pencilarrays_tpu.serve.shed.PressureGate`).  Default 0
+        — an SLO-less tenant is maximally sheddable.
+    """
+
+    deadline_s: Optional[float] = None
+    p99_budget_s: Optional[float] = None
+    shed_priority: int = 0
+
+    def __post_init__(self):
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be positive, got {self.deadline_s}")
+        if self.p99_budget_s is not None and self.p99_budget_s <= 0:
+            raise ValueError(
+                f"p99_budget_s must be positive, got {self.p99_budget_s}")
+
+
+class LoadTracker:
+    """Arrival / cost / service history in the bytes-equivalent
+    currency — THE load projection every overload decision reads.
+
+    Thread-safe.  ``window`` bounds the completion history (service
+    rate = total priced cost / total measured seconds over the
+    window — a ratio of sums, so one tiny batch cannot dominate the
+    estimate the way a mean-of-ratios would let it)."""
+
+    def __init__(self, window: int = 64):
+        self._lock = threading.Lock()
+        self._completions: deque = deque(maxlen=max(1, int(window)))
+        self._arrivals: deque = deque(maxlen=max(1, int(window)))
+        self._queued_cost = 0       # admitted, not yet taken
+        self._inflight_cost = 0     # taken, not yet completed
+        self._queued_n = 0
+        self._inflight_n = 0
+        # the rate is read on EVERY admission (hot path) but changes
+        # only at completions: cache it per completion-window version
+        self._version = 0
+        self._rate_cache = (-1, None)
+
+    # -- feeding (the queue's accounting hooks) ----------------------------
+    def note_arrival(self, cost_bytes: int,
+                     now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._arrivals.append((now, int(cost_bytes)))
+            self._queued_cost += int(cost_bytes)
+            self._queued_n += 1
+
+    def note_taken(self, cost_bytes: int) -> None:
+        """An entry left the queue for dispatch (still counts toward
+        drain until its batch completes)."""
+        with self._lock:
+            self._queued_cost = max(0, self._queued_cost - int(cost_bytes))
+            self._queued_n = max(0, self._queued_n - 1)
+            self._inflight_cost += int(cost_bytes)
+            self._inflight_n += 1
+
+    def note_removed(self, cost_bytes: int) -> None:
+        """An entry left the queue WITHOUT dispatching (expired shed,
+        pressure eviction): its cost stops weighing on the drain
+        projection immediately."""
+        with self._lock:
+            self._queued_cost = max(0, self._queued_cost - int(cost_bytes))
+            self._queued_n = max(0, self._queued_n - 1)
+
+    def note_completed(self, cost_bytes: int, n: int,
+                       execute_s: float) -> None:
+        """One dispatched batch finished: ``cost_bytes`` priced cost,
+        ``n`` requests, ``execute_s`` measured wall seconds.  Failed
+        dispatches feed the window too — their time was just as real."""
+        with self._lock:
+            self._inflight_cost = max(
+                0, self._inflight_cost - int(cost_bytes))
+            self._inflight_n = max(0, self._inflight_n - int(n))
+            if execute_s > 0:
+                self._completions.append((int(cost_bytes),
+                                          float(execute_s)))
+                self._version += 1
+
+    # -- the projection ----------------------------------------------------
+    def rate_bytes_per_s(self) -> Optional[float]:
+        """Measured service rate (priced cost per wall second) over the
+        completion window; ``None`` until the first measurable
+        completion — a never-measured service projects nothing."""
+        with self._lock:
+            ver, cached = self._rate_cache
+            if ver == self._version:
+                return cached
+            if not self._completions:
+                rate = None
+            else:
+                cost = sum(c for c, _ in self._completions)
+                secs = sum(s for _, s in self._completions)
+                rate = (cost / secs if secs > 0 and cost > 0 else None)
+            self._rate_cache = (self._version, rate)
+        return rate
+
+    def projected_wait_s(self, ahead_cost_bytes: Optional[int] = None
+                         ) -> Optional[float]:
+        """Seconds a request admitted NOW would wait before its own
+        dispatch completes: everything queued and in flight (or the
+        explicit ``ahead_cost_bytes``) divided by the measured rate.
+        ``None`` while the tracker is blind."""
+        rate = self.rate_bytes_per_s()
+        if rate is None:
+            return None
+        if ahead_cost_bytes is None:
+            with self._lock:
+                ahead_cost_bytes = self._queued_cost + self._inflight_cost
+        return ahead_cost_bytes / rate
+
+    def drain_s(self) -> Optional[float]:
+        """Projected time to drain everything queued + in flight — the
+        shedding gate's water-mark currency."""
+        return self.projected_wait_s()
+
+    def arrival_cost_per_s(self) -> Optional[float]:
+        """Offered load over the arrival window (bytes-equivalent per
+        second); ``None`` with fewer than two arrivals."""
+        with self._lock:
+            if len(self._arrivals) < 2:
+                return None
+            t0, _ = self._arrivals[0]
+            t1, _ = self._arrivals[-1]
+            cost = sum(c for _, c in self._arrivals)
+        if t1 <= t0:
+            return None
+        return cost / (t1 - t0)
+
+    def snapshot(self) -> dict:
+        """The projection record journaled with every pressure
+        transition and scale decision — the inputs, so ``pa-obs
+        timeline`` can render WHY."""
+        with self._lock:
+            queued = self._queued_cost
+            inflight = self._inflight_cost
+            queued_n = self._queued_n
+            inflight_n = self._inflight_n
+        rate = self.rate_bytes_per_s()
+        drain = (None if rate is None
+                 else (queued + inflight) / rate)
+        return {
+            "queued_cost_bytes": queued,
+            "inflight_cost_bytes": inflight,
+            "queued_requests": queued_n,
+            "inflight_requests": inflight_n,
+            "rate_bytes_per_s": rate,
+            "arrival_cost_per_s": self.arrival_cost_per_s(),
+            "drain_s": drain,
+        }
+
+    def _reset_for_tests(self) -> None:
+        with self._lock:
+            self._completions.clear()
+            self._arrivals.clear()
+            self._queued_cost = self._inflight_cost = 0
+            self._queued_n = self._inflight_n = 0
+            self._version += 1
+            self._rate_cache = (-1, None)

@@ -45,6 +45,23 @@ KV namespace — a KV root is one job incarnation):
   along: the reformation re-invokes the service's registered factory,
   the queue re-binds, and the post-loop drain must complete both
   requests bit-identically (``SERVE_RESUMED=2``).
+* ``storm`` — the ISSUE 15 overload drill: each rank's ``PlanService``
+  (SLOs: protected priority 10 with a deadline, sheddable priority 0;
+  pressure gate armed) takes an overload storm — every sheddable
+  submission is rejected typed ``AdmissionError(reason="shed")`` while
+  the protected tier queues; rank 1 is then SIGKILLed mid-storm
+  (``hop.exchange:kill%rank1``) and the survivor's serve dispatch
+  (``elastic_step``) reforms to world-1 and resumes draining — every
+  protected ticket resolves bit-identical to direct (unloaded)
+  execution, under deadline, exactly once.
+* ``scale`` — the ISSUE 15 autoscaler round trip: both ranks' windowed
+  controllers agree the mesh is idle (``serve.scale`` down journaled
+  everywhere, only the highest rank acts via ``announce_leave``), the
+  survivor reforms down; the departed process pre-warms its plans
+  through the persistent compile cache and rejoins
+  (``join_prewarmed``), admitted by the survivor's overload-driven
+  scale-up reformation; a post-join aligned ``guarded_step`` proves
+  the re-grown mesh coordinates.
 * ``straggle`` / ``control`` — the PR 7 straggler drill: every rank
   runs the same guarded transpose steps, with rank 1 dragged by the
   deterministic ``hop.exchange:delay%rank1`` fault (``straggle``) or
@@ -85,6 +102,13 @@ def main():
     # tight aggregation cadence: the drill exercises the live mesh
     # publish/fold loop, not just the explicit fold at the end
     os.environ.setdefault("PENCILARRAYS_TPU_OBS_AGG_S", "0.5")
+    if phase == "scale":
+        # the pre-warmed-join story: the joiner compiles its plans
+        # through the PERSISTENT compile cache before joining, so the
+        # post-join rebuild is a cache hit (must be set before the
+        # package import wires jax_compilation_cache_dir)
+        os.environ.setdefault("PENCILARRAYS_TPU_COMPILE_CACHE",
+                              os.path.join(tmpdir, "xla-cache"))
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -277,6 +301,175 @@ def main():
         print(f"SERVE_RESUMED={ok}")
         final = np.ascontiguousarray(np.asarray(pa.gather(state["u"])))
         print(f"FINAL={hashlib.sha256(final.tobytes()).hexdigest()}")
+    elif phase == "storm":
+        # ISSUE 15 tentpole drill: an overload storm against the
+        # 2-rank FileKV mesh sheds EXACTLY the sheddable tenants
+        # (typed, at submit), rank 1 is SIGKILLed mid-storm, and the
+        # survivor's serve dispatch reforms + resumes draining — every
+        # submitted request ends in exactly one of: result / typed
+        # DeadlineError / typed AdmissionError; protected results are
+        # bit-identical to direct (unloaded) execution.
+        from pencilarrays_tpu.resilience import faults as _faults
+        from pencilarrays_tpu.serve import (
+            AdmissionError, PlanService, PressurePolicy, SLO)
+
+        os.environ["PENCILARRAYS_TPU_ELASTIC"] = "1"
+        svc = PlanService(
+            max_batch=4, max_wait_s=60.0,
+            slos={"prot": SLO(deadline_s=120.0, shed_priority=10),
+                  "bulk": SLO(shed_priority=0)},
+            pressure=PressurePolicy(high_water_s=1e-4, low_water_s=5e-5),
+            retry=RetryPolicy(max_attempts=2, base_delay=0.01))
+        payloads = [np.random.default_rng(100 + i).standard_normal(shape)
+                    for i in range(4)]
+        # warmup: one aligned boundary that compiles the reshard and
+        # seeds the service-rate window the projections read
+        w = svc.submit_reshard(
+            "prot", pa.PencilArray.from_global(pen, truth), pen2)
+        assert svc.drain() == 1
+        w.result(120)
+        # the storm: 4 protected requests queue (the drain projection
+        # crosses the water marks)...
+        prot_tickets = [
+            svc.submit_reshard(
+                "prot", pa.PencilArray.from_global(pen, p), pen2)
+            for p in payloads]
+        # ...then 4 sheddable requests — ALL shed typed at submit, and
+        # nothing else is (the protected tier keeps flowing)
+        shed = 0
+        for i in range(4):
+            try:
+                svc.submit_reshard(
+                    "bulk",
+                    pa.PencilArray.from_global(pen, payloads[i]), pen2)
+            except AdmissionError as e:
+                assert e.reason == "shed", e.reason
+                shed += 1
+        assert shed == 4, f"expected 4 shed, got {shed}"
+        print(f"STORM_SHED={shed}")
+        # mid-storm SIGKILL: rank 1 dies on its NEXT exchange — inside
+        # the storm batch's dispatch.  The survivor's serve dispatch
+        # (elastic_step) detects the loss by lease expiry, reforms to
+        # world-1, reruns the batch and resumes draining.
+        k = _faults.hit_count("hop.exchange")
+        os.environ["PENCILARRAYS_TPU_FAULTS"] = \
+            f"hop.exchange:kill%rank1@{k + 1}"
+        assert svc.drain() >= 1
+        import hashlib
+
+        digest = hashlib.sha256()
+        for p, t in zip(payloads, prot_tickets):
+            out = t.result(120)
+            ref = pa.reshard(pa.PencilArray.from_global(pen, p), pen2)
+            a = np.ascontiguousarray(np.asarray(pa.gather(out)))
+            b = np.ascontiguousarray(np.asarray(pa.gather(ref)))
+            assert np.array_equal(a, b), \
+                "protected result differs from unloaded execution"
+            assert (t.t_done - t.t_submit) < 120.0, "deadline busted"
+            digest.update(a.tobytes())
+        st = svc.stats()
+        assert st["completed"] == {"ok": 5}, st["completed"]
+        assert st["slo_violations"] == 0, st
+        assert st["pressure"] in ("shed", "evict"), st
+        print(f"STORM_OK={len(prot_tickets)}")
+        print(f"FINAL={digest.hexdigest()}")
+    elif phase == "scale":
+        # ISSUE 15: the scale-down -> scale-up round trip through a
+        # REAL joiner.  Both ranks run the same windowed controller;
+        # the highest rank announces its departure, survivors reform
+        # down; the departed process comes back as a pre-warmed joiner
+        # admitted by the survivor's scale-up reformation.
+        from pencilarrays_tpu import cluster
+        from pencilarrays_tpu.serve import (
+            AutoscalePolicy, Autoscaler, PlanService, SLO)
+        from pencilarrays_tpu.serve.autoscale import join_prewarmed
+
+        os.environ["PENCILARRAYS_TPU_ELASTIC"] = "1"
+        policy = RetryPolicy(max_attempts=2, base_delay=0.01)
+        svc = PlanService(max_batch=4, max_wait_s=60.0,
+                          slos={"prot": SLO(shed_priority=1)})
+        asc = Autoscaler(svc, policy=AutoscalePolicy(
+            overload_drain_s=0.05, windows=2, cooldown_s=0.0,
+            min_world=1))
+        state = {"u": pa.PencilArray.from_global(pen, truth)}
+
+        def tick_step():
+            return pa.transpose(state["u"], pen2)
+
+        # (1) DOWN: two idle windows -> every rank journals the same
+        # decision; only the highest rank flags itself
+        asc.tick()
+        d = asc.tick()
+        assert d.direction == "down", d
+        coord = cluster.coordinator()
+        if rank == world - 1:
+            assert d.acted and coord.leaving, d
+            out = guard.guarded_step(tick_step, retry=policy,
+                                     label="scale-boundary")
+            assert out is not None    # the leaver exits WITH its result
+            kv = coord.kv
+            coord.leave()
+            # wait until the survivor's scale-DOWN reformation commits
+            # (its gen-1 lease appears) before requesting the rejoin —
+            # otherwise the join request races the departure and the
+            # SAME reformation re-admits us (legal, but then the drill
+            # never exercises the scale-up decision)
+            t_wait = time.monotonic() + 60
+            while time.monotonic() < t_wait:
+                if kv.try_get("pa.g1/lease/r0") is not None:
+                    break
+                time.sleep(0.1)
+            else:
+                raise SystemExit("scale-down reformation never landed")
+
+            # (2) ...and returns as a PRE-WARMED joiner: plans compiled
+            # through the persistent cache BEFORE the join request
+            def factory(ctx=None):
+                return pa.PencilFFTPlan(pa.Topology((1,)), shape,
+                                        real=True)
+
+            r, warm = join_prewarmed(coord.kv, f"s{rank}",
+                                     factories={"scale-plan": factory},
+                                     timeout=180)
+            print(f"SCALE_JOINED gen={r.membership.gen} "
+                  f"rank={r.membership.new_rank} "
+                  f"warm_s={warm['warm_s']:.3f}")
+            out = guard.guarded_step(lambda: "post-join", retry=policy,
+                                     label="post-join",
+                                     coordinator=r.coordinator)
+            assert out == "post-join"
+        else:
+            assert not d.acted and d.detail == "not-leaver", d
+            # the survivor's boundary turns the announced departure
+            # into a reformation down
+            out = guard.elastic_step(tick_step, retry=policy,
+                                     label="scale-boundary")
+            assert out is not None
+            coord = cluster.coordinator()
+            assert coord.world == world - 1, coord.world
+            print(f"SCALE_DOWN world={coord.world}")
+            # (3) UP: sustained overload + a pending joiner -> the
+            # controller reforms to admit it.  The backlog is fed to
+            # the projection directly (the storm drill covers organic
+            # serve traffic; this drill is the capacity round trip).
+            svc.queue.load.note_completed(1000, 1, 1.0)  # 1000 B-eq/s
+            svc.queue.load.note_arrival(10_000)          # 10 s backlog
+            deadline_t = time.monotonic() + 120
+            acted = None
+            while time.monotonic() < deadline_t:
+                dd = asc.tick()
+                if dd.direction == "up" and dd.acted:
+                    acted = dd
+                    break
+                time.sleep(0.25)
+            assert acted is not None, "scale-up never admitted a joiner"
+            print(f"SCALE_UP gen={acted.gen} detail={acted.detail}")
+            newc = cluster.coordinator()
+            assert newc.world == world, newc.world
+            out = guard.guarded_step(lambda: "post-join", retry=policy,
+                                     label="post-join",
+                                     coordinator=newc)
+            assert out == "post-join"
     elif phase in ("straggle", "control"):
         from pencilarrays_tpu import cluster
 

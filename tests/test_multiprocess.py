@@ -577,6 +577,105 @@ def test_elastic_reformation_4rank(tmp_path):
     _run_elastic_sequence(tmp_path, 4)
 
 
+# ---------------------------------------------------------------------------
+# overload-resilient serving drills (ISSUE 15): storm shedding + SIGKILL
+# mid-storm, and the autoscaler's scale-down -> rejoin round trip
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_overload_storm_sheds_and_survives_kill(tmp_path):
+    """ISSUE 15 acceptance: an overload storm against the 2-rank FileKV
+    mesh sheds EXACTLY the sheddable tenants (typed at submit, the
+    protected tenant's requests all complete under deadline and
+    bit-identical to unloaded execution), and rank 1 SIGKILLed
+    mid-storm triggers reform + resumed draining with every submitted
+    request resolved exactly once — result, typed DeadlineError, or
+    typed AdmissionError; no stranded waiter, no silent late answer."""
+    outs = _launch_cluster_phase(tmp_path, 2, "storm",
+                                 expect_kill_rank=1)
+    out0 = outs[0]
+    assert "STORM_SHED=4" in out0       # all 4 sheddable, typed
+    assert "STORM_OK=4" in out0         # all 4 protected, bit-identical
+    assert "STORM_SHED=4" in outs[1]    # the victim shed too, pre-kill
+    assert _FINAL_RE.search(out0)
+    events = _cluster_events(tmp_path)
+    # the kill was journaled from inside the dying rank's dispatch
+    kills = [e for e in events
+             if e["ev"] == "fault" and e["mode"] == "kill"]
+    assert kills and all(e["proc"] == 1 and e["point"] == "hop.exchange"
+                         for e in kills), kills
+    # the pressure gate's transition is on the record, on BOTH ranks
+    press = [e for e in events if e["ev"] == "serve.pressure"]
+    assert {e["proc"] for e in press} == {0, 1}, press
+    assert all(e["state"] in ("shed", "evict") for e in press
+               if e["prev"] == "ok"), press
+    assert all(e.get("projection", {}).get("drain_s") is not None
+               for e in press), "transitions must carry the projection"
+    # the survivor reformed: replan -> engine AFTER restore-stage
+    # (hold-until-commit, satellite 1) -> complete, then recovered
+    stages = [e["stage"] for e in events
+              if e["ev"] == "cluster.reform" and e["proc"] == 0]
+    assert "complete" in stages, stages
+    assert stages.index("replan") < stages.index("engine") \
+        < stages.index("complete"), stages
+    rec = [(e["stage"], e.get("via")) for e in events
+           if e["ev"] == "guard.recover" and e["proc"] == 0]
+    assert ("recovered", "reform") in rec, rec
+    # exactly-once resolution: warmup + 4 protected = 5 ok completes
+    # on the survivor, unique request ids, zero SLO violations; the 4
+    # shed requests are typed submit rejections (counters, no tickets)
+    comp0 = [e for e in events
+             if e["ev"] == "serve.complete" and e["proc"] == 0]
+    assert len(comp0) == 5 and len({e["req"] for e in comp0}) == 5, comp0
+    assert all(e["outcome"] == "ok" for e in comp0), comp0
+    assert not [e for e in events if e["ev"] == "serve.slo_violation"]
+
+
+@pytest.mark.chaos
+def test_scale_round_trip_through_real_joiner(tmp_path):
+    """ISSUE 15 acceptance: scale-down -> scale-up round-trips through
+    a REAL joiner.  Idle windows make every rank journal the same
+    ``serve.scale`` down decision (only the highest rank acts =
+    announce_leave), the survivor reforms down; the departed process
+    returns as a pre-warmed joiner (plans compiled through the
+    persistent cache before the join) admitted by the survivor's
+    scale-up reformation — every decision journaled with its
+    projection inputs."""
+    outs = _launch_cluster_phase(tmp_path, 2, "scale")
+    out0, out1 = outs
+    assert "SCALE_DOWN world=1" in out0
+    assert re.search(r"SCALE_UP gen=\d+", out0), out0[-2000:]
+    m = re.search(r"SCALE_JOINED gen=(\d+) rank=1 warm_s=([0-9.]+)",
+                  out1)
+    assert m, out1[-2000:]
+    events = _cluster_events(tmp_path)
+    scale = [e for e in events if e["ev"] == "serve.scale"]
+    tup = {(e["proc"], e["direction"], e.get("reason"), e.get("acted"))
+           for e in scale}
+    assert (1, "down", "idle", True) in tup, tup      # the leaver acted
+    assert (0, "down", "idle", False) in tup, tup     # same decision,
+    # journaled on the non-leaver too
+    assert (0, "up", "overload", True) in tup, tup    # admitted joiner
+    assert (1, "up", "prewarm", False) in tup, tup    # measured warmup
+    assert all("projection" in e for e in scale), scale
+    # two reformations on the survivor, in order: the planned
+    # departure, then the scale-up join admission
+    begins = [e.get("reason") for e in events
+              if e["ev"] == "cluster.reform" and e["stage"] == "begin"
+              and e["proc"] == 0]
+    assert begins == ["leave", "scale-up"], begins
+    completes = [e for e in events if e["ev"] == "cluster.reform"
+                 and e["stage"] == "complete" and e["proc"] == 0]
+    assert len(completes) == 2, completes
+    # the joiner's admission is a member join record
+    joins = [e for e in events if e["ev"] == "cluster.member"
+             and e["change"] == "join"]
+    assert joins and all(e["rank"] == 1 for e in joins), joins
+    # the departure was planned: no crash bundles, no peer-failure
+    assert not [e for e in events if e["ev"] == "guard.bundle"]
+
+
 @pytest.mark.chaos
 def test_cluster_straggler_detection(tmp_path):
     """PR 7 acceptance: a ``hop.exchange:delay%rank1`` fault on a

@@ -1,0 +1,790 @@
+"""Overload-resilient serving (ISSUE 15): SLO deadlines at all three
+enforcement points, the hysteretic load-shedding gate, eviction
+determinism, the serve.submit fault point, the autoscaler's windowed
+controller, and the reform-ordering fix (engine reform only after the
+restore rung commits).
+
+Boundary contracts under test (the satellite checklist):
+
+* a request whose projected wait EQUALS its deadline exactly is
+  admitted (strict-inequality rejection, pinned);
+* shed hysteresis: storm -> recover is exactly two transitions, the
+  band between the water marks never flaps the gate;
+* eviction is deterministic in the submission sequence;
+* ``AdmissionError(reason="shed")`` vs ``"queue-depth"`` vs
+  ``"hbm-limit"`` vs ``DeadlineError`` reasons are never conflated;
+* a restore-stage reformation failure resumes the OLD engines with
+  their held dispatch queue INTACT (the PR-12 flagged hazard).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu import obs
+from pencilarrays_tpu.obs import events as obs_events
+from pencilarrays_tpu.obs import metrics as obs_metrics
+from pencilarrays_tpu.ops.fft import PencilFFTPlan
+from pencilarrays_tpu.resilience import faults
+from pencilarrays_tpu.resilience.errors import InjectedFault
+from pencilarrays_tpu.serve import (
+    SLO,
+    AdmissionError,
+    AutoscalePolicy,
+    Autoscaler,
+    DeadlineError,
+    PlanService,
+    PressurePolicy,
+    TenantQuota,
+)
+from pencilarrays_tpu.serve.shed import PressureGate
+
+pytestmark = pytest.mark.usefixtures("devices")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in (obs.ENV_VAR, faults.ENV_VAR, "PENCILARRAYS_TPU_RETRIES"):
+        monkeypatch.delenv(var, raising=False)
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    yield
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+
+
+def _topo2(devices):
+    return pa.Topology((2,), devices=devices[:2])
+
+
+def _host(rng, shape):
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape)).astype(np.complex64)
+
+
+def _np(x):
+    return np.asarray(pa.gather(x))
+
+
+# ---------------------------------------------------------------------------
+# the SLO declaration + projection plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_slo_validation():
+    SLO()                                   # all-default is legal
+    SLO(deadline_s=1.0, p99_budget_s=2.0, shed_priority=3)
+    with pytest.raises(ValueError):
+        SLO(deadline_s=0.0)
+    with pytest.raises(ValueError):
+        SLO(p99_budget_s=-1.0)
+    with pytest.raises(TypeError):
+        PlanService(slos={"t": "not-an-slo"})
+
+
+def test_load_tracker_projection_arithmetic():
+    from pencilarrays_tpu.serve.slo import LoadTracker
+
+    lt = LoadTracker()
+    assert lt.rate_bytes_per_s() is None    # blind: no verdicts
+    assert lt.projected_wait_s() is None
+    lt.note_arrival(1000)
+    lt.note_arrival(1000)
+    assert lt.snapshot()["queued_cost_bytes"] == 2000
+    # one measured completion sets the rate: 500 bytes-equiv / s
+    lt.note_taken(1000)
+    lt.note_completed(1000, 1, 2.0)
+    assert lt.rate_bytes_per_s() == pytest.approx(500.0)
+    # 1000 still queued -> 2 s projected drain, exact
+    assert lt.drain_s() == pytest.approx(2.0)
+    assert lt.projected_wait_s(250) == pytest.approx(0.5)
+    # removal (shed/evict) stops the cost weighing immediately
+    lt.note_removed(1000)
+    assert lt.drain_s() == pytest.approx(0.0)
+
+
+def test_disabled_path_prices_nothing(devices):
+    """A service with no SLOs and no pressure policy must not price
+    requests at admission (the PR-10 behavior AND overhead): the load
+    tracker sees zero-cost arrivals and projects nothing."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(0)
+    svc = PlanService(max_batch=4, max_wait_s=60.0)
+    assert not svc._slo_armed
+    svc.submit("t", _host(rng, (8, 6, 4)), plan=plan)
+    assert svc.queue.load.snapshot()["queued_cost_bytes"] == 0
+    assert svc.queue.load.projected_wait_s() is None
+    svc.drain()
+    assert svc.queue.load.rate_bytes_per_s() is None
+    assert svc.stats()["pressure"] is None
+
+
+# ---------------------------------------------------------------------------
+# enforcement point 1: admission projection
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_projection_boundary_exact_equality_admits(devices):
+    """THE boundary pin: projected wait == deadline admits; any
+    projection strictly beyond it rejects typed
+    ``DeadlineError(reason="projected")`` — never a silent late
+    answer."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(1)
+    svc = PlanService(max_batch=8, max_wait_s=60.0,
+                      slos={"bulk": SLO(shed_priority=0)})
+    for _ in range(3):
+        svc.submit("bulk", _host(rng, (8, 6, 4)), plan=plan)
+    # seed the service rate: X bytes-equivalent per 2 s
+    cost = svc.queue.load.snapshot()["queued_cost_bytes"] // 3
+    assert cost > 0
+    svc.queue.load.note_completed(cost, 1, 2.0)
+    projected = svc.queue.load.projected_wait_s()
+    assert projected is not None and projected > 0
+    # equality: ADMITTED (strict-inequality contract)
+    svc.set_slo("edge", SLO(deadline_s=projected))
+    t_ok = svc.submit("edge", _host(rng, (8, 6, 4)), plan=plan)
+    assert t_ok.error() is None
+    # now the projection grew (one more queued request); a deadline
+    # strictly below it is rejected with the projection attached
+    projected2 = svc.queue.load.projected_wait_s()
+    svc.set_slo("tight", SLO(deadline_s=projected2 * 0.5))
+    with pytest.raises(DeadlineError) as ei:
+        svc.submit("tight", _host(rng, (8, 6, 4)), plan=plan)
+    assert ei.value.reason == "projected"
+    assert ei.value.tenant == "tight"
+    assert ei.value.projected_s == pytest.approx(projected2)
+    assert ei.value.deadline_s == pytest.approx(projected2 * 0.5)
+    # the rejection never entered the queue
+    assert svc.queue.depth("tight") == 0
+    svc.drain()
+
+
+def test_blind_tracker_admits_everything(devices):
+    """No completion history -> no projection -> deadlines cannot
+    reject at admission (a never-measured service has no basis)."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(2)
+    svc = PlanService(max_batch=4, max_wait_s=60.0,
+                      slos={"dl": SLO(deadline_s=1e-9)})
+    # far too tight to ever hold — but unprojectable, so admitted
+    t = svc.submit("dl", _host(rng, (8, 6, 4)), plan=plan)
+    assert t.error() is None
+    svc.drain()
+
+
+# ---------------------------------------------------------------------------
+# enforcement point 2: take-side expiry shed
+# ---------------------------------------------------------------------------
+
+
+def test_expired_entry_shed_at_take_typed(devices, tmp_path):
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(3)
+    obs.enable(str(tmp_path / "obs"))
+    svc = PlanService(max_batch=4, max_wait_s=60.0,
+                      slos={"dl": SLO(deadline_s=0.03)})
+    t = svc.submit("dl", _host(rng, (8, 6, 4)), plan=plan)
+    time.sleep(0.08)            # the deadline lapses in the queue
+    assert svc.drain() == 0     # nothing dispatched
+    with pytest.raises(DeadlineError) as ei:
+        t.result(1)
+    assert ei.value.reason == "expired"
+    assert ei.value.tenant == "dl"
+    assert svc.stats()["completed"] == {"DeadlineError": 1}
+    # quota released: the tenant can submit again
+    svc.submit("dl", _host(rng, (8, 6, 4)), plan=plan)
+    svc.drain()
+    obs.disable()
+    events = obs_events.read_journal(str(tmp_path / "obs"))
+    comp = [e for e in events if e["ev"] == "serve.complete"
+            and e["outcome"] == "DeadlineError"]
+    assert len(comp) == 1 and comp[0]["req"] == t.id
+    counters = obs_metrics.snapshot()["counters"]
+    assert counters["serve.shed{reason=expired,tenant=dl}"] == 1
+
+
+def test_expiry_feeds_pump_deadline(devices):
+    """The deadline-aware pump tick: ``next_ready_in`` is bounded by
+    the earliest queued SLO deadline, so a streaming service wakes to
+    shed an expiring entry instead of waiting out the coalesce
+    window."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(4)
+    svc = PlanService(max_batch=8, max_wait_s=30.0,
+                      slos={"dl": SLO(deadline_s=0.05)})
+    svc.submit("dl", _host(rng, (8, 6, 4)), plan=plan)
+    wait = svc.queue.next_ready_in()
+    assert wait is not None and wait <= 0.05 + 1e-3, wait
+    svc.drain()
+
+
+def test_streaming_pump_sheds_at_slo_deadline(devices):
+    """Live streaming regression (found by end-to-end verify): the
+    pump's INITIAL arm must honor a queued SLO deadline far inside the
+    coalesce window — the expired entry is shed typed at ~its deadline,
+    not discovered a full ``max_wait_s`` later."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(15)
+    svc = PlanService(max_batch=8, max_wait_s=5.0,
+                      slos={"dl": SLO(deadline_s=0.1, shed_priority=1)})
+    svc.start()
+    t0 = time.monotonic()
+    t = svc.submit("dl", _host(rng, (8, 6, 4)), plan=plan)
+    with pytest.raises(DeadlineError) as ei:
+        t.result(3)
+    assert ei.value.reason == "expired"
+    assert time.monotonic() - t0 < 2.0, \
+        "the pump waited out the coalesce window instead of the deadline"
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# enforcement point 3: late completion journaled
+# ---------------------------------------------------------------------------
+
+
+def test_late_completion_journals_slo_violation(devices, tmp_path):
+    """A request dispatched in time but finished late RETURNS its
+    result and journals fsync-critical ``serve.slo_violation`` with
+    per-tenant counters — enforced, visible, never silent."""
+    topo = _topo2(devices)
+    # a fresh shape: the first dispatch pays XLA compile, far beyond
+    # the deadline — deterministic lateness without sleeping
+    plan = PencilFFTPlan(topo, (10, 8, 6))
+    rng = np.random.default_rng(5)
+    obs.enable(str(tmp_path / "obs"))
+    svc = PlanService(max_batch=4, max_wait_s=60.0,
+                      slos={"dl": SLO(deadline_s=0.02,
+                                      p99_budget_s=0.05)})
+    u = _host(rng, (10, 8, 6))
+    t = svc.submit("dl", u, plan=plan)
+    svc.drain()                 # takes immediately: not expired-shed
+    ref = plan.compile(()).forward(pa.PencilArray.from_global(
+        plan.input_pencil, u))
+    assert np.array_equal(_np(t.result(5)), _np(ref)), \
+        "a late completion must still return the (correct) answer"
+    assert svc.stats()["slo_violations"] == 1
+    obs.disable()
+    events = obs_events.read_journal(str(tmp_path / "obs"))
+    viol = [e for e in events if e["ev"] == "serve.slo_violation"]
+    assert len(viol) == 1
+    assert viol[0]["tenant"] == "dl" and viol[0]["req"] == t.id
+    assert viol[0]["deadline_s"] == pytest.approx(0.02)
+    assert viol[0]["late_s"] > 0
+    counters = obs_metrics.snapshot()["counters"]
+    assert counters["serve.slo_violations{tenant=dl}"] == 1
+    # schema-clean through the real CLI path
+    from pencilarrays_tpu.obs.__main__ import main
+
+    assert main(["lint", str(tmp_path / "obs")]) == 0
+    assert main(["timeline", str(tmp_path / "obs")]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the pressure gate: hysteresis, shed, evict
+# ---------------------------------------------------------------------------
+
+
+def test_pressure_gate_hysteresis_no_flap(tmp_path):
+    """Storm -> recover is exactly TWO transitions; the band between
+    the water marks holds the current state in both directions."""
+    obs.enable(str(tmp_path / "obs"))
+    gate = PressureGate(PressurePolicy(high_water_s=0.1, low_water_s=0.05))
+    assert gate.state == "ok"
+    assert gate.update(0.07) == "ok"        # band: ok holds
+    assert gate.update(0.12) == "shed"      # storm crosses high water
+    assert gate.update(0.07) == "shed"      # band: shed holds (no flap)
+    assert gate.update(0.09) == "shed"
+    assert gate.update(0.04) == "ok"        # recovery below LOW water
+    assert gate.update(0.07) == "ok"        # band again: still ok
+    assert gate.transitions == 2, \
+        "storm->recover must be exactly two transitions, no flapping"
+    assert gate.update(None) == "ok"        # blind projection: no-op
+    obs.disable()
+    events = obs_events.read_journal(str(tmp_path / "obs"))
+    trans = [(e["prev"], e["state"]) for e in events
+             if e["ev"] == "serve.pressure"]
+    assert trans == [("ok", "shed"), ("shed", "ok")]
+
+
+def test_pressure_gate_recovers_at_zero_low_water():
+    """``low_water_s=0`` is legal — a fully-drained queue projects
+    EXACTLY 0.0 and must reopen the gate (at-or-below semantics), not
+    wedge it shut forever."""
+    gate = PressureGate(PressurePolicy(high_water_s=1.0, low_water_s=0.0))
+    assert gate.update(2.0) == "evict"
+    assert gate.update(0.0) == "ok"
+
+
+def test_pressure_gate_evict_escalation():
+    gate = PressureGate(PressurePolicy(high_water_s=0.1, low_water_s=0.05,
+                                       evict_water_s=0.3))
+    assert gate.update(0.15) == "shed"
+    assert not gate.evicting()
+    assert gate.update(0.35) == "evict"     # the second rung
+    assert gate.evicting()
+    assert gate.update(0.2) == "shed"       # de-escalates below evict
+    assert gate.update(0.01) == "ok"
+    with pytest.raises(ValueError):
+        PressurePolicy(high_water_s=0.1, low_water_s=0.2)
+    with pytest.raises(ValueError):
+        PressurePolicy(high_water_s=0.1, evict_water_s=0.05)
+
+
+def _storm_service(devices, rng, *, evict_water_s=None):
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    svc = PlanService(
+        max_batch=8, max_wait_s=60.0,
+        slos={"prot": SLO(shed_priority=10),
+              "bulk": SLO(shed_priority=0)},
+        pressure=PressurePolicy(high_water_s=0.5, low_water_s=0.1,
+                                evict_water_s=evict_water_s))
+    return svc, plan
+
+
+def test_shed_at_submit_protects_high_priority(devices, tmp_path):
+    """Over high water the gate sheds the sheddable tier typed at
+    submit; the protected tier keeps flowing; recovery re-opens the
+    gate."""
+    obs.enable(str(tmp_path / "obs"))
+    rng = np.random.default_rng(6)
+    svc, plan = _storm_service(devices, rng)
+    u = _host(rng, (8, 6, 4))
+    for _ in range(2):
+        svc.submit("prot", u, plan=plan)
+    cost = svc.queue.load.snapshot()["queued_cost_bytes"] // 2
+    svc.queue.load.note_completed(cost, 1, 10.0)    # very slow service
+    assert svc.queue.load.drain_s() > 0.5
+    with pytest.raises(AdmissionError) as ei:
+        svc.submit("bulk", u, plan=plan)
+    assert ei.value.reason == "shed" and ei.value.tenant == "bulk"
+    # the protected tenant is NEVER shed
+    t = svc.submit("prot", u, plan=plan)
+    assert t.error() is None
+    # an SLO-less tenant defaults to priority 0: sheddable
+    with pytest.raises(AdmissionError) as ei2:
+        svc.submit("anon", u, plan=plan)
+    assert ei2.value.reason == "shed"
+    # recovery: drain the queue, feed a fast completion, gate reopens
+    svc.queue.load.note_completed(100 * cost, 1, 0.001)
+    svc.drain()
+    assert svc.queue.load.drain_s() < 0.1
+    t2 = svc.submit("bulk", u, plan=plan)
+    assert t2.error() is None
+    svc.drain()
+    obs.disable()
+    counters = obs_metrics.snapshot()["counters"]
+    assert counters["serve.rejected{reason=shed,tenant=bulk}"] == 1
+    assert counters["serve.rejected{reason=shed,tenant=anon}"] == 1
+
+
+def test_evict_rung_deterministic_in_submission_sequence(devices,
+                                                         tmp_path):
+    """The second rung: already-queued sheddable entries are evicted in
+    admission-sequence order — exactly the sheddable ones, exactly
+    once, protected entries untouched."""
+    obs.enable(str(tmp_path / "obs"))
+    rng = np.random.default_rng(7)
+    svc, plan = _storm_service(devices, rng, evict_water_s=1.0)
+    u = _host(rng, (8, 6, 4))
+    tickets = {}
+    for name in ("bulk", "prot", "bulk", "prot", "bulk"):
+        t = svc.submit(name, u, plan=plan)
+        tickets.setdefault(name, []).append(t)
+    cost = svc.queue.load.snapshot()["queued_cost_bytes"] // 5
+    svc.queue.load.note_completed(cost, 1, 10.0)    # drain >> evict_at
+    assert svc.queue.load.drain_s() > 1.0
+    # the next maintenance pass (any dispatch path) runs the rung
+    svc._slo_maintenance()
+    evicted = [t for t in tickets["bulk"] if t.done()]
+    assert len(evicted) == 3, "every sheddable entry evicts, exactly once"
+    for t in tickets["bulk"]:
+        assert isinstance(t.error(), AdmissionError)
+        assert t.error().reason == "shed"
+    # eviction order == admission order (ticket ids ascend with seq)
+    events = obs_events.read_journal(str(tmp_path / "obs"))
+    shed_reqs = [e["req"] for e in events if e["ev"] == "serve.complete"
+                 and e["outcome"] == "AdmissionError"]
+    assert shed_reqs == sorted(t.id for t in tickets["bulk"])
+    for t in tickets["prot"]:
+        assert not t.done(), "a protected entry was evicted"
+    svc.drain()
+    for t in tickets["prot"]:
+        assert t.error() is None
+    obs.disable()
+
+
+def test_admission_reasons_never_conflated(devices):
+    """``shed`` vs ``queue-depth`` vs ``inflight-bytes`` vs
+    ``hbm-limit`` vs the two DeadlineError reasons: distinct types /
+    reason strings, each from its own enforcement point."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(8)
+    u = _host(rng, (8, 6, 4))
+    # quota reasons (PR-10 semantics untouched by the SLO layer)
+    svc = PlanService(max_batch=8, max_wait_s=60.0,
+                      quotas={"small": TenantQuota(max_requests=1),
+                              "thin": TenantQuota(max_bytes=10)},
+                      slos={"prot": SLO(shed_priority=1)},
+                      pressure=PressurePolicy(high_water_s=0.1,
+                                              low_water_s=0.05))
+    svc.submit("small", u, plan=plan)
+    with pytest.raises(AdmissionError) as e1:
+        svc.submit("small", u, plan=plan)
+    with pytest.raises(AdmissionError) as e2:
+        svc.submit("thin", u, plan=plan)
+    # force the gate shut: shed reason is distinct from both
+    cost = max(1, svc.queue.load.snapshot()["queued_cost_bytes"])
+    svc.queue.load.note_completed(cost, 1, 100.0)
+    with pytest.raises(AdmissionError) as e3:
+        svc.submit("bulk", u, plan=plan)
+    reasons = {e1.value.reason, e2.value.reason, e3.value.reason}
+    assert reasons == {"queue-depth", "inflight-bytes", "shed"}
+    svc.drain()
+    # hbm-limit rides its own service knob (typed at submit, reshard)
+    topo4 = pa.Topology((2, 2), devices=devices[:4])
+    src = pa.Pencil(topo4, (8, 6, 4), (1, 2))
+    dst = pa.Pencil(topo4, (8, 6, 4), (0, 2))
+    x = pa.PencilArray.from_global(src, _host(rng, (8, 6, 4)))
+    svc2 = PlanService(hbm_limit=1)     # nothing routes under 1 byte
+    with pytest.raises(AdmissionError) as e4:
+        svc2.submit_reshard("whale", x, dst)
+    assert e4.value.reason == "hbm-limit"
+    # DeadlineError is a different TYPE with its own reasons
+    assert not isinstance(e4.value, DeadlineError)
+    assert {r for r in ("projected", "expired")} \
+        .isdisjoint({e1.value.reason, e2.value.reason,
+                     e3.value.reason, e4.value.reason})
+
+
+# ---------------------------------------------------------------------------
+# the serve.submit fault point
+# ---------------------------------------------------------------------------
+
+
+def test_serve_submit_fault_point(devices):
+    """``serve.submit:error`` fails the submitter typed at the
+    admission boundary — before any queue state changes — and the
+    counter addressing (@nth) works like every other point."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(9)
+    svc = PlanService(max_batch=4, max_wait_s=60.0)
+    u = _host(rng, (8, 6, 4))
+    with faults.active("serve.submit:error*1@2"):
+        t1 = svc.submit("t", u, plan=plan)      # hit 1: clean
+        with pytest.raises(InjectedFault):      # hit 2: injected, once
+            svc.submit("t", u, plan=plan)
+        t3 = svc.submit("t", u, plan=plan)      # hit 3: clean again
+        assert t3.error() is None
+    assert svc.queue.depth() == 2, \
+        "an injected admission failure must not enter the queue"
+    svc.drain()
+    assert t1.error() is None
+
+
+def test_serve_submit_fault_point_delay_mode(devices):
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(10)
+    svc = PlanService(max_batch=4, max_wait_s=60.0)
+    u = _host(rng, (8, 6, 4))
+    with faults.active("serve.submit:delay@1"), \
+            pytest.MonkeyPatch.context() as mp:
+        mp.setenv(faults.DELAY_S_VAR, "0.15")
+        t0 = time.monotonic()
+        svc.submit("t", u, plan=plan)
+        assert time.monotonic() - t0 >= 0.15    # dragged, then admitted
+    assert svc.queue.depth() == 1
+    svc.drain()
+
+
+# ---------------------------------------------------------------------------
+# the autoscaler controller (unit: no cluster; the round trip rides
+# the FileKV drill in test_multiprocess.py)
+# ---------------------------------------------------------------------------
+
+
+def _loaded_service(devices, rng, drain_s):
+    """A service whose projection reads ``drain_s`` of queued work."""
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    svc = PlanService(max_batch=8, max_wait_s=60.0,
+                      slos={"t": SLO(shed_priority=0)})
+    svc.submit("t", _host(rng, (8, 6, 4)), plan=plan)
+    cost = svc.queue.load.snapshot()["queued_cost_bytes"]
+    svc.queue.load.note_completed(cost, 1, drain_s)  # rate = cost/drain
+    return svc
+
+
+def test_autoscaler_requires_consecutive_windows(devices, tmp_path):
+    obs.enable(str(tmp_path / "obs"))
+    rng = np.random.default_rng(11)
+    svc = _loaded_service(devices, rng, drain_s=5.0)
+    asc = Autoscaler(svc, policy=AutoscalePolicy(
+        overload_drain_s=1.0, windows=3, cooldown_s=0.0))
+    assert asc.tick().direction == "hold"
+    assert asc.tick().direction == "hold"
+    d = asc.tick()      # third consecutive overload window: decide
+    assert d.direction == "up" and d.reason == "overload"
+    assert not d.acted and d.detail == "no-coordinator"
+    assert d.projection["drain_s"] == pytest.approx(5.0)
+    # the streak was consumed: the very next tick holds again
+    assert asc.tick().direction == "hold"
+    obs.disable()
+    events = obs_events.read_journal(str(tmp_path / "obs"))
+    scale = [e for e in events if e["ev"] == "serve.scale"]
+    assert len(scale) == 1
+    assert scale[0]["direction"] == "up"
+    assert scale[0]["reason"] == "overload"
+    assert scale[0]["projection"]["drain_s"] == pytest.approx(5.0)
+    assert scale[0]["acted"] is False
+    svc.drain()
+
+
+def test_autoscaler_interrupted_streak_never_decides(devices):
+    rng = np.random.default_rng(12)
+    svc = _loaded_service(devices, rng, drain_s=5.0)
+    asc = Autoscaler(svc, policy=AutoscalePolicy(
+        overload_drain_s=1.0, windows=2, cooldown_s=0.0))
+    assert asc.tick().direction == "hold"   # overload window 1
+    svc.drain()                             # load vanishes
+    assert asc.tick().direction == "hold"   # idle window 1 (streak reset)
+    assert asc.decisions == 0
+
+
+def test_autoscaler_cooldown_rate_limits(devices):
+    rng = np.random.default_rng(13)
+    svc = _loaded_service(devices, rng, drain_s=5.0)
+    asc = Autoscaler(svc, policy=AutoscalePolicy(
+        overload_drain_s=1.0, windows=1, cooldown_s=3600.0))
+    assert asc.tick().direction == "up"     # first decision fires
+    d = asc.tick()                          # still overloaded...
+    assert d.direction == "hold" and d.reason == "cooldown"
+    assert asc.decisions == 1
+    svc.drain()
+
+
+def test_autoscaler_idle_scales_down(devices, tmp_path):
+    obs.enable(str(tmp_path / "obs"))
+    topo = _topo2(devices)
+    svc = PlanService(max_batch=4, slos={"t": SLO(shed_priority=0)})
+    del topo
+    asc = Autoscaler(svc, policy=AutoscalePolicy(
+        overload_drain_s=1.0, windows=2, cooldown_s=0.0))
+    assert asc.tick().direction == "hold"
+    d = asc.tick()
+    assert d.direction == "down" and d.reason == "idle"
+    assert not d.acted and d.detail == "no-coordinator"
+    obs.disable()
+    events = obs_events.read_journal(str(tmp_path / "obs"))
+    assert [e["direction"] for e in events
+            if e["ev"] == "serve.scale"] == ["down"]
+
+
+def test_autoscaler_down_designates_highest_rank(tmp_path):
+    """Every rank computes the same decision; only the highest-rank
+    member flags itself for departure (announce_leave)."""
+    from pencilarrays_tpu.cluster.consensus import Coordinator
+    from pencilarrays_tpu.cluster.kv import FileKV
+
+    kv = FileKV(str(tmp_path / "kv"))
+    c0 = Coordinator(kv, 0, 2, lease_ttl=30.0, verdict_timeout=20)
+    c1 = Coordinator(kv, 1, 2, lease_ttl=30.0, verdict_timeout=20)
+    try:
+        svc = PlanService(max_batch=4, slos={"t": SLO(shed_priority=0)})
+        pol = AutoscalePolicy(windows=1, cooldown_s=0.0, min_world=1)
+        a0 = Autoscaler(svc, coordinator=c0, policy=pol)
+        a1 = Autoscaler(svc, coordinator=c1, policy=pol)
+        d0, d1 = a0.tick(), a1.tick()
+        assert (d0.direction, d1.direction) == ("down", "down")
+        assert not d0.acted and d0.detail == "not-leaver"
+        assert d1.acted and d1.detail == "leaving-rank=1"
+        assert c1.leaving and not c0.leaving
+        # min_world floor refuses to shrink a 2-world below 2
+        a2 = Autoscaler(svc, coordinator=c0, policy=AutoscalePolicy(
+            windows=1, cooldown_s=0.0, min_world=2))
+        d = a2.tick()
+        assert d.direction == "down" and d.detail == "at-min-world"
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+
+
+def test_prewarm_plans_compiles_and_reports(devices, tmp_path):
+    from pencilarrays_tpu.serve.autoscale import prewarm_plans
+
+    obs.enable(str(tmp_path / "obs"))
+    topo = _topo2(devices)
+
+    def factory(ctx=None):
+        return PencilFFTPlan(topo, (8, 6, 4), real=True)
+
+    rep = prewarm_plans({"warm": factory})
+    assert rep["plans"] == 1 and rep["warm_s"] > 0
+    assert "warm" in rep["per_plan_s"]
+    obs.disable()
+    events = obs_events.read_journal(str(tmp_path / "obs"))
+    pre = [e for e in events if e["ev"] == "serve.scale"
+           and e["reason"] == "prewarm"]
+    assert len(pre) == 1 and pre[0]["projection"]["plans"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the reform-ordering fix (PR-12 flagged hazard, satellite 1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_restore_failure_resumes_engines_with_held_queue(devices,
+                                                         tmp_path):
+    """A restore-stage reformation failure must resume the OLD mesh
+    with every held engine dispatch INTACT: before the reorder,
+    ``reform_all`` ran in the replan stage and a restore failure left
+    the held dispatches already failed typed — contradicting the
+    quiesce site's hold-until-commit comment.  Now the held dispatch
+    survives the failed reformation and EXECUTES on resume."""
+    from pencilarrays_tpu import cluster
+    from pencilarrays_tpu.cluster import elastic
+    from pencilarrays_tpu.cluster.consensus import Coordinator
+    from pencilarrays_tpu.cluster.errors import ReformError
+    from pencilarrays_tpu.cluster.kv import FileKV
+    from pencilarrays_tpu.engine import get_engine
+    from pencilarrays_tpu.resilience import CheckpointManager
+
+    engine = get_engine()
+    gen0 = engine.generation
+    assert engine.quiesce(5)
+    held = engine.submit(lambda: "held-survives", label="held")
+    kv = FileKV(str(tmp_path / "kv"))
+    c0 = Coordinator(kv, 0, 1, lease_ttl=5.0, verdict_timeout=20)
+    # an EMPTY checkpoint manager: membership/mesh/replan succeed, the
+    # restore rung fails (no valid step anywhere)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    try:
+        with pytest.raises(ReformError) as ei:
+            elastic.reform(c0, reason="drill", install=False,
+                           ckpt_mgr=mgr, restore=lambda c: None)
+        assert ei.value.stage == "restore"
+        # the engines were NEVER reformed (the fix: reform_all runs
+        # only after the restore rung commits)...
+        assert engine.generation == gen0
+        # ...and the failed reformation resumed them: the held dispatch
+        # executes with its RESULT — not EngineReformedError
+        assert held.result(10) == "held-survives"
+    finally:
+        c0.shutdown()
+        cluster._reset_for_tests()
+
+
+@pytest.mark.chaos
+def test_successful_reform_still_drops_held_dispatches(devices,
+                                                       tmp_path):
+    """The flip side: when the reformation COMMITS, held dispatches
+    fail typed (their programs target the dead mesh) — the reorder
+    must not silently start dispatching stale programs."""
+    from pencilarrays_tpu import cluster
+    from pencilarrays_tpu.cluster import elastic
+    from pencilarrays_tpu.cluster.consensus import Coordinator
+    from pencilarrays_tpu.cluster.kv import FileKV
+    from pencilarrays_tpu.engine import get_engine
+    from pencilarrays_tpu.engine.errors import EngineReformedError
+    from pencilarrays_tpu.resilience import CheckpointManager
+
+    engine = get_engine()
+    gen0 = engine.generation
+    assert engine.quiesce(5)
+    held = engine.submit(lambda: "never", label="held")
+    kv = FileKV(str(tmp_path / "kv"))
+    c0 = Coordinator(kv, 0, 1, lease_ttl=5.0, verdict_timeout=20)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    state = {"u": pa.PencilArray.from_global(
+        pa.Pencil(pa.Topology((1,), devices=devices[:1]), (4, 4), (0,)),
+        np.ones((4, 4), np.float32))}
+    mgr.save(1, state)
+    try:
+        r = elastic.reform(c0, reason="drill", install=False,
+                           ckpt_mgr=mgr, restore=lambda c: None)
+        assert r.restored_step == 1
+        assert engine.generation == gen0 + 1
+        with pytest.raises(EngineReformedError):
+            held.result(10)
+        r.coordinator.shutdown()
+    finally:
+        c0.shutdown()
+        cluster._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# engine-reformation resubmission: no ticket stranded
+# ---------------------------------------------------------------------------
+
+
+# ---------------------------------------------------------------------------
+# bench smoke (slow-marked: the sweep the suite's --autoscale arm commits)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_autoscale_bench_smoke(devices, tmp_path):
+    from benchmarks.autoscale_bench import run_autoscale_suite
+
+    res = run_autoscale_suite(devices[:2], workdir=str(tmp_path),
+                              waves=2, warm_join=False)
+    storm = res["storm"]["storm"]
+    assert storm["shed_precision"] == 1.0
+    assert storm["shed_recall"] == 1.0
+    assert storm["protected"]["p99_ms"] > 0
+    assert res["storm"]["unloaded"]["shed_typed_at_submit"] == 0
+    assert res["disabled_path"]["serve_rerun"][
+        "coalesced_at_least_serialized"]
+    assert res["controller"]["tick_us"] < 1000
+
+
+@pytest.mark.chaos
+def test_reformed_engine_batch_resubmits_instead_of_stranding(devices):
+    """A serve batch whose engine task was dropped typed by
+    ``Engine.reform`` is parked and resubmitted onto the reformed
+    engine — the ticket resolves with its RESULT, not
+    EngineReformedError (the no-ticket-stranded contract)."""
+    from pencilarrays_tpu.engine import get_engine
+
+    topo = _topo2(devices)
+    plan = PencilFFTPlan(topo, (8, 6, 4))
+    rng = np.random.default_rng(14)
+    engine = get_engine()
+    svc = PlanService(max_batch=4, max_wait_s=0.0)
+    u = _host(rng, (8, 6, 4))
+    assert engine.quiesce(5)        # hold the dispatch queue
+    t = svc.submit("t", u, plan=plan)
+    stepper = threading.Thread(target=svc.step,
+                               kwargs={"flush": True}, daemon=True)
+    stepper.start()
+    deadline = time.monotonic() + 10
+    while engine.depth() == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert engine.depth() == 1, "batch never reached the held engine"
+    engine.reform()                 # drops the queued task typed
+    stepper.join(timeout=10)
+    assert not stepper.is_alive()
+    assert not t.done(), "the ticket must await resubmission, not fail"
+    svc.step(flush=True)            # safe point: flushes the parked batch
+    ref = plan.compile(()).forward(pa.PencilArray.from_global(
+        plan.input_pencil, u))
+    assert np.array_equal(_np(t.result(10)), _np(ref))
+    assert svc.stats()["completed"] == {"ok": 1}
+    svc.close()
