@@ -57,6 +57,21 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# the honest caption every BENCH_* artifact in this repo carries: what
+# the CPU-mesh numbers do and do not claim about a real TPU
+ICI_CAPTION = (
+    "CPU virtual-device mesh: the FFT arms' device side is host "
+    "compute and the mixed-traffic drill's 'device' work is a host "
+    "sleep, so ICI/HBM contention is absent and absolute times are "
+    "scheduler + host costs, not TPU collective bandwidth.  What "
+    "transfers: the certified invariant (per-chain SPMD collective "
+    "order, zero in-chain inversions) is platform-independent, and "
+    "on a real mesh out-of-order issue across disjoint chains hides "
+    "genuine ICI/compute time rather than sleep time — the overlap "
+    "fraction is a floor on structure, not a measurement of TPU "
+    "speedup.")
+
+
 def _percentiles(lat_s: Sequence[float]) -> dict:
     arr = np.asarray(sorted(lat_s))
     return {"p50_ms": float(np.percentile(arr, 50) * 1e3),
@@ -321,6 +336,185 @@ def run_exec_suite(devs, *, shape: Tuple[int, ...] = (96, 48, 48),
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_mixed_traffic_drill(*, n_whale: int = 60, n_minnow: int = 12,
+                            whale_ms: float = 8.0, minnow_ms: float = 0.5,
+                            repeats: int = 3) -> dict:
+    """The ISSUE 16 headline drill: a whale tenant's long batches and a
+    minnow tenant's tiny ones through the SAME engine, twice — ``v1``
+    (``dag=False``: one total-order queue, every task a barrier) and
+    ``v2`` (the task DAG: whale and minnow dispatches declare disjoint
+    resource chains, minnows ride the SLO priority lane).
+
+    The whale chain writes ``plan:whale``, the minnow chain writes
+    ``plan:minnow`` — disjoint, so under v2 a queued minnow is ready
+    the moment its own chain head completes and, sitting on lane 1,
+    issues ahead of every queued whale.  Under v1 it waits out the
+    whole whale backlog.  Headline: **minnow p99 latency** under whale
+    load, total steps/sec (the whales must not pay for the minnows'
+    jump), and the **overlap fraction** (dispatches issued out of
+    enqueue order / total).
+
+    Measured-verdict discipline: each arm's issued dispatch log is
+    certified by ``verify_dispatch_log`` — the v2 log in partial-order
+    mode (zero in-chain inversions, reorders counted), the v1 log
+    still total-order.  The drill's device work is a host sleep — the
+    drill measures the SCHEDULER, not the mesh; the committed FFT
+    numbers live in the ``sync``/``pipelined`` arms above."""
+    import threading
+
+    from pencilarrays_tpu.analysis import spmd
+    from pencilarrays_tpu.engine import Engine
+
+    stride = max(1, n_whale // max(1, n_minnow))
+
+    def one_arm(dag: bool, r: int) -> dict:
+        tag = "v2" if dag else "v1"
+        eng = Engine(f"drill-{tag}-{r}", workers=2, dag=dag)
+        try:
+            lock = threading.Lock()
+            t_done: dict = {}
+
+            def make_run(ms: float):
+                def run():
+                    time.sleep(ms / 1e3)
+                return run
+
+            def make_cb(i: int):
+                def cb(_fut):
+                    with lock:
+                        t_done[i] = time.perf_counter()
+                return cb
+
+            futs, t_sub, kinds = [], [], []
+            minnows_left = n_minnow
+            t0 = time.perf_counter()
+            for w in range(n_whale):
+                t_sub.append(time.perf_counter())
+                kinds.append("whale")
+                f = eng.submit(make_run(whale_ms), label=f"whale{w}",
+                               writes=("plan:whale",), lane=0)
+                f.add_done_callback(make_cb(len(futs)))
+                futs.append(f)
+                if w % stride == stride - 1 and minnows_left:
+                    minnows_left -= 1
+                    t_sub.append(time.perf_counter())
+                    kinds.append("minnow")
+                    f = eng.submit(make_run(minnow_ms),
+                                   label=f"minnow{n_minnow - minnows_left}",
+                                   writes=("plan:minnow",), lane=1)
+                    f.add_done_callback(make_cb(len(futs)))
+                    futs.append(f)
+            for f in futs:
+                f.result(120)
+            eng.drain(120)
+            wall = time.perf_counter() - t0
+            stats = eng.stats()
+            cert = spmd.verify_dispatch_log(
+                eng.dispatch_log(), source=f"mixed-drill-{tag}")
+            lat = [t_done[i] - t_sub[i] for i in range(len(futs))]
+            minnow = [l for l, k in zip(lat, kinds) if k == "minnow"]
+            whale = [l for l, k in zip(lat, kinds) if k == "whale"]
+            return {
+                "wall_s": wall,
+                "steps_per_s": len(futs) / wall,
+                "minnow_latency": _percentiles(minnow),
+                "whale_latency": _percentiles(whale),
+                "out_of_order": stats["out_of_order"],
+                "overlap_fraction": (stats["out_of_order"]
+                                     / max(1, stats["dispatched"])),
+                "starved_issues": stats["starved_issues"],
+                "dispatch_log": cert,
+            }
+        finally:
+            eng.close()
+
+    best = {}
+    for dag in (False, True):
+        tag = "v2" if dag else "v1"
+        for r in range(repeats):
+            arm = one_arm(dag, r)
+            if (tag not in best
+                    or arm["wall_s"] < best[tag]["wall_s"]):
+                best[tag] = arm
+    v1, v2 = best["v1"], best["v2"]
+    return {
+        "n_whale": n_whale, "n_minnow": n_minnow,
+        "whale_ms": whale_ms, "minnow_ms": minnow_ms,
+        "repeats": repeats,
+        "v1": v1, "v2": v2,
+        "minnow_p99_speedup": (v1["minnow_latency"]["p99_ms"]
+                               / max(1e-9,
+                                     v2["minnow_latency"]["p99_ms"])),
+        "minnow_p99_improved": (v2["minnow_latency"]["p99_ms"]
+                                < v1["minnow_latency"]["p99_ms"]),
+        "throughput_ratio_v2_over_v1": (v2["steps_per_s"]
+                                        / v1["steps_per_s"]),
+        "v2_certified_partial_order": v2["dispatch_log"].get(
+            "mode") == "partial",
+        "v1_certified_total_order": v1["dispatch_log"].get(
+            "mode") == "total",
+    }
+
+
+def run_depth_stress(*, depths: Sequence[int] = (1_000, 10_000),
+                     per_group: int = 5, ticks: int = 100,
+                     seed: int = 7) -> dict:
+    """The ISSUE 16 satellite pin, bench-side: push the admission
+    queue's take path and the ``LoadTracker`` projections to 10^4
+    queued entries and show the per-tick scan work tracks DUE work,
+    not depth (the v1 take path rescanned every pending group per
+    tick — superlinear across a tick burst).
+
+    Counter-based, deterministic: ``scan_stats()["groups_scanned"]``
+    after ``ticks`` idle ticks must be ZERO at every depth, and a due
+    burst must scan exactly the due groups.  Wall-clock per tick rides
+    along as color, not verdict."""
+    from pencilarrays_tpu.serve.queue import (AdmissionQueue, TenantQuota,
+                                              Ticket, _Entry)
+
+    rng = np.random.default_rng(seed)
+    quota = TenantQuota(max_requests=1 << 20, max_bytes=1 << 50)
+    out = {"per_group": per_group, "ticks": ticks, "depths": []}
+    for depth in depths:
+        n_groups = max(1, depth // per_group)
+        base = time.monotonic()
+        q = AdmissionQueue(max_batch=per_group + 1, max_wait_s=10.0,
+                           default_quota=quota)
+        for g in range(n_groups):
+            for _ in range(per_group):
+                t = Ticket(f"t{g % 7}", "fft", f"k{g}")
+                t.t_submit = base
+                e = _Entry(ticket=t, plan=None, direction="forward",
+                           payload=None, nbytes=1, plan_name=None,
+                           deadline=None)
+                e.cost_bytes = int(rng.integers(1 << 10, 1 << 16))
+                q.offer(e)
+        t0 = time.perf_counter()
+        for _ in range(ticks):
+            q.take_ready(now=base + 0.5)
+        idle_s = time.perf_counter() - t0
+        idle_scanned = q.scan_stats()["groups_scanned"]
+        # the due burst: everything coalesces out at max_wait
+        t0 = time.perf_counter()
+        batches = q.take_ready(now=base + 20.0)
+        burst_s = time.perf_counter() - t0
+        s = q.scan_stats()
+        q.load.note_completed(1 << 20, per_group, 1e-2)
+        out["depths"].append({
+            "depth": n_groups * per_group,
+            "idle_ticks": ticks,
+            "idle_groups_scanned": idle_scanned,
+            "idle_us_per_tick": idle_s / ticks * 1e6,
+            "burst_groups_scanned": s["groups_scanned"] - idle_scanned,
+            "burst_batches": len(batches),
+            "burst_ms": burst_s * 1e3,
+            "projected_wait_s": q.load.projected_wait_s(),
+        })
+    out["idle_scan_flat"] = len({d["idle_groups_scanned"]
+                                 for d in out["depths"]}) == 1
+    return out
+
+
 def write_artifact(results: dict, path: str = "BENCH_EXEC.json", *,
                    devs=None) -> None:
     doc = dict(results)
@@ -351,6 +545,9 @@ def main():
     devs = jax.devices()[: args.devices]
     results = run_exec_suite(devs, shape=tuple(args.shape),
                              n_steps=args.steps)
+    results["mixed_traffic"] = run_mixed_traffic_drill()
+    results["depth_stress"] = run_depth_stress()
+    results["caption"] = ICI_CAPTION
     results["platform"] = devs[0].platform
     results["n_devices"] = len(devs)
     write_artifact(results, args.out, devs=devs)

@@ -760,7 +760,11 @@ def main():
                              "steps/sec, per-step latency and the "
                              "host-overlap fraction, with the issued "
                              "dispatch log certified against the "
-                             "serialized schedule; writes "
+                             "serialized schedule, plus the DAG-v2 "
+                             "mixed-traffic drill (whale+minnow, "
+                             "minnow p99, overlap fraction, partial-"
+                             "order certification) and the admission-"
+                             "queue depth stress; writes "
                              "BENCH_EXEC.json")
     parser.add_argument("--engine-only", action="store_true",
                         help="run ONLY the --engine arm (used to "
@@ -947,11 +951,20 @@ def main():
     # certified equal to the serialized schedule (zero trace diffs) —
     # committed as BENCH_EXEC.json.
     if args.engine or args.engine_only:
-        from benchmarks.exec_bench import run_exec_suite
+        from benchmarks.exec_bench import (ICI_CAPTION, run_depth_stress,
+                                           run_exec_suite,
+                                           run_mixed_traffic_drill)
         from benchmarks.exec_bench import write_artifact as write_exec
 
         results["engine"] = run_exec_suite(devs,
                                            n_steps=args.engine_steps)
+        # the ISSUE 16 DAG arm: whale+minnow mixed traffic through the
+        # v1 total-order engine vs the v2 task DAG (minnow p99 under
+        # whale load, overlap fraction, partial-order certification),
+        # plus the admission-queue depth stress (scan work vs depth)
+        results["engine"]["mixed_traffic"] = run_mixed_traffic_drill()
+        results["engine"]["depth_stress"] = run_depth_stress()
+        results["engine"]["caption"] = ICI_CAPTION
         write_exec({**results["engine"],
                     "platform": devs[0].platform,
                     "n_devices": len(devs)}, "BENCH_EXEC.json",

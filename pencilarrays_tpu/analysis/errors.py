@@ -75,25 +75,43 @@ class HbmBoundError(AnalysisError):
 
 class DispatchOrderError(AnalysisError):
     """An engine's issued dispatch order diverged from its enqueue
-    order — the pipelined schedule is NOT the serialized schedule, and
+    order — total order for the v1 queue, per dependency chain for the
+    v2 DAG.  The pipelined schedule is NOT the serialized schedule, and
     on a mesh a reordered collective launch is a deadlock.  Names the
     first diverging dispatch (issue position, label, and the enqueue
-    sequence numbers observed vs expected).  Ordering is guaranteed by
-    construction (one consumer thread, FIFO), so this firing means the
-    executor itself is broken — the check exists precisely so that
-    claim is *proved*, not assumed."""
+    sequence numbers observed vs expected); in partial-order mode
+    ``chain`` names the dependency chain and ``dep_seq`` the violated
+    edge's tail (the earlier-enqueued task that issued AFTER this one
+    despite a resource conflict).  Ordering is guaranteed by
+    construction (one consumer thread, conflicts issue FIFO), so this
+    firing means the executor itself is broken — the check exists
+    precisely so that claim is *proved*, not assumed."""
 
     def __init__(self, source: str, position: int, label: str,
-                 expected_seq: int, observed_seq: int):
+                 expected_seq: int, observed_seq: int,
+                 chain: Optional[str] = None,
+                 dep_seq: Optional[int] = None,
+                 detail: Optional[str] = None):
         self.source = source
         self.position = position
         self.label = label
         self.expected_seq = int(expected_seq)
         self.observed_seq = int(observed_seq)
-        super().__init__(
-            f"{source}: dispatch order diverges at issue position "
-            f"{position} ({label!r}): expected enqueue seq "
-            f"{expected_seq}, issued seq {observed_seq}")
+        self.chain = chain
+        self.dep_seq = int(dep_seq) if dep_seq is not None else None
+        if chain is not None:
+            msg = (f"{source}: dispatch order diverges at issue "
+                   f"position {position} ({label!r}) on chain "
+                   f"{chain!r}: enqueue seq {observed_seq} issued "
+                   f"before its dependency seq "
+                   f"{dep_seq if dep_seq is not None else expected_seq}")
+        else:
+            msg = (f"{source}: dispatch order diverges at issue "
+                   f"position {position} ({label!r}): expected enqueue "
+                   f"seq {expected_seq}, issued seq {observed_seq}")
+        if detail:
+            msg = f"{msg} — {detail}"
+        super().__init__(msg)
 
 
 class DonationError(AnalysisError):

@@ -494,21 +494,162 @@ def verify_donation(trace: CollectiveTrace, *,
             f"did not elide the buffer the pricing assumed")
 
 
+def _verify_partial_order(records: Sequence, source: str
+                          ) -> Tuple[int, int, int]:
+    """The partial-order walk: recompute dependence edges from the
+    declared resource sets in enqueue order, fold in each record's own
+    recorded ``deps``, and prove every edge respects issue order.
+    Returns ``(chains, edges, reordered)``; raises
+    :class:`DispatchOrderError` on the first violated chain edge.
+
+    The barrier rule is positional, not edge-enumerated (a barrier
+    touching N earlier records would otherwise cost O(N) edges each):
+    a barrier's issue position must exceed EVERY earlier-enqueued
+    record's, and every later-enqueued record must exceed the last
+    barrier's — together exactly "conflicts with everything, both
+    directions"."""
+    pos_of: Dict[int, int] = {}
+    for pos, r in enumerate(records):
+        seq = r.enqueue_seq
+        if seq in pos_of:
+            raise DispatchOrderError(
+                source, pos, r.label, expected_seq=seq,
+                observed_seq=seq,
+                detail=f"duplicate enqueue seq {seq} in one log — two "
+                       f"dispatches cannot share an enqueue slot")
+        pos_of[seq] = pos
+    by_enqueue = sorted(records, key=lambda r: r.enqueue_seq)
+    writer: Dict[str, int] = {}      # resource -> last writer seq
+    readers: Dict[str, set] = {}     # resource -> reader seqs since
+    barrier_seq = None               # last barrier's enqueue seq
+    barrier_pos = -1
+    max_prev_pos = -1                # max issue pos among earlier-enqueued
+    max_prev_seq = None              # a seq attaining it (edge naming)
+    chain_ids = set()
+    edges = reordered = 0
+    for r in by_enqueue:
+        seq, pos = r.enqueue_seq, pos_of[r.enqueue_seq]
+        deps: Dict[int, str] = {}    # dep seq -> chain label of the edge
+        if getattr(r, "barrier", True):
+            if pos < max_prev_pos:
+                raise DispatchOrderError(
+                    source, pos, r.label, expected_seq=max_prev_seq,
+                    observed_seq=seq, chain="*", dep_seq=max_prev_seq,
+                    detail="a barrier issued before an earlier-enqueued "
+                           "dispatch it must wait out")
+            barrier_seq, barrier_pos = seq, pos
+            # the barrier resets resource history: every later task
+            # orders against the barrier itself, not pre-barrier writers
+            writer.clear()
+            readers.clear()
+            edges += 1 if max_prev_seq is not None else 0
+        else:
+            if barrier_seq is not None:
+                deps[barrier_seq] = "*"
+            reads = frozenset(getattr(r, "reads", ()) or ())
+            writes = frozenset(getattr(r, "writes", ()) or ())
+            for res in reads | writes:
+                w = writer.get(res)
+                if w is not None:
+                    deps[w] = res                      # RAW / WAW
+            for res in writes:
+                for s in readers.get(res, ()):
+                    deps.setdefault(s, res)            # WAR
+            for d in getattr(r, "deps", ()) or ():
+                # the engine's own recorded edges (includes after= —
+                # invisible to the resource recompute); edges landing
+                # outside this log slice (other clients' traffic) are
+                # unprovable here and skipped
+                if d in pos_of:
+                    deps.setdefault(d, getattr(r, "chain", "*"))
+            for d, chain in sorted(deps.items()):
+                edges += 1
+                if pos_of[d] > pos:
+                    raise DispatchOrderError(
+                        source, pos, r.label, expected_seq=d,
+                        observed_seq=seq, chain=chain, dep_seq=d)
+            for res in writes:
+                writer[res] = seq
+                readers.pop(res, None)
+            for res in reads - writes:
+                readers.setdefault(res, set()).add(seq)
+            chain_ids.add(getattr(r, "chain", "*"))
+        if pos > max_prev_pos:
+            max_prev_pos, max_prev_seq = pos, seq
+    # the barrier floor forward: every record enqueued after the LAST
+    # barrier was already edge-checked against it above; nothing more
+    # to do — but count the cross-chain reorders for the report
+    issued_max = -1
+    for r in records:
+        if r.enqueue_seq < issued_max:
+            reordered += 1
+        else:
+            issued_max = r.enqueue_seq
+    return len(chain_ids) + (1 if barrier_pos >= 0 else 0), edges, \
+        reordered
+
+
+def _check_resource_declarations(records: Sequence, source: str) -> None:
+    """The forged-resource check: a non-barrier ``"ok"`` record that
+    dispatched a plan must have DECLARED the matching ``plan:<fp>``
+    write — the resource token the serve layer stamps — else its chain
+    membership was a lie and the partial-order proof above proved the
+    wrong graph.  Raises :class:`ScheduleMismatchError`
+    (op ``"resource-set"``)."""
+    for r in records:
+        if getattr(r, "barrier", True) or getattr(r, "outcome", "ok") \
+                != "ok":
+            continue
+        meta = getattr(r, "meta", None) or {}
+        plan = meta.get("plan")
+        if plan is None:
+            continue
+        want = f"plan:{plan.plan_key()}"
+        writes = tuple(getattr(r, "writes", ()) or ())
+        if want not in writes:
+            raise ScheduleMismatchError(
+                f"{source} [{r.label}]", "resource-set",
+                {"writes": [want]}, {"writes": list(writes)})
+
+
 def verify_dispatch_log(records: Sequence, *, source: str = "engine",
-                        verify_traces: bool = True) -> dict:
+                        verify_traces: bool = True,
+                        mode: str = "auto") -> dict:
     """Check (d), the engine check: a pipelined executor's ISSUED
-    dispatch sequence equals the serialized schedule.
+    dispatch sequence equals the serialized schedule — per dependency
+    chain for the v2 DAG engine, totally for the v1 ordered queue.
 
     ``records`` are :class:`~pencilarrays_tpu.engine.DispatchRecord`\\ s
-    (issue order).  Two properties are proved:
+    (issue order).  ``mode`` selects the order model:
 
-    * **order** — issue order == enqueue order (ascending
+    * ``"total"`` — issue order == enqueue order (ascending
       ``enqueue_seq`` along ascending ``issue_seq``; gaps are fine —
       interleaved traffic from other clients of the same engine was
-      issued between these records — but an INVERSION means the
-      pipelined schedule is not the serialized one and raises
+      issued between these records — but an INVERSION raises
       :class:`~pencilarrays_tpu.analysis.errors.DispatchOrderError`
       naming the first diverging dispatch);
+    * ``"partial"`` — the v2 model: dependence edges are RECOMPUTED
+      from each record's declared ``reads``/``writes`` in enqueue
+      order (write-after-anything and read-after-write conflict; a
+      ``barrier`` record conflicts with everything before AND after
+      it), the engine's own recorded ``deps`` edges are added, and
+      every edge must respect issue order — an in-chain inversion
+      raises :class:`DispatchOrderError` naming the violated chain
+      edge, while a cross-chain reorder certifies clean.  The
+      recomputation is the teeth: a scheduler bug that issued
+      conflicting tasks out of order is caught even if it ALSO
+      recorded its (wrong) deps consistently.  A forged declaration
+      is caught too: an ``"ok"`` non-barrier record that dispatched a
+      plan (``meta["plan"]``) must declare the matching
+      ``"plan:<fp>"`` write, else :class:`ScheduleMismatchError`
+      (op ``"resource-set"``) — a task cannot opt out of its chain by
+      under-declaring;
+    * ``"auto"`` (default) — ``"partial"`` iff any record is
+      non-barrier, else ``"total"``; a pre-v2 log (every record
+      barrier by default) verifies under the exact v1 rules.
+
+    Independent of mode, two more properties are proved:
+
     * **trace** — every ``"ok"`` record that carries a plan in its
       ``meta`` (``plan``/``extra_dims``/``direction`` — the serve
       layer's dispatch metadata) has its compiled collective trace
@@ -529,17 +670,31 @@ def verify_dispatch_log(records: Sequence, *, source: str = "engine",
       logged against a reduced-wire plan, or a stale batch size) passed
       because only op identity/order was compared.
 
-    Returns ``{"dispatches", "order_ok", "verified_traces",
-    "unverified", "wire_checked", "ops"}``."""
+    Returns ``{"dispatches", "order_ok", "mode", "chains", "edges",
+    "reordered", "verified_traces", "unverified", "wire_checked",
+    "ops"}``."""
     records = list(records)
-    prev_seq = None
-    for pos, r in enumerate(records):
-        seq = r.enqueue_seq
-        if prev_seq is not None and seq <= prev_seq:
-            raise DispatchOrderError(source, pos, r.label,
-                                     expected_seq=prev_seq + 1,
-                                     observed_seq=seq)
-        prev_seq = seq
+    if mode not in ("auto", "total", "partial"):
+        raise ValueError(f"unknown dispatch-log mode {mode!r}")
+    if mode == "auto":
+        mode = "partial" if any(
+            not getattr(r, "barrier", True) for r in records) else "total"
+    chains, edges, reordered = 0, 0, 0
+    if mode == "total":
+        prev_seq = None
+        for pos, r in enumerate(records):
+            seq = r.enqueue_seq
+            if prev_seq is not None and seq <= prev_seq:
+                raise DispatchOrderError(source, pos, r.label,
+                                         expected_seq=prev_seq + 1,
+                                         observed_seq=seq)
+            prev_seq = seq
+        chains = 1 if records else 0
+        edges = max(0, len(records) - 1)
+    else:
+        chains, edges, reordered = _verify_partial_order(records, source)
+    if mode == "partial":
+        _check_resource_declarations(records, source)
     verified, unverified, total_ops, wire_checked = 0, 0, 0, 0
     if verify_traces:
         seen: Dict[tuple, int] = {}
@@ -574,6 +729,8 @@ def verify_dispatch_log(records: Sequence, *, source: str = "engine",
     else:
         unverified = len(records)
     return {"dispatches": len(records), "order_ok": True,
+            "mode": mode, "chains": chains, "edges": edges,
+            "reordered": reordered,
             "verified_traces": verified, "unverified": unverified,
             "wire_checked": wire_checked, "ops": total_ops}
 

@@ -1,15 +1,20 @@
-"""Async per-mesh task-graph executor — ordered dispatch, host overlap.
+"""Async per-mesh task-graph executor — dependency-chain dispatch,
+host overlap, SLO priority lanes.
 
 The engine is the runtime's ONE issuer of device work and ONE spawner
 of threads:
 
-* :class:`Engine` (``engine/executor.py``) — an ordered dispatch queue
-  (single consumer thread, collective order guaranteed by
-  construction) plus a host task pool that overlaps checkpoint
-  serialization, guard probe readback, drift sampling and batch
-  packing with the next dispatch's compute.  Steps are
-  :class:`StepFuture`\\ s; double-buffered step pipelines (pack step
-  *k+1* while *k* runs) fall out of the ``pack=`` stage for free.
+* :class:`Engine` (``engine/executor.py``) — a task DAG with a single
+  consumer thread: tasks declare read/write resource sets, conflicting
+  tasks issue in enqueue order (the per-chain SPMD collective-order
+  proof obligation), disjoint tasks issue out of order biased by
+  ``lane=`` priority, starvation-bounded.  Tasks that declare nothing
+  are barriers — the v1 strict total order, unchanged.  A host task
+  pool overlaps checkpoint serialization, guard probe readback, drift
+  sampling and batch packing with the current dispatch's compute.
+  Steps are :class:`StepFuture`\\ s; double-buffered step pipelines
+  (pack step *k+1* while *k* runs) fall out of the ``pack=`` stage for
+  free, and ``submit(after=...)`` pins explicit edges between chunks.
 * :class:`RuntimeConfig` (``engine/config.py``) — every env-gated
   runtime knob (``obs``/``guard``/``cluster``/``elastic``) parsed in
   ONE place and snapshotted once at engine construction.

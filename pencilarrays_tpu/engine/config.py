@@ -43,10 +43,13 @@ from dataclasses import dataclass
 from typing import Optional, Tuple
 
 __all__ = ["RuntimeConfig", "current", "WATCHED_VARS",
-           "ENGINE_WORKERS_VAR", "ENGINE_QUIESCE_VAR"]
+           "ENGINE_WORKERS_VAR", "ENGINE_QUIESCE_VAR",
+           "ENGINE_DAG_VAR", "ENGINE_STARVE_VAR"]
 
 ENGINE_WORKERS_VAR = "PENCILARRAYS_TPU_ENGINE_WORKERS"
 ENGINE_QUIESCE_VAR = "PENCILARRAYS_TPU_ENGINE_QUIESCE_S"
+ENGINE_DAG_VAR = "PENCILARRAYS_TPU_ENGINE_DAG"
+ENGINE_STARVE_VAR = "PENCILARRAYS_TPU_ENGINE_STARVE_S"
 
 # gate off-tokens: guard/obs match exactly (an env value of "OFF" is a
 # bundle/journal *directory* for them), cluster/elastic case-fold
@@ -85,6 +88,8 @@ WATCHED_VARS: Tuple[str, ...] = (
     # engine/
     ENGINE_WORKERS_VAR,
     ENGINE_QUIESCE_VAR,
+    ENGINE_DAG_VAR,
+    ENGINE_STARVE_VAR,
 )
 
 
@@ -149,6 +154,14 @@ class RuntimeConfig:
     # engine/
     engine_workers: int = 2
     engine_quiesce_s: float = 30.0
+    # out-of-order issue among resource-disjoint tasks — default ON;
+    # "0"/"off"/"false" restores the v1 strict total order (the
+    # multi-controller escape hatch: cross-chain issue order is a
+    # property of THIS process's single consumer, not of the fleet)
+    engine_dag: bool = True
+    # lane-starvation bound: a queued task older than this issues next
+    # regardless of lane or pack readiness
+    engine_starve_s: float = 1.0
 
     @classmethod
     def resolve(cls, environ=None) -> "RuntimeConfig":
@@ -208,6 +221,9 @@ class RuntimeConfig:
                 g("PENCILARRAYS_TPU_ELASTIC_JOIN_TIMEOUT"), 600.0),
             engine_workers=max(1, workers if workers is not None else 2),
             engine_quiesce_s=_float(g(ENGINE_QUIESCE_VAR), 30.0),
+            engine_dag=(g(ENGINE_DAG_VAR, "")
+                        .strip().lower() not in ("0", "off", "false")),
+            engine_starve_s=max(0.0, _float(g(ENGINE_STARVE_VAR), 1.0)),
         )
 
 

@@ -1,43 +1,67 @@
-"""The per-mesh task-graph executor — ordered dispatch, host overlap.
+"""The per-mesh task-graph executor — dependency-chain dispatch, host
+overlap, SLO priority lanes.
 
 PR 5 made every runtime arm **sync-per-dispatch** to dodge a
 CPU-backend rendezvous deadlock: two host threads racing collective
 dispatches onto one mesh could interleave their program launches, and
 two ranks disagreeing about launch order deadlock inside the exchange.
-Correct — but it surrendered async pipelining, and everything built
-since contends on the main thread: checkpoint serialization, guard
-probe readback, drift sampling and serve batch packing all run between
-dispatches while the device sits idle (see the post-mortem in
-``docs/Executor.md``).
+Correct — but it surrendered async pipelining.  PR 12 recovered the
+overlap with ONE ordered dispatch queue per engine: a single consumer
+issues every dispatch in strict enqueue order, so the SPMD ordering
+invariant holds by construction — but it also serializes EVERYTHING:
+tenant A's whale batch head-of-line blocks tenant B's 2 ms transform,
+and two independent tenants' steps can never overlap on the wire.
 
-This module recovers the overlap WITHOUT reopening the deadlock class,
-the DaggerFFT way (arXiv:2601.12209 — distributed FFT stages as an
-async task DAG):
+This module is the engine **v2** — the full task-scheduling half of
+DaggerFFT (arXiv:2601.12209), closing exactly that gap:
 
-* **one ordered dispatch queue per engine** — a single consumer thread
-  issues every device dispatch in enqueue order.  The SPMD ordering
-  invariant ("every rank issues the same collectives in the same
-  order") holds *by construction*: there is exactly one issuer and it
-  never reorders.  ``analysis.spmd.verify_dispatch_log`` proves it
-  after the fact (issue order == enqueue order, op-for-op trace ==
-  prediction) — the static certification PR 11 built this for;
-* **a host task pool** that runs everything that does NOT touch the
-  mesh — step packing, checkpoint serialization, probe readback, drift
-  sampling — concurrently with the consumer's current dispatch.  A
-  step submitted with a ``pack`` stage has its operand built on the
-  pool while the PREVIOUS step's device program runs: double-buffered
-  step pipelines fall out for free;
+* **tasks declare resources** — :meth:`Engine.submit` takes ``reads``
+  / ``writes`` sets of resource tokens (``"plan:<fp>"``,
+  ``"route:<key>"``, buffer names — any string).  Tasks whose resource
+  sets conflict (write/write, write/read) form a **dependency chain**
+  and issue in enqueue order, exactly as before.  Tasks on disjoint
+  resources are independent: the consumer issues any *ready* task —
+  deps resolved, operand packed — even if an earlier-enqueued task is
+  still waiting on its pack stage.  A task that declares NO resources
+  is a **barrier** (conflicts with everything, both directions): v1
+  clients that never heard of resources keep the strict total order,
+  bit-for-bit;
+* **the SPMD proof obligation survives, per chain** — there is still
+  exactly ONE issuer per mesh, and within every dependency chain issue
+  order == enqueue order.  ``analysis.spmd.verify_dispatch_log`` grows
+  a partial-order mode that proves it after the fact (every chain edge
+  respected, typed
+  :class:`~pencilarrays_tpu.analysis.errors.DispatchOrderError` naming
+  the violated edge; resource sets are re-checked against the
+  dispatched plans so a forged declaration cannot certify).
+  Cross-chain reorders are a single-issuer property of THIS process:
+  multi-controller ranks must either disable the DAG
+  (``PENCILARRAYS_TPU_ENGINE_DAG=0``) or drain at agreed points, the
+  same contract streaming serve mode already carries;
+* **priority lanes** — ``submit(lane=...)`` biases the pick among
+  ready tasks (highest lane first, FIFO within a lane), so an
+  SLO-tight tenant's task jumps the whale queue at every issue point.
+  Starvation-bounded: a task queued longer than the snapshot's
+  ``engine_starve_s`` is issued next regardless of lane — expensive
+  lanes are delayed, never parked forever;
+* **a host task pool** runs everything that does NOT touch the mesh —
+  step packing, checkpoint serialization, probe readback — overlapped
+  with the consumer's current dispatch, and a pack completion wakes
+  the consumer so a just-packed independent task issues immediately;
 * **steps are futures** — :meth:`Engine.submit` returns a
   :class:`StepFuture`; failures are scoped to one future and the queue
-  keeps draining (a worker-pool exception becomes a typed
-  :class:`~pencilarrays_tpu.engine.errors.EngineTaskError`, never a
-  wedged consumer).
+  keeps draining.  Futures chain: ``submit(after=[...])`` adds
+  explicit dependency edges (the double-buffered chunk-pipeline shape:
+  chunk k+1's pack overlaps chunk k's collective, issue order between
+  the chunks pinned by the edge).
 
 The engine resolves its :class:`~pencilarrays_tpu.engine.config.
 RuntimeConfig` once at construction — zero per-dispatch env reads —
 and re-resolves only at an explicit :meth:`Engine.reform` (the elastic
-reformation boundary: ``cluster/elastic.py`` quiesces every engine
-before membership changes and reforms them after re-planning).
+reformation boundary: ``cluster/elastic.py`` quiesces every engine —
+all lanes pause at the next task boundary — before membership changes
+and reforms them after re-planning; held dispatches are dropped typed,
+counted per lane in the ``engine.reform`` journal record).
 """
 
 from __future__ import annotations
@@ -147,7 +171,14 @@ class StepFuture:
 class DispatchRecord:
     """One issued dispatch, in issue order — what
     ``analysis.spmd.verify_dispatch_log`` certifies against the
-    enqueue order and the ``collective_costs`` predictions."""
+    enqueue order (per dependency chain in partial-order mode) and the
+    ``collective_costs`` predictions.
+
+    v1 records carry only the first seven fields; every v2 field
+    defaults so old constructors — and old pickles — still verify.
+    ``barrier=True`` is the load-bearing default: a record that never
+    declared resources conflicts with everything, which is exactly the
+    strict total order the v1 verifier enforced."""
 
     enqueue_seq: int
     issue_seq: int
@@ -156,6 +187,12 @@ class DispatchRecord:
     queued_s: float
     run_s: float
     meta: dict = field(default_factory=dict)
+    lane: int = 0
+    chain: str = "*"                # "*" = barrier (every chain)
+    barrier: bool = True
+    reads: tuple = ()
+    writes: tuple = ()
+    deps: tuple = ()                # enqueue_seqs this task waited on
 
 
 @dataclass
@@ -167,6 +204,12 @@ class _Task:
     pack_future: Optional[StepFuture]
     meta: dict
     t_enqueue: float
+    reads: frozenset = frozenset()
+    writes: frozenset = frozenset()
+    lane: int = 0
+    barrier: bool = True
+    chain: str = "*"
+    deps: tuple = ()
 
 
 @dataclass
@@ -191,11 +234,22 @@ class Engine:
     config:
         Explicit :class:`~pencilarrays_tpu.engine.config.RuntimeConfig`
         (default: ``config.current()`` — resolved ONCE, here).
+    dag:
+        Out-of-order issue among resource-disjoint tasks (default: the
+        snapshot's ``engine_dag``, env knob
+        ``PENCILARRAYS_TPU_ENGINE_DAG``).  ``False`` treats every task
+        as a barrier — the v1 strict total order.
+    starve_s:
+        Starvation bound for lane/readiness bias (default: the
+        snapshot's ``engine_starve_s``): a task queued this long is
+        issued next regardless of lane or pack readiness.
     """
 
     def __init__(self, name: str = "engine", *,
                  workers: Optional[int] = None,
-                 config: Optional[_config.RuntimeConfig] = None):
+                 config: Optional[_config.RuntimeConfig] = None,
+                 dag: Optional[bool] = None,
+                 starve_s: Optional[float] = None):
         self.name = name
         self.config = config if config is not None else _config.current()
         if workers is not None and int(workers) < 1:
@@ -208,12 +262,35 @@ class Engine:
         # not reintroduce the zero-worker pack wedge silently
         self._workers = int(workers) if workers is not None else \
             max(1, self.config.engine_workers)
+        # explicit dag/starve_s overrides survive reform(); the config
+        # path re-resolves with the fresh snapshot
+        self._dag_override = dag
+        self._starve_override = starve_s
+        self.dag = bool(self.config.engine_dag) if dag is None else \
+            bool(dag)
+        self.starve_s = float(self.config.engine_starve_s) \
+            if starve_s is None else max(0.0, float(starve_s))
         self._cv = threading.Condition()
         self._gen = 0
         self._closed = False
         self._paused = False
         self._busy = False              # consumer mid-dispatch
-        self._tasks: deque = deque()
+        # -- the task DAG (all under _cv) --
+        # _queued: every not-yet-issued task, keyed by enqueue seq
+        # (dict = insertion-ordered); _ready: the issuable subset
+        # (deps resolved); _nblock: outstanding dep count per queued
+        # task; _dependents: completed-task fan-out; _unresolved:
+        # seqs enqueued but not yet COMPLETED (queued + in-flight) —
+        # the set new deps are computed against
+        self._queued: Dict[int, _Task] = {}
+        self._ready: Dict[int, _Task] = {}
+        self._nblock: Dict[int, int] = {}
+        self._dependents: Dict[int, List[int]] = {}
+        self._unresolved: set = set()
+        self._res_writer: Dict[str, int] = {}
+        self._res_readers: Dict[str, set] = {}
+        self._last_barrier: Optional[int] = None
+        self._lane_counts: Dict[int, int] = {}  # queued tasks per lane
         self._timers: list = []         # heap of (deadline, seq, fn)
         self._host_q: deque = deque()
         self._host_busy = 0
@@ -228,6 +305,10 @@ class Engine:
         self._host_done = 0
         self._dispatch_busy_s = 0.0
         self._host_busy_s = 0.0
+        self._out_of_order = 0          # dispatches issued before an
+        self._max_issued_seq = 0        # earlier-enqueued task (the
+        #                                 bench's overlap numerator)
+        self._starved_issues = 0
 
     # -- introspection -----------------------------------------------------
     @property
@@ -245,7 +326,7 @@ class Engine:
 
     def depth(self) -> int:
         with self._cv:
-            return len(self._tasks) + (1 if self._busy else 0)
+            return len(self._queued) + (1 if self._busy else 0)
 
     def on_consumer_thread(self) -> bool:
         """True when the calling thread is (or WAS) one of this
@@ -271,14 +352,20 @@ class Engine:
 
     def stats(self) -> dict:
         with self._cv:
+            lanes = dict(self._lane_counts)
             return {
                 "name": self.name,
                 "generation": self._gen,
-                "queued": len(self._tasks),
+                "queued": len(self._queued),
+                "ready": len(self._ready),
+                "lanes": lanes,
+                "dag": self.dag,
                 "busy": self._busy,
                 "host_queued": len(self._host_q),
                 "host_busy": self._host_busy,
                 "dispatched": self._dispatched,
+                "out_of_order": self._out_of_order,
+                "starved_issues": self._starved_issues,
                 "host_tasks": self._host_done,
                 "dispatch_busy_s": self._dispatch_busy_s,
                 "host_busy_s": self._host_busy_s,
@@ -289,17 +376,34 @@ class Engine:
 
     # -- submission --------------------------------------------------------
     def submit(self, run: Callable, *, pack: Optional[Callable] = None,
-               label: str = "step", meta: Optional[dict] = None
+               label: str = "step", meta: Optional[dict] = None,
+               reads=(), writes=(), lane: int = 0, after=()
                ) -> StepFuture:
         """Enqueue one device dispatch; returns its future.
 
         ``run`` issues the device work (the ONLY place collective
-        programs may be launched) and executes on the consumer thread
-        in strict enqueue order.  ``pack`` (optional) builds the
-        operand on the host pool, overlapped with earlier dispatches;
-        its return value becomes ``run``'s single argument (without
-        ``pack``, ``run`` is called with no arguments).  A ``pack``
-        failure fails THIS future typed and the consumer moves on.
+        programs may be launched) and executes on the consumer thread.
+        ``pack`` (optional) builds the operand on the host pool,
+        overlapped with earlier dispatches; its return value becomes
+        ``run``'s single argument (without ``pack``, ``run`` is called
+        with no arguments).  A ``pack`` failure fails THIS future typed
+        and the consumer moves on.
+
+        ``reads`` / ``writes`` declare the task's resource sets
+        (strings — ``"plan:<fp>"``, ``"route:<key>"``, buffer names).
+        Tasks that conflict (a write against any prior touch, a read
+        against a prior write) issue in enqueue order; disjoint tasks
+        may issue out of order.  Declaring NEITHER makes the task a
+        **barrier**: it waits for everything enqueued before it and
+        blocks everything after — the exact v1 total order, which is
+        why every pre-v2 call site keeps its ordering bit-for-bit.
+        The declaration is a *promise* the partial-order verifier
+        audits: ``run`` must not touch undeclared shared state (a
+        dispatched plan is checked against the declared writes).
+
+        ``lane`` biases the pick among ready tasks (highest first,
+        FIFO within); ``after`` adds explicit dependency edges on
+        futures from THIS engine (already-resolved ones are no-ops).
 
         ``meta`` is held BY REFERENCE until ``run`` returns — a task
         whose shape is unknown at submit time (e.g.
@@ -308,6 +412,14 @@ class Engine:
         shallow COPY is snapshotted into the dispatch log, so later
         mutation of the caller's dict cannot rewrite certification
         history."""
+        rset = frozenset(reads)
+        wset = frozenset(writes)
+        for r in rset | wset:
+            if not isinstance(r, str):
+                raise TypeError(
+                    f"resource tokens must be str, got {type(r).__name__}"
+                    f" in task {label!r}: resources are identity-compared"
+                    f" across tasks and must hash stably")
         fut = StepFuture(label)
         with self._cv:
             if self._closed:
@@ -316,13 +428,128 @@ class Engine:
             pf = None
             if pack is not None:
                 pf = self._offer_host_locked(pack, label, "pack")
-            self._tasks.append(_Task(
-                seq=next(self._enq), label=label, run=run, future=fut,
+            seq = next(self._enq)
+            barrier = not self.dag or (not rset and not wset
+                                       and not after)
+            task = _Task(
+                seq=seq, label=label, run=run, future=fut,
                 pack_future=pf, meta=meta if meta is not None else {},
-                t_enqueue=time.monotonic()))
+                t_enqueue=time.monotonic(),
+                reads=rset, writes=wset, lane=int(lane),
+                barrier=barrier,
+                chain="*" if barrier else
+                      "|".join(sorted(wset) or sorted(rset)) or "*")
+            fut._pa_engine = self
+            fut._pa_seq = seq
+            self._enqueue_locked(task, after)
             self._ensure_threads_locked()
             self._cv.notify_all()
+            lane_depth = self._lane_counts.get(task.lane, 0)
+            ready_n = len(self._ready)
+        from .. import obs
+
+        if obs.enabled():
+            obs.gauge("engine.lanes", engine=self.name,
+                      lane=str(task.lane),
+                      state="queued").set(lane_depth)
+            obs.gauge("engine.ready_tasks",
+                      engine=self.name).set(ready_n)
         return fut
+
+    def _enqueue_locked(self, task: _Task, after=()) -> None:
+        """Compute the task's dependency edges against the unresolved
+        set, update the resource maps, and file it queued (ready if
+        nothing blocks it).  Caller holds ``_cv``."""
+        seq = task.seq
+        deps: set = set()
+        if task.barrier:
+            # a barrier conflicts with everything in flight, and
+            # becomes the floor every later task must clear
+            deps.update(self._unresolved)
+            self._last_barrier = seq
+        else:
+            lb = self._last_barrier
+            if lb is not None and lb in self._unresolved:
+                deps.add(lb)
+            for r in task.reads | task.writes:
+                w = self._res_writer.get(r)
+                if w is not None and w in self._unresolved:
+                    deps.add(w)          # RAW / WAW
+            for w_res in task.writes:
+                readers = self._res_readers.get(w_res)
+                if readers:
+                    deps.update(s for s in readers
+                                if s in self._unresolved)  # WAR
+            for f in after:
+                eng = getattr(f, "_pa_engine", None)
+                if eng is not None and eng is not self:
+                    raise ValueError(
+                        f"after= future {f.label!r} belongs to engine "
+                        f"{eng.name!r}, not {self.name!r}: cross-engine "
+                        f"edges would deadlock two consumers on each "
+                        f"other — chain via add_done_callback instead")
+                s = getattr(f, "_pa_seq", None)
+                if s is not None and s in self._unresolved:
+                    deps.add(s)
+        for w_res in task.writes:
+            self._res_writer[w_res] = seq
+            self._res_readers.pop(w_res, None)
+        for r in task.reads - task.writes:
+            self._res_readers.setdefault(r, set()).add(seq)
+        task.deps = tuple(sorted(deps))
+        self._unresolved.add(seq)
+        for d in deps:
+            self._dependents.setdefault(d, []).append(seq)
+        self._nblock[seq] = len(deps)
+        self._queued[seq] = task
+        self._lane_counts[task.lane] = \
+            self._lane_counts.get(task.lane, 0) + 1
+        if not deps:
+            self._ready[seq] = task
+
+    def _complete_locked(self, task: _Task) -> None:
+        """Retire a finished task from the DAG: release its dependents
+        (newly unblocked ones become ready) and drop its entries from
+        the resource maps so the maps stay bounded by in-flight work,
+        not history.  Caller holds ``_cv``."""
+        seq = task.seq
+        self._unresolved.discard(seq)
+        for dseq in self._dependents.pop(seq, ()):
+            n = self._nblock.get(dseq)
+            if n is None:
+                continue            # dropped by a reform/close
+            n -= 1
+            self._nblock[dseq] = n
+            if n == 0 and dseq in self._queued:
+                self._ready[dseq] = self._queued[dseq]
+        for w_res in task.writes:
+            if self._res_writer.get(w_res) == seq:
+                del self._res_writer[w_res]
+        for r in task.reads:
+            readers = self._res_readers.get(r)
+            if readers is not None:
+                readers.discard(seq)
+                if not readers:
+                    del self._res_readers[r]
+        if self._last_barrier == seq:
+            self._last_barrier = None
+
+    def _clear_dag_locked(self) -> List[_Task]:
+        """Drop every queued task (reform/close): returns them for the
+        caller to fail typed OUTSIDE the lock.  The in-flight task, if
+        any, skips its own completion bookkeeping via the generation
+        check, so the whole DAG state resets here."""
+        pending = list(self._queued.values())
+        self._queued.clear()
+        self._ready.clear()
+        self._nblock.clear()
+        self._dependents.clear()
+        self._unresolved.clear()
+        self._res_writer.clear()
+        self._res_readers.clear()
+        self._last_barrier = None
+        self._lane_counts.clear()
+        return pending
 
     def host_task(self, fn: Callable, *, label: str = "host"
                   ) -> StepFuture:
@@ -394,7 +621,7 @@ class Engine:
         deadline = (time.monotonic() + timeout) if timeout is not None \
             else None
         with self._cv:
-            while (self._tasks or self._busy or self._host_q
+            while (self._queued or self._busy or self._host_q
                    or self._host_busy):
                 remaining = None
                 if deadline is not None:
@@ -476,8 +703,7 @@ class Engine:
             # moved (see _run_task), so the busy flag must not keep
             # counting it toward the new generation's depth/drain
             self._busy = False
-            pending = list(self._tasks)
-            self._tasks.clear()
+            pending = self._clear_dag_locked()
             host_pending = [h for h in self._host_q]
             self._host_q.clear()
             self._timers.clear()
@@ -490,6 +716,10 @@ class Engine:
             self.config = config if config is not None \
                 else _config.current()
             self._workers = max(1, self.config.engine_workers)
+            if self._dag_override is None:
+                self.dag = bool(self.config.engine_dag)
+            if self._starve_override is None:
+                self.starve_s = float(self.config.engine_starve_s)
             self._dispatch_thread = None
             self._host_threads = []
             self._paused = False
@@ -498,7 +728,9 @@ class Engine:
             f"engine {self.name!r} reformed to generation {gen}: "
             f"queued dispatch dropped (its compiled program targeted "
             f"the previous mesh)", generation=gen)
+        dropped_lanes: Dict[int, int] = {}
         for t in pending:
+            dropped_lanes[t.lane] = dropped_lanes.get(t.lane, 0) + 1
             t.future._fail(err)
         for h in host_pending:
             h.future._fail(EngineTaskError(h.label, h.stage, err))
@@ -508,7 +740,9 @@ class Engine:
             obs.counter("engine.reforms").inc()
             obs.record_event("engine.reform", gen=gen, stage="complete",
                              name=self.name, dropped=len(pending),
-                             dropped_host=len(host_pending))
+                             dropped_host=len(host_pending),
+                             dropped_lanes={str(k): v for k, v in
+                                            sorted(dropped_lanes.items())})
         self._run_reform_cbs()
         return gen
 
@@ -519,8 +753,7 @@ class Engine:
             if self._closed:
                 return
             self._closed = True
-            pending = list(self._tasks)
-            self._tasks.clear()
+            pending = self._clear_dag_locked()
             host_pending = list(self._host_q)
             self._host_q.clear()
             self._timers.clear()
@@ -556,6 +789,36 @@ class Engine:
                 name=f"pa-engine-{self.name}-host{len(self._host_threads)}"
                      f"-g{gen}"))
 
+    def _pick_locked(self, now: float) -> Optional[_Task]:
+        """Choose the next ready task, or None if every ready task is
+        still waiting on its pack (the consumer then cv-waits: a pack
+        completion notifies, and the starvation deadline bounds the
+        wait).  Caller holds ``_cv``.
+
+        Order of preference: (1) a STARVED task — queued past
+        ``starve_s`` — lowest seq first, picked even if its pack is
+        pending (the consumer then blocks on it v1-style: guaranteed
+        progress is the floor, lanes only bias above it); (2) the
+        pack-ready task with the highest lane, FIFO within a lane."""
+        starved = None
+        best = None
+        starve = self.starve_s
+        for seq, t in self._ready.items():
+            if now - t.t_enqueue >= starve:
+                if starved is None or seq < starved.seq:
+                    starved = t
+                continue
+            if t.pack_future is not None \
+                    and not t.pack_future._event.is_set():
+                continue
+            key = (-t.lane, seq)
+            if best is None or key < best[0]:
+                best = (key, t)
+        if starved is not None:
+            self._starved_issues += 1
+            return starved
+        return best[1] if best is not None else None
+
     def _loop_dispatch(self, gen: int) -> None:
         while True:
             timer_fn = None
@@ -574,13 +837,33 @@ class Engine:
                         # would issue dead-mesh programs)
                         self._busy = True
                         break
-                    if not self._paused and self._tasks:
-                        task = self._tasks.popleft()
-                        self._busy = True
-                        break
+                    if not self._paused and self._ready:
+                        task = self._pick_locked(now)
+                        if task is not None:
+                            del self._ready[task.seq]
+                            del self._queued[task.seq]
+                            self._nblock.pop(task.seq, None)
+                            n = self._lane_counts.get(task.lane, 1) - 1
+                            if n > 0:
+                                self._lane_counts[task.lane] = n
+                            else:
+                                self._lane_counts.pop(task.lane, None)
+                            self._busy = True
+                            break
                     wait = None
-                    if self._timers and not self._paused:
-                        wait = max(0.0, self._timers[0][0] - now)
+                    if not self._paused:
+                        bounds = []
+                        if self._timers:
+                            bounds.append(self._timers[0][0] - now)
+                        if self._ready:
+                            # every ready task awaits its pack: wake at
+                            # the earliest starvation deadline (a pack
+                            # completion notifies sooner)
+                            bounds.append(min(
+                                t.t_enqueue + self.starve_s
+                                for t in self._ready.values()) - now)
+                        if bounds:
+                            wait = max(0.0, min(bounds))
                     self._cv.wait(wait)
             if timer_fn is not None:
                 try:
@@ -602,10 +885,12 @@ class Engine:
         out, err = None, None
         operand = _NO_OPERAND
         if task.pack_future is not None:
-            # head-of-line wait: ordering REQUIRES issuing in enqueue
-            # order, so a slow pack stalls the queue behind it — the
-            # price of the invariant (packs for later steps keep
-            # running on the pool meanwhile)
+            # usually resolved already — the DAG pick prefers
+            # pack-ready tasks — but a barrier (enqueue order REQUIRED)
+            # or a starved task is issued with its pack still pending,
+            # and then this is the v1 head-of-line wait: a slow pack
+            # stalls the queue behind it, the price of the invariant
+            # (packs for later steps keep running on the pool)
             task.pack_future._event.wait()
             perr = task.pack_future.error()
             if perr is not None:
@@ -630,6 +915,10 @@ class Engine:
                 self._issue_seq += 1
                 self._dispatched += 1
                 self._dispatch_busy_s += t1 - t0
+                if task.seq < self._max_issued_seq:
+                    self._out_of_order += 1
+                else:
+                    self._max_issued_seq = task.seq
                 # the logged meta is a shallow-copy SNAPSHOT: the log
                 # is immutable certification history once the dispatch
                 # completes, and must not pin the caller's (possibly
@@ -639,16 +928,30 @@ class Engine:
                     label=task.label,
                     outcome="ok" if err is None else type(err).__name__,
                     queued_s=t0 - task.t_enqueue, run_s=t1 - t0,
-                    meta=dict(task.meta)))
+                    meta=dict(task.meta),
+                    lane=task.lane, chain=task.chain,
+                    barrier=task.barrier,
+                    reads=tuple(sorted(task.reads)),
+                    writes=tuple(sorted(task.writes)),
+                    deps=task.deps))
+                self._complete_locked(task)
             self._cv.notify_all()
+            lane_depth = self._lane_counts.get(task.lane, 0)
+            ready_n = len(self._ready)
+        from .. import obs
+
+        if not stale and obs.enabled():
+            obs.gauge("engine.lanes", engine=self.name,
+                      lane=str(task.lane),
+                      state="queued").set(lane_depth)
+            obs.gauge("engine.ready_tasks",
+                      engine=self.name).set(ready_n)
         if stale:
             # a quiesce-timeout survivor finishing after a reform: its
             # generation's accounting was already written off, and its
             # lower enqueue_seq must NOT land after new-generation log
             # records (a spurious DispatchOrderError on a healthy
             # engine) — resolve the future, touch nothing else
-            from .. import obs
-
             if obs.enabled():
                 obs.counter("engine.stale_dispatches").inc()
         if err is None:
@@ -674,15 +977,21 @@ class Engine:
             except BaseException as e:
                 err = EngineTaskError(item.label, item.stage, e)
             t1 = time.monotonic()
+            # resolve BEFORE the notify: the consumer's "some ready
+            # task's pack completed?" wake-up re-checks pack futures
+            # under _cv — notifying first would let it observe this
+            # pack still unresolved, wait again, and never be
+            # re-notified (drain() only needs the busy decrement, which
+            # still precedes its wake)
+            if err is None:
+                item.future._fulfill(out)
+            else:
+                item.future._fail(err)
             with self._cv:
                 self._host_busy -= 1
                 self._host_done += 1
                 self._host_busy_s += t1 - t0
                 self._cv.notify_all()
-            if err is None:
-                item.future._fulfill(out)
-            else:
-                item.future._fail(err)
 
 
 # ---------------------------------------------------------------------------

@@ -81,7 +81,11 @@ DEFAULT_DIR = "pa_obs"
 # fields ``extra_dims`` (the plan's batch) and ``decomposition`` (the
 # slab/pencil verdict) — see obs/schema.py V3_EVENT_FIELDS.  v1/v2
 # journals again stay lint-clean.
-SCHEMA_VERSION = 4
+# v5: ``serve.dispatch`` additionally carries the DAG-engine fields
+# ``lane`` (the priority lane the batch was submitted on) and ``chain``
+# (the dependency chain it orders within) — see obs/schema.py
+# V5_EVENT_FIELDS.  Earlier journals again stay lint-clean.
+SCHEMA_VERSION = 5
 
 # events whose loss would blind a post-mortem: fsync'd under the default
 # "critical" policy.  High-rate events (per-hop dispatch) only flush.

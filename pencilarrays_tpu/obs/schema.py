@@ -15,7 +15,7 @@ from typing import Dict, Iterable, List, Tuple, Union
 from .events import SCHEMA_VERSION
 
 __all__ = ["COMMON_FIELDS", "EVENT_TYPES", "V4_EVENT_FIELDS",
-           "lint_event", "lint_journal"]
+           "V5_EVENT_FIELDS", "lint_event", "lint_journal"]
 
 # fields every record carries (written by events.record_event itself)
 COMMON_FIELDS: Tuple[str, ...] = (
@@ -45,6 +45,16 @@ V3_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
 # v1-v3 journals stay lint-clean, as with the v2/v3 stamps.
 V4_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     "route.plan": ("peak_hbm_bytes", "hbm_limit", "donate"),
+}
+
+# per-event fields required since schema v5 (the DAG engine): a v5
+# ``serve.dispatch`` record must carry the engine priority lane it was
+# submitted on and the dependency chain it orders within (the declared
+# write set, joined) — what pa-obs' per-lane timeline tracks and the
+# partial-order certification render from.  v1-v4 journals stay
+# lint-clean, as with the earlier versioned stamps.
+V5_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
+    "serve.dispatch": ("lane", "chain"),
 }
 
 # ev -> required payload fields (extra fields are allowed; missing ones
@@ -167,6 +177,12 @@ def lint_event(e: dict) -> List[str]:
                 errors.append(
                     f"v{v} event {ev!r} missing required field {f!r} "
                     f"(memory-bounded routing fields, schema v4): {e!r}")
+    if isinstance(v, (int, float)) and v >= 5:
+        for f in V5_EVENT_FIELDS.get(ev, ()):
+            if f not in e:
+                errors.append(
+                    f"v{v} event {ev!r} missing required field {f!r} "
+                    f"(DAG-engine lane fields, schema v5): {e!r}")
     return errors
 
 

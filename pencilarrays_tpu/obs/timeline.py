@@ -318,6 +318,12 @@ def merge_journals(directory: str, *, correct_skew: bool = True,
 _TRACKS = {"run": 0, "hops": 1, "io": 2, "ckpt": 3, "recovery": 4,
            "cluster": 5, "serve": 6}
 
+# dispatches that carry a priority lane (schema v5) render on dynamic
+# per-lane tracks BELOW the serve track, so cross-lane overlap — a
+# whale batch in flight while a minnow batch issues — is visible as
+# two concurrent spans instead of interleaved instants on one line
+_LANE_TRACK_BASE = 7
+
 _TRACK_OF = {
     "hop": "hops",
     "io.open": "io", "io.write": "io", "io.read": "io",
@@ -390,7 +396,13 @@ def _span_name(e: dict) -> str:
     if ev == "serve.coalesce":
         return f"coalesce n={e.get('n', '?')} ({e.get('reason', '?')})"
     if ev == "serve.dispatch":
-        return f"serve.dispatch n={e.get('n', '?')}"
+        name = f"serve.dispatch n={e.get('n', '?')}"
+        if isinstance(e.get("lane"), int):
+            name += f" lane={e['lane']}"
+        chain = e.get("chain")
+        if chain and chain != "*":
+            name += f" [{chain}]"
+        return name
     if ev == "serve.complete":
         return (f"serve {e.get('tenant', '?')}#{e.get('req', '?')}:"
                 f"{e.get('outcome', '?')}")
@@ -430,6 +442,10 @@ def to_trace(tl: MergedTimeline) -> dict:
                 "otherData": {"directory": tl.directory,
                               "warnings": tl.warnings}}
     t0 = min(e["t_corr"] for e in tl.events)
+    lanes = sorted({e["lane"] for e in tl.events
+                    if e.get("ev") == "serve.dispatch"
+                    and isinstance(e.get("lane"), int)
+                    and e["lane"] >= 0})
     out: List[dict] = []
     for rank in tl.ranks:
         out.append({"ph": "M", "name": "process_name", "pid": rank,
@@ -442,10 +458,21 @@ def to_trace(tl: MergedTimeline) -> dict:
             out.append({"ph": "M", "name": "thread_sort_index",
                         "pid": rank, "tid": tid,
                         "args": {"sort_index": tid}})
+        for lane in lanes:
+            tid = _LANE_TRACK_BASE + lane
+            out.append({"ph": "M", "name": "thread_name", "pid": rank,
+                        "tid": tid,
+                        "args": {"name": f"serve.lane{lane}"}})
+            out.append({"ph": "M", "name": "thread_sort_index",
+                        "pid": rank, "tid": tid,
+                        "args": {"sort_index": tid}})
     for e in tl.events:
         rank = int(e.get("proc", 0))
         ev = e.get("ev", "?")
         tid = _TRACKS[_TRACK_OF.get(ev, "run")]
+        if (ev == "serve.dispatch" and isinstance(e.get("lane"), int)
+                and e["lane"] >= 0):
+            tid = _LANE_TRACK_BASE + e["lane"]
         ts_end = (e["t_corr"] - t0) * 1e6
         args = {k: v for k, v in e.items() if k != "t_corr"}
         dur_field = _SPAN_DURATION_FIELD.get(ev)

@@ -28,6 +28,7 @@ are formed or ordered relative to each other):
 
 from __future__ import annotations
 
+import heapq
 import itertools
 import threading
 import time
@@ -114,6 +115,7 @@ class _Entry:
     deadline: Optional[float] = None  # absolute monotonic SLO deadline
     shed_priority: int = 0        # the tenant's SLO shed tier
     cost_bytes: int = 0           # priced B=1 cost (projection currency)
+    departed: bool = False        # left _pending (lazy SLO-heap skip)
 
 
 @dataclass
@@ -176,6 +178,25 @@ class AdmissionQueue:
         # entries shed at the take point (SLO deadline expired while
         # queued) — the service pops these and fails their tickets typed
         self._expired: List[_Entry] = []
+        # -- the take-path index (depth-stress fix) --
+        # The v1 take path rescanned EVERY pending group per tick:
+        # O(groups) per call, superlinear across a burst (ROADMAP's
+        # 10^4-entry flag; pinned by tests/test_serve_depth.py).  The
+        # take now touches only groups that can actually yield work:
+        # _full — groups at max_batch (maintained at offer/take);
+        # _due_heap — (coalesce deadline, tiebreak, key), lazily
+        # validated (a popped key whose LIVE head is due later is
+        # re-pushed, a dead key is dropped); _slo_heap — (SLO deadline,
+        # seq, entry), lazily skipping departed entries.  Batch
+        # formation and dispatch order are untouched — the index
+        # changes WHAT is scanned, never what is taken or how it sorts.
+        self._full: set = set()
+        self._due_heap: list = []
+        self._slo_heap: list = []
+        self._heap_seq = itertools.count(1)
+        # scan accounting (the scaling assertion's deterministic pin)
+        self._take_calls = 0
+        self._groups_scanned = 0
 
     # -- admission ---------------------------------------------------------
     def quota_for(self, tenant: str) -> TenantQuota:
@@ -209,12 +230,25 @@ class AdmissionQueue:
                     f"would exceed quota ({q.max_bytes})", tenant=t,
                     reason="inflight-bytes")
             entry.seq = next(self._seq)
+            entry.departed = False
             self._tenant_requests[t] = n + 1
             self._tenant_bytes[t] = b + entry.nbytes
             group = self._pending.setdefault(entry.ticket.key, [])
             group.append(entry)
+            if len(group) == 1:
+                # the group's coalescing deadline enters the index once,
+                # at formation; a remainder left by a take re-pushes
+                heapq.heappush(self._due_heap, (
+                    entry.ticket.t_submit + self.max_wait_s,
+                    next(self._heap_seq), entry.ticket.key))
+            if entry.deadline is not None:
+                heapq.heappush(self._slo_heap,
+                               (entry.deadline, entry.seq, entry))
             self.load.note_arrival(entry.cost_bytes)
-            return len(group) >= self.max_batch
+            full = len(group) >= self.max_batch
+            if full:
+                self._full.add(entry.ticket.key)
+            return full
 
     def close_gate(self) -> None:
         """Refuse all future :meth:`offer` calls (atomic with the offer
@@ -253,29 +287,12 @@ class AdmissionQueue:
         now = time.monotonic() if now is None else now
         out: List[Batch] = []
         with self._lock:
-            for key in list(self._pending):
-                entries = self._pending[key]
-                live = [e for e in entries
-                        if e.deadline is None or now <= e.deadline]
-                if len(live) != len(entries):
-                    for e in entries:
-                        if e.deadline is not None and now > e.deadline:
-                            self._expired.append(e)
-                            self.load.note_removed(e.cost_bytes)
-                    entries = live
-                    self._pending[key] = entries
-                while len(entries) >= self.max_batch:
-                    take, entries = (entries[: self.max_batch],
-                                     entries[self.max_batch:])
-                    self._pending[key] = entries
-                    out.append(self._mk_batch(key, take, "full"))
-                if entries and (flush or now - entries[0].ticket.t_submit
-                                >= self.max_wait_s):
-                    del self._pending[key]
-                    out.append(self._mk_batch(
-                        key, entries, "flush" if flush else "deadline"))
-                elif not entries:
-                    del self._pending[key]
+            self._take_calls += 1
+            keys = (list(self._pending) if flush
+                    else self._due_keys_locked(now))
+            self._groups_scanned += len(keys)
+            for key in keys:
+                self._take_key_locked(key, now, flush, out)
         for b in out:
             b.cost = self._batch_cost(b)
             for e in b.entries:
@@ -288,6 +305,97 @@ class AdmissionQueue:
 
         out.sort(key=order)
         return out
+
+    def _take_key_locked(self, key: str, now: float, flush: bool,
+                         out: List[Batch]) -> None:
+        """The v1 per-group take body, verbatim semantics: shed
+        deadline-expired members, split full batches, take the rest if
+        due (or flushing).  Caller holds the lock and picked ``key``
+        from the index (or the full scan, under flush)."""
+        entries = self._pending.get(key)
+        if entries is None:
+            return
+        live = [e for e in entries
+                if e.deadline is None or now <= e.deadline]
+        if len(live) != len(entries):
+            for e in entries:
+                if e.deadline is not None and now > e.deadline:
+                    e.departed = True
+                    self._expired.append(e)
+                    self.load.note_removed(e.cost_bytes)
+            entries = live
+            self._pending[key] = entries
+        while len(entries) >= self.max_batch:
+            take, entries = (entries[: self.max_batch],
+                             entries[self.max_batch:])
+            self._pending[key] = entries
+            for e in take:
+                e.departed = True
+            out.append(self._mk_batch(key, take, "full"))
+        if entries and (flush or now - entries[0].ticket.t_submit
+                        >= self.max_wait_s):
+            del self._pending[key]
+            for e in entries:
+                e.departed = True
+            out.append(self._mk_batch(
+                key, entries, "flush" if flush else "deadline"))
+        elif not entries:
+            del self._pending[key]
+        if key in self._full and \
+                len(self._pending.get(key, ())) < self.max_batch:
+            self._full.discard(key)
+        remainder = self._pending.get(key)
+        if remainder:
+            # the survivors' coalescing deadline re-enters the index
+            # (their original due entry was consumed popping this key)
+            heapq.heappush(self._due_heap, (
+                remainder[0].ticket.t_submit + self.max_wait_s,
+                next(self._heap_seq), key))
+
+    def _due_keys_locked(self, now: float) -> List[str]:
+        """Every key that can yield work at ``now``: full groups,
+        groups whose coalescing deadline passed, and groups holding an
+        SLO-expired entry (the take-point shed must fire even when the
+        group itself is not due).  O(due + full + log n), NOT
+        O(groups) — the depth-stress fix.  Caller holds the lock."""
+        keys: List[str] = []
+        seen = set()
+        while self._slo_heap and self._slo_heap[0][0] <= now:
+            _, _, entry = heapq.heappop(self._slo_heap)
+            if entry.departed:
+                continue
+            k = entry.ticket.key
+            if k in self._pending and k not in seen:
+                seen.add(k)
+                keys.append(k)
+        for k in self._full:
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
+        while self._due_heap and self._due_heap[0][0] <= now:
+            _, _, k = heapq.heappop(self._due_heap)
+            group = self._pending.get(k)
+            if not group:
+                continue        # stale: the group was fully taken
+            actual = group[0].ticket.t_submit + self.max_wait_s
+            if actual > now:
+                # stale-but-live: the head that set this deadline left;
+                # re-index at the live head's deadline
+                heapq.heappush(self._due_heap,
+                               (actual, next(self._heap_seq), k))
+                continue
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
+        return keys
+
+    def scan_stats(self) -> dict:
+        """Take-path scan accounting — ``groups_scanned`` across
+        ``take_calls`` is what the depth-stress scaling assertion pins
+        (it must track DUE work, not queue breadth)."""
+        with self._lock:
+            return {"take_calls": self._take_calls,
+                    "groups_scanned": self._groups_scanned}
 
     @staticmethod
     def _mk_batch(key: str, entries: List[_Entry], reason: str) -> Batch:
@@ -320,12 +428,15 @@ class AdmissionQueue:
                 if len(keep) != len(entries):
                     for e in entries:
                         if e.shed_priority < protected_priority:
+                            e.departed = True
                             evicted.append(e)
                             self.load.note_removed(e.cost_bytes)
                     if keep:
                         self._pending[key] = keep
                     else:
                         del self._pending[key]
+                    if len(keep) < self.max_batch:
+                        self._full.discard(key)
         evicted.sort(key=lambda e: e.seq)
         return evicted
 
@@ -430,13 +541,32 @@ class AdmissionQueue:
         with self._lock:
             if not self._pending:
                 return None
-            due = min(v[0].ticket.t_submit + self.max_wait_s
-                      for v in self._pending.values() if v)
-            slo = [e.deadline for v in self._pending.values()
-                   for e in v if e.deadline is not None]
-            if slo:
-                due = min(due, min(slo))
-        return max(0.0, due - now)
+            due = None
+            while self._due_heap:
+                d, _, k = self._due_heap[0]
+                group = self._pending.get(k)
+                if not group:
+                    heapq.heappop(self._due_heap)
+                    continue
+                actual = group[0].ticket.t_submit + self.max_wait_s
+                if actual > d:
+                    # stale head: re-index at the live head's deadline
+                    heapq.heappop(self._due_heap)
+                    heapq.heappush(self._due_heap,
+                                   (actual, next(self._heap_seq), k))
+                    continue
+                due = d
+                break
+            while self._slo_heap and self._slo_heap[0][2].departed:
+                heapq.heappop(self._slo_heap)
+            if self._slo_heap:
+                sd = self._slo_heap[0][0]
+                due = sd if due is None else min(due, sd)
+        # every nonempty group holds a due-heap entry (pushed at
+        # formation and at every remainder), so due is None only when
+        # _pending emptied between the check and the walk — impossible
+        # under the lock; the guard is belt-and-braces
+        return max(0.0, due - now) if due is not None else None
 
     def depth(self, tenant: Optional[str] = None) -> int:
         with self._lock:

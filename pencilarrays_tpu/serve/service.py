@@ -954,11 +954,14 @@ class PlanService:
             # serve.dispatch record, no dispatch count
         batch.entries = survivors
         tenants = sorted({e.ticket.tenant for e in survivors})
+        writes = self._batch_resources(batch)
+        lane = self._lane_for(batch)
         if obs.enabled() and not resubmit:
             obs.record_event(
                 "serve.dispatch", key=batch.key, n=len(survivors),
                 tenants=tenants, score_bytes=batch.cost,
-                reason=batch.reason)
+                reason=batch.reason, lane=lane,
+                chain="|".join(writes) if writes else "*")
         with self._lock:
             if not resubmit:
                 self._dispatches += 1
@@ -985,10 +988,46 @@ class PlanService:
                 timing["s"] = time.perf_counter() - t0
 
         fut = self.engine().submit(
-            run, pack=pack, label=f"serve:{batch.key}", meta=meta)
+            run, pack=pack, label=f"serve:{batch.key}", meta=meta,
+            writes=writes, lane=lane)
         fut.add_done_callback(
             lambda f: self._complete_or_park(batch, f, timing))
         return fut
+
+    def _batch_resources(self, batch: Batch) -> tuple:
+        """The batch's declared engine write set — its dependency
+        chain.  One fingerprint = one chain: every dispatch of the
+        same plan (either direction — a backward may consume a
+        forward's output, so they are conservatively chained) orders
+        FIFO, while different tenants' different plans overlap.
+        Reshard batches chain on their coalesce route key (the
+        ``#solo`` suffix stripped: a solo-cost split still contends
+        for the same route)."""
+        if batch.kind == "fft":
+            return (f"plan:{batch.entries[0].plan.plan_key()}",)
+        return ("route:" + batch.key.split("#solo", 1)[0],)
+
+    def _lane_for(self, batch: Batch) -> int:
+        """The batch's engine priority lane: the max ``shed_priority``
+        among its entries' SLOs (the tier the shedding gate already
+        protects), plus one **urgency boost** when any member's
+        remaining deadline slack is under the queue's projected wait —
+        the batch that will MISS its SLO if it queues normally jumps
+        first.  Unpriced traffic (no SLOs armed) rides lane 0, where
+        the engine's FIFO tiebreak is exactly the v1 order."""
+        if not self._slo_armed:
+            return 0
+        lane = max((e.shed_priority for e in batch.entries), default=0)
+        deadlines = [e.deadline for e in batch.entries
+                     if e.deadline is not None]
+        if deadlines:
+            slack = min(deadlines) - time.monotonic()
+            projected = self.queue.load.projected_wait_s()
+            # projected is None until the tracker has a completion rate
+            # — no projection, no urgency verdict, no boost
+            if projected is not None and slack < projected:
+                lane += 1
+        return lane
 
     def _complete_or_park(self, batch: Batch, f, timing: dict) -> None:
         """A batch whose queued engine task was dropped typed by an

@@ -1,0 +1,384 @@
+"""Engine v2 task DAG (ISSUE 16): out-of-order issue on disjoint
+resource chains, SLO priority lanes with a starvation bound, explicit
+``after=`` edges, and the partial-order dispatch-log certification.
+
+Two halves:
+
+* **adversarial certification fixtures** — hand-built dispatch logs
+  fed straight to ``analysis.spmd.verify_dispatch_log``: an in-chain
+  inversion is fatal (typed ``DispatchOrderError`` naming the violated
+  chain edge), a cross-chain reorder certifies clean (and is counted),
+  a forged resource set — dispatched plan not declared in ``writes`` —
+  is caught, a barrier can never jump the log, a duplicate enqueue seq
+  is typed, and a v1 all-barrier log still verifies in total-order
+  mode;
+* **live-engine behavior** — disjoint chains issue out of order, lanes
+  bias the pick among ready tasks, ``starve_s`` bounds the bypass
+  (a starved task issues next REGARDLESS of lane), ``after=`` pins
+  cross-chain order and refuses cross-engine edges, ``dag=False`` (and
+  the ``PENCILARRAYS_TPU_ENGINE_DAG=0`` escape hatch) keep the exact
+  v1 total order, and a reform drops held lanes typed.
+"""
+
+import threading
+import time
+
+import pytest
+
+from pencilarrays_tpu import guard, obs
+from pencilarrays_tpu.analysis import spmd
+from pencilarrays_tpu.analysis.errors import (
+    DispatchOrderError,
+    ScheduleMismatchError,
+)
+from pencilarrays_tpu.engine import (
+    DispatchRecord,
+    Engine,
+    EngineReformedError,
+)
+from pencilarrays_tpu.engine import config as eng_config
+from pencilarrays_tpu.obs import events as obs_events
+from pencilarrays_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in (obs.ENV_VAR, guard.ENV_VAR, faults.ENV_VAR,
+                eng_config.ENGINE_WORKERS_VAR, eng_config.ENGINE_DAG_VAR,
+                eng_config.ENGINE_STARVE_VAR):
+        monkeypatch.delenv(var, raising=False)
+    obs_events._reset_for_tests()
+    yield
+    obs_events._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# adversarial certification fixtures
+# ---------------------------------------------------------------------------
+
+
+def _rec(enqueue_seq, issue_seq, label, **kw):
+    kw.setdefault("outcome", "ok")
+    return DispatchRecord(enqueue_seq=enqueue_seq, issue_seq=issue_seq,
+                          label=label, queued_s=0.0, run_s=0.0,
+                          outcome=kw.pop("outcome"), **kw)
+
+
+def _chain_rec(enqueue_seq, issue_seq, label, res, deps=()):
+    return _rec(enqueue_seq, issue_seq, label, barrier=False,
+                chain=res, writes=(res,), deps=tuple(deps))
+
+
+def test_v1_total_order_log_still_verifies():
+    # an all-barrier log (every pre-v2 engine, every old pickle) takes
+    # the total-order path: strictly ascending passes, an inversion is
+    # the same typed error PR 12 pinned
+    log = [_rec(i, i, f"s{i}") for i in range(1, 5)]
+    out = spmd.verify_dispatch_log(log, source="t", verify_traces=False)
+    assert out["mode"] == "total"
+    assert out["order_ok"] and out["dispatches"] == 4
+    bad = [log[0], log[2], log[1], log[3]]
+    with pytest.raises(DispatchOrderError) as ei:
+        spmd.verify_dispatch_log(bad, source="t", verify_traces=False)
+    assert ei.value.position == 2
+
+
+def test_cross_chain_reorder_certifies_clean():
+    # chains a and b are disjoint: b1 issuing before a1 is the whole
+    # POINT of v2 — certified clean, counted in "reordered"
+    log = [
+        _chain_rec(2, 1, "b1", "b"),
+        _chain_rec(1, 2, "a1", "a"),
+        _chain_rec(3, 3, "a2", "a", deps=(1,)),
+    ]
+    out = spmd.verify_dispatch_log(log, source="t", verify_traces=False)
+    assert out["mode"] == "partial"
+    assert out["order_ok"]
+    assert out["chains"] == 2
+    assert out["reordered"] == 1
+
+
+def test_in_chain_inversion_is_fatal():
+    # a2 issued before a1 on the SAME chain: the SPMD collective-order
+    # invariant is broken — typed, naming the violated edge
+    log = [
+        _chain_rec(2, 1, "a2", "a", deps=(1,)),
+        _chain_rec(1, 2, "a1", "a"),
+    ]
+    with pytest.raises(DispatchOrderError) as ei:
+        spmd.verify_dispatch_log(log, source="t", verify_traces=False)
+    assert ei.value.chain == "a"
+    assert ei.value.dep_seq == 1
+    assert ei.value.observed_seq == 2
+
+
+def test_recomputed_edges_catch_undeclared_deps():
+    # the verifier RECOMPUTES hazards from the declared resource sets —
+    # a log whose recorded deps were scrubbed still fails on the
+    # recomputed WAW edge
+    log = [
+        _chain_rec(2, 1, "a2", "a"),        # deps forged empty
+        _chain_rec(1, 2, "a1", "a"),
+    ]
+    with pytest.raises(DispatchOrderError):
+        spmd.verify_dispatch_log(log, source="t", verify_traces=False)
+
+
+def test_barrier_cannot_jump_the_log():
+    # a barrier conflicts with EVERYTHING: chain work enqueued after it
+    # issuing before it is fatal even though the chains are disjoint
+    log = [
+        _chain_rec(1, 1, "a1", "a"),
+        _chain_rec(3, 2, "a2", "a", deps=(1, 2)),
+        _rec(2, 3, "bar"),                  # barrier issued LAST
+    ]
+    with pytest.raises(DispatchOrderError) as ei:
+        spmd.verify_dispatch_log(log, source="t", verify_traces=False)
+    assert ei.value.chain == "*"
+
+
+def test_duplicate_enqueue_seq_is_typed():
+    log = [
+        _chain_rec(1, 1, "a1", "a"),
+        _chain_rec(1, 2, "dup", "b"),
+    ]
+    with pytest.raises(DispatchOrderError):
+        spmd.verify_dispatch_log(log, source="t", verify_traces=False)
+
+
+class _StubPlan:
+    def plan_key(self):
+        return "feedc0de"
+
+
+def test_forged_resource_set_is_caught():
+    # a non-barrier record that DISPATCHED a plan but never declared
+    # the matching plan:<fp> write lied about its chain membership —
+    # the partial-order proof above it proved the wrong graph
+    forged = _rec(1, 1, "fft", barrier=False, chain="route:x",
+                  writes=("route:x",), meta={"plan": _StubPlan()})
+    with pytest.raises(ScheduleMismatchError) as ei:
+        spmd.verify_dispatch_log([forged], source="t",
+                                 verify_traces=False)
+    assert "resource-set" in str(ei.value)
+    honest = _rec(1, 1, "fft", barrier=False, chain="plan:feedc0de",
+                  writes=("plan:feedc0de",), meta={"plan": _StubPlan()})
+    out = spmd.verify_dispatch_log([honest], source="t",
+                                   verify_traces=False)
+    assert out["order_ok"] and out["mode"] == "partial"
+
+
+# ---------------------------------------------------------------------------
+# live-engine behavior
+# ---------------------------------------------------------------------------
+
+
+def _labels(engine):
+    return [r.label for r in engine.dispatch_log()]
+
+
+def test_disjoint_chains_issue_out_of_order():
+    # a1 holds the consumer; by completion a2 (chain a) and b (chain b,
+    # lane 1) are both queued — b is ready and outranks a2, so it
+    # issues first despite the later enqueue seq
+    e = Engine("dag-ooo", workers=2)
+    try:
+        assert e.dag
+        fa1 = e.submit(lambda: time.sleep(0.15), label="a1",
+                       writes=("a",))
+        fa2 = e.submit(lambda: None, label="a2", writes=("a",))
+        fb = e.submit(lambda: None, label="b", writes=("b",), lane=1)
+        for f in (fa1, fa2, fb):
+            f.result(30)
+        assert e.drain(30)
+        labels = _labels(e)
+        assert labels.index("b") < labels.index("a2")
+        assert labels.index("a1") < labels.index("a2")
+        st = e.stats()
+        assert st["out_of_order"] >= 1
+        cert = spmd.verify_dispatch_log(e.dispatch_log(),
+                                        source="dag-ooo")
+        assert cert["mode"] == "partial"
+        assert cert["order_ok"] and cert["reordered"] >= 1
+    finally:
+        e.close()
+
+
+def test_lane_bias_picks_high_lane_first():
+    # behind a plug barrier, a whale chain and one lane-1 minnow all
+    # become ready at once: the minnow issues immediately after the
+    # plug, ahead of every whale enqueued before it
+    e = Engine("dag-lane", workers=2, starve_s=30.0)
+    try:
+        plug = e.submit(lambda: time.sleep(0.25), label="plug")
+        whales = [e.submit(lambda: None, label=f"w{i}",
+                           writes=("plan:whale",)) for i in range(3)]
+        minnow = e.submit(lambda: None, label="m", writes=("plan:m",),
+                          lane=1)
+        for f in [plug, minnow] + whales:
+            f.result(30)
+        assert e.drain(30)
+        assert _labels(e) == ["plug", "m", "w0", "w1", "w2"]
+    finally:
+        e.close()
+
+
+def test_starvation_bound_overrides_lanes():
+    # starve_s=0 makes every queued task immediately starved: the pick
+    # degenerates to strict enqueue order EVEN against a higher lane —
+    # the bound guarantees progress >= v1 for any lane mix
+    e = Engine("dag-starve", workers=2, starve_s=0.0)
+    try:
+        plug = e.submit(lambda: time.sleep(0.2), label="plug")
+        lo = e.submit(lambda: None, label="lo", writes=("x",))
+        hi = e.submit(lambda: None, label="hi", writes=("y",), lane=5)
+        for f in (plug, lo, hi):
+            f.result(30)
+        assert e.drain(30)
+        assert _labels(e) == ["plug", "lo", "hi"]
+        assert e.stats()["starved_issues"] >= 1
+    finally:
+        e.close()
+
+
+def test_after_edges_pin_cross_chain_order():
+    # chains a and b are disjoint, so b COULD issue first — the
+    # explicit after= edge pins it behind a, and the edge lands in the
+    # record's deps so the verifier audits it too
+    e = Engine("dag-after", workers=2)
+    try:
+        fa = e.submit(lambda: time.sleep(0.1), label="a",
+                      writes=("a",))
+        fb = e.submit(lambda: None, label="b", writes=("b",),
+                      lane=1, after=[fa])
+        fb.result(30)
+        assert e.drain(30)
+        labels = _labels(e)
+        assert labels.index("a") < labels.index("b")
+        rec_b = next(r for r in e.dispatch_log() if r.label == "b")
+        assert fa._pa_seq in rec_b.deps
+        # and the recorded edge is load-bearing in certification: the
+        # same two records with the issue order flipped are fatal
+        rec_a = next(r for r in e.dispatch_log() if r.label == "a")
+        with pytest.raises(DispatchOrderError):
+            spmd.verify_dispatch_log([rec_b, rec_a], source="t",
+                                     verify_traces=False)
+    finally:
+        e.close()
+
+
+def test_after_refuses_cross_engine_edges():
+    e1 = Engine("dag-x1", workers=2)
+    e2 = Engine("dag-x2", workers=2)
+    try:
+        f1 = e1.submit(lambda: None, label="t1", writes=("a",))
+        with pytest.raises(ValueError, match="cross-engine"):
+            e2.submit(lambda: None, label="t2", writes=("b",),
+                      after=[f1])
+        f1.result(30)
+    finally:
+        e1.close()
+        e2.close()
+
+
+def test_dag_off_keeps_total_order(monkeypatch):
+    # the multi-controller escape hatch: PENCILARRAYS_TPU_ENGINE_DAG=0
+    # makes every task a barrier no matter what it declares — the
+    # exact v1 total order, still certifiable in total mode
+    monkeypatch.setenv(eng_config.ENGINE_DAG_VAR, "0")
+    e = Engine("dag-off", workers=2)
+    try:
+        assert not e.dag
+        futs = [e.submit(lambda: None, label=f"t{i}",
+                         writes=("a" if i % 2 else "b",), lane=i % 3)
+                for i in range(6)]
+        for f in futs:
+            f.result(30)
+        assert e.drain(30)
+        assert _labels(e) == [f"t{i}" for i in range(6)]
+        assert all(r.barrier for r in e.dispatch_log())
+        assert e.stats()["out_of_order"] == 0
+        cert = spmd.verify_dispatch_log(e.dispatch_log(),
+                                        source="dag-off")
+        assert cert["mode"] == "total" and cert["order_ok"]
+    finally:
+        e.close()
+
+
+def test_reform_drops_held_lanes_typed():
+    # a reform quiesces the consumer and drops every HELD dispatch
+    # typed — including non-barrier DAG tasks parked across lanes —
+    # and the fresh generation starts with an empty graph
+    e = Engine("dag-reform", workers=2)
+    try:
+        plug = e.submit(lambda: time.sleep(0.3), label="plug")
+        held = [e.submit(lambda: None, label=f"h{i}",
+                         writes=("a",), lane=i % 2) for i in range(4)]
+        time.sleep(0.05)            # plug is in flight, h* are held
+        e.reform()
+        plug.result(30)             # in-flight work finishes
+        for f in held:
+            with pytest.raises(EngineReformedError):
+                f.result(30)
+        st = e.stats()
+        assert st["queued"] == 0 and st["ready"] == 0
+        assert not st["lanes"]
+        f2 = e.submit(lambda: 7, label="fresh", writes=("a",))
+        assert f2.result(30) == 7
+    finally:
+        e.close()
+
+
+def test_lane_gauges_emitted(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.ENV_VAR, str(tmp_path / "obs"))
+    obs_events._reset_for_tests()
+    e = Engine("dag-gauge", workers=2)
+    try:
+        fa = e.submit(lambda: None, label="a", writes=("a",))
+        fb = e.submit(lambda: None, label="b", writes=("b",), lane=2)
+        fa.result(30)
+        fb.result(30)
+        assert e.drain(30)
+        gauges = obs.snapshot()["gauges"]
+        assert any(k.startswith("engine.lanes{") and "lane=2" in k
+                   for k in gauges), gauges
+        assert any(k.startswith("engine.ready_tasks{")
+                   for k in gauges), gauges
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# bench arms (smoke)
+# ---------------------------------------------------------------------------
+
+
+def test_depth_stress_smoke():
+    from benchmarks.exec_bench import run_depth_stress
+
+    res = run_depth_stress(depths=(500, 2000), ticks=20)
+    assert res["idle_scan_flat"]
+    for d in res["depths"]:
+        assert d["idle_groups_scanned"] == 0
+        assert d["burst_batches"] == d["depth"] // res["per_group"]
+
+
+@pytest.mark.slow
+def test_mixed_traffic_drill_smoke():
+    """The BENCH_EXEC mixed-traffic harness runs end to end at toy
+    scale: both arms certified (v2 partial-order with zero in-chain
+    inversions, v1 total-order), minnows jump the whale backlog, and
+    reorders actually happened.  The committed artifact's magnitudes
+    are the full-scale run's claim, not this smoke's."""
+    from benchmarks.exec_bench import run_mixed_traffic_drill
+
+    res = run_mixed_traffic_drill(n_whale=16, n_minnow=4,
+                                  whale_ms=6.0, minnow_ms=0.5,
+                                  repeats=1)
+    assert res["v2_certified_partial_order"]
+    assert res["v1_certified_total_order"]
+    assert res["v1"]["dispatch_log"]["order_ok"]
+    assert res["v2"]["dispatch_log"]["order_ok"]
+    assert res["v2"]["overlap_fraction"] > 0
+    assert res["v1"]["out_of_order"] == 0
+    assert res["minnow_p99_improved"]

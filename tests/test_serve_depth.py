@@ -1,0 +1,173 @@
+"""Depth stress for the admission-queue take path (PR 16).
+
+The ROADMAP flagged that ``AdmissionQueue.take_ready`` and the
+``LoadTracker`` projections had never been exercised past a handful of
+queued entries.  At 10^4 the v1 take path went superlinear: every tick
+rescanned EVERY pending group — O(groups) per call even when nothing
+was due.  The fix indexes the take path (a full-group set, a lazy
+coalesce-deadline heap, a lazy SLO-deadline heap) so a tick touches
+only groups that can yield work.
+
+The scaling pin is COUNTER-based, not wall-clock-based:
+``AdmissionQueue.scan_stats()["groups_scanned"]`` must track due work,
+not queue breadth — deterministic on any CI machine.  Batch formation
+and dispatch ordering are pinned unchanged by tests/test_serve.py; this
+file only pins what the take path *scans*.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from pencilarrays_tpu.serve.queue import (
+    AdmissionQueue,
+    TenantQuota,
+    Ticket,
+    _Entry,
+)
+
+BIG = TenantQuota(max_requests=1 << 20, max_bytes=1 << 50)
+
+
+def _entry(key: str, base: float, *, tenant: str = "t",
+           deadline: float = None) -> _Entry:
+    t = Ticket(tenant, "fft", key)
+    t.t_submit = base
+    return _Entry(ticket=t, plan=None, direction="forward",
+                  payload=None, nbytes=1, plan_name=None,
+                  deadline=deadline)
+
+
+def _fill(q: AdmissionQueue, n_groups: int, per_group: int,
+          base: float, prefix: str = "k") -> None:
+    for g in range(n_groups):
+        for _ in range(per_group):
+            q.offer(_entry(f"{prefix}{g}", base))
+
+
+def test_idle_ticks_scan_nothing_at_depth():
+    # 10^4 queued entries, none due, none full: a hundred ticks must
+    # not scan a single group (v1 scanned 2000 * 100)
+    base = time.monotonic()
+    q = AdmissionQueue(max_batch=8, max_wait_s=10.0,
+                       default_quota=BIG)
+    _fill(q, n_groups=2000, per_group=5, base=base)
+    assert q.depth() == 10_000
+    for _ in range(100):
+        assert q.take_ready(now=base + 0.5) == []
+    s = q.scan_stats()
+    assert s["take_calls"] == 100
+    assert s["groups_scanned"] == 0
+
+
+def test_due_tick_scans_exactly_the_due_groups():
+    base = time.monotonic()
+    q = AdmissionQueue(max_batch=8, max_wait_s=1.0,
+                       default_quota=BIG)
+    _fill(q, n_groups=50, per_group=5, base=base)           # due at +1
+    _fill(q, n_groups=30, per_group=5, base=base + 100.0,
+          prefix="late")                                    # much later
+    batches = q.take_ready(now=base + 2.0)
+    # only the 50 due groups were touched; 30 not-due groups unscanned
+    assert q.scan_stats()["groups_scanned"] == 50
+    assert len(batches) == 50
+    assert all(b.reason == "deadline" for b in batches)
+    assert q.depth() == 150
+    # the next idle tick scans nothing again
+    assert q.take_ready(now=base + 2.5) == []
+    assert q.scan_stats()["groups_scanned"] == 50
+
+
+def test_full_group_surfaces_without_scanning_neighbors():
+    base = time.monotonic()
+    q = AdmissionQueue(max_batch=8, max_wait_s=10.0,
+                       default_quota=BIG)
+    _fill(q, n_groups=999, per_group=5, base=base)
+    full = [q.offer(_entry("whale", base)) for _ in range(8)]
+    assert full[-1] is True         # offer's fast-path signal
+    batches = q.take_ready(now=base + 0.01)
+    assert [b.key for b in batches] == ["whale"]
+    assert batches[0].reason == "full"
+    assert q.scan_stats()["groups_scanned"] == 1
+
+
+def test_slo_expiry_wakes_only_the_affected_group():
+    base = time.monotonic()
+    q = AdmissionQueue(max_batch=8, max_wait_s=50.0,
+                       default_quota=BIG)
+    _fill(q, n_groups=500, per_group=2, base=base)
+    q.offer(_entry("doomed", base, deadline=base + 0.1))
+    q.take_ready(now=base + 0.5)
+    assert q.scan_stats()["groups_scanned"] == 1
+    dead = q.pop_expired()
+    assert [e.ticket.key for e in dead] == ["doomed"]
+
+
+def test_next_ready_in_is_heap_backed_and_correct():
+    base = time.monotonic()
+    q = AdmissionQueue(max_batch=8, max_wait_s=2.0,
+                       default_quota=BIG)
+    assert q.next_ready_in(now=base) is None
+    _fill(q, n_groups=1000, per_group=10, base=base + 5.0)
+    q.offer(_entry("old", base))    # the oldest head: due at +2
+    got = q.next_ready_in(now=base + 1.0)
+    assert got == pytest.approx(1.0, abs=1e-6)
+    # an SLO deadline tighter than every coalesce deadline wins
+    q.offer(_entry("slo", base + 5.0, deadline=base + 1.2))
+    got = q.next_ready_in(now=base + 1.0)
+    assert got == pytest.approx(0.2, abs=1e-6)
+    # taking the due group re-arms to the next coalesce deadline
+    q.take_ready(now=base + 2.0)
+    assert q.next_ready_in(now=base + 2.0) == pytest.approx(
+        5.0, abs=1e-6)
+
+
+def test_remainder_after_full_split_reenters_the_index():
+    base = time.monotonic()
+    q = AdmissionQueue(max_batch=4, max_wait_s=1.0,
+                       default_quota=BIG)
+    for _ in range(6):
+        q.offer(_entry("k", base))
+    batches = q.take_ready(now=base + 0.01)     # full split: 4 taken
+    assert [b.reason for b in batches] == ["full"]
+    assert q.depth() == 2
+    # the 2-entry remainder must still coalesce out at its deadline
+    batches = q.take_ready(now=base + 2.0)
+    assert [len(b.entries) for b in batches] == [2]
+    assert q.depth() == 0
+
+
+def test_load_tracker_projections_hold_at_depth():
+    # the LoadTracker half of the ROADMAP flag: feeding 10^4 entries
+    # and reading every projection stays O(window), no error, sane
+    # values (its internals are deques — this pins the integration)
+    base = time.monotonic()
+    q = AdmissionQueue(max_batch=8, max_wait_s=10.0,
+                       default_quota=BIG)
+    for i in range(10_000):
+        e = _entry(f"k{i % 100}", base)
+        e.cost_bytes = 1000
+        q.offer(e)
+    snap = q.load.snapshot()
+    assert snap["queued_cost_bytes"] == 10_000 * 1000
+    q.load.note_completed(50 * 1000, 50, 0.5)
+    assert q.load.projected_wait_s() is not None
+    assert q.load.drain_s() is not None
+
+
+def test_scan_work_tracks_due_work_not_depth():
+    # THE scaling assertion: double the idle depth, the scan work of a
+    # tick burst must not grow at all (v1 grew linearly)
+    def scans_at(n_groups: int) -> int:
+        base = time.monotonic()
+        q = AdmissionQueue(max_batch=8, max_wait_s=10.0,
+                          default_quota=BIG)
+        _fill(q, n_groups=n_groups, per_group=5, base=base)
+        for _ in range(50):
+            q.take_ready(now=base + 0.5)
+        return q.scan_stats()["groups_scanned"]
+
+    assert scans_at(200) == 0
+    assert scans_at(2000) == 0
