@@ -753,6 +753,19 @@ def main():
     parser.add_argument("--autoscale-only", action="store_true",
                         help="run ONLY the --autoscale arm (used to "
                              "commit the BENCH_AUTOSCALE.json artifact)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="also run the fleet-federation arm "
+                             "(benchmarks/fleet_bench.py): routing "
+                             "decision latency over N live mesh "
+                             "exports, the failover MTTR breakdown "
+                             "(detect/rebind/resolve with exactly-"
+                             "once asserted), and shed precision/"
+                             "recall with typed AdmissionError "
+                             "crossing the KV wire; writes "
+                             "BENCH_FLEET.json")
+    parser.add_argument("--fleet-only", action="store_true",
+                        help="run ONLY the --fleet arm (used to "
+                             "commit the BENCH_FLEET.json artifact)")
     parser.add_argument("--engine", action="store_true",
                         help="also run the async-executor arm "
                              "(benchmarks/exec_bench.py): pipelined "
@@ -938,6 +951,30 @@ def main():
                          "n_devices": len(devs)},
                         "BENCH_AUTOSCALE.json", devs=devs)
         if args.autoscale_only:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+            print(json.dumps(results, indent=1))
+            return
+
+    # -- 18. fleet: multi-mesh federation (opt-in) -------------------------
+    # The ISSUE 17 headline: a routing decision is microseconds-scale
+    # front-end work; whole-mesh loss is detected lease-bounded (~ttl,
+    # never a watchdog) and healed with every ticket resolved exactly
+    # once; the PR-15 shedding gate's typed AdmissionError survives the
+    # KV wire hop — committed as BENCH_FLEET.json.
+    if args.fleet or args.fleet_only:
+        import tempfile
+
+        from benchmarks.fleet_bench import run_fleet_suite
+        from benchmarks.fleet_bench import write_artifact as write_fleet
+
+        with tempfile.TemporaryDirectory() as wd:
+            results["fleet"] = run_fleet_suite(devs, workdir=wd)
+        write_fleet({**results["fleet"],
+                     "platform": devs[0].platform,
+                     "n_devices": len(devs)},
+                    "BENCH_FLEET.json", devs=devs)
+        if args.fleet_only:
             with open(args.out, "w") as f:
                 json.dump(results, f, indent=1)
             print(json.dumps(results, indent=1))

@@ -86,7 +86,7 @@ DEFAULT_ALLOWLIST = "pa-lint.allow"
 
 # the daemon-bearing packages whose module-level mutable state the
 # unlocked-state check audits
-DAEMON_PACKAGES = ("obs", "cluster", "serve", "engine")
+DAEMON_PACKAGES = ("obs", "cluster", "serve", "engine", "fleet")
 
 # the one package allowed to construct threads (thread-spawn check)
 THREAD_PACKAGE = "engine"
@@ -98,8 +98,9 @@ _MUTATING_METHODS = frozenset({
     "remove", "discard", "popitem", "insert", "appendleft",
 })
 
-CHECKS = ("journal-event", "env-knob", "plan-cache", "fault-point",
-          "unlocked-state", "thread-spawn", "wire-cast", "hop-peak")
+CHECKS = ("journal-event", "fleet-event", "env-knob", "plan-cache",
+          "fault-point", "unlocked-state", "thread-spawn", "wire-cast",
+          "hop-peak")
 
 # the exchange-program sources the wire-cast check audits: whole
 # modules whose traced bodies build exchange programs, plus named
@@ -364,6 +365,64 @@ def _check_journal_events(root: str, trees: Dict[str, ast.Module],
                     arg.value,
                     f"record_event({arg.value!r}, ...) is not "
                     f"registered in obs/schema.py EVENT_TYPES"))
+
+
+def _check_fleet_events(root: str, trees: Dict[str, ast.Module],
+                        findings: List[Finding]) -> None:
+    """The ``fleet.*`` journal namespace is owned by ``fleet/`` and
+    fully registered — both directions:
+
+    * inside ``fleet/``, every ``record_event`` name must be a string
+      LITERAL (a dynamic name would dodge the static registry check —
+      in the package whose events gate failover, that is not
+      acceptable debt) that is registered and lives in the ``fleet.``
+      namespace (fleet modules never journal another layer's events);
+    * outside ``fleet/``, emitting a ``fleet.*`` event is a finding —
+      the fleet timeline must be attributable to the fleet layer.
+
+    Unregistered literals anywhere are already journal-event findings;
+    this check adds the namespace-ownership and no-dynamic-names
+    invariants the fleet drills assert on."""
+    events = registered_events(root)
+    fleet_prefix = os.path.join(root, PACKAGE, "fleet") + os.sep
+    for path, tree in trees.items():
+        in_fleet = path.startswith(fleet_prefix)
+        dotted = _module_dotted(root, path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_record_event_call(node) and node.args):
+                continue
+            arg = node.args[0]
+            literal = (arg.value
+                       if isinstance(arg, ast.Constant)
+                       and isinstance(arg.value, str) else None)
+            if in_fleet:
+                if literal is None:
+                    findings.append(Finding(
+                        "fleet-event", _rel(root, path), node.lineno,
+                        f"{dotted}:dynamic",
+                        "record_event with a non-literal event name "
+                        "in fleet/ — fleet events must be statically "
+                        "checkable against obs/schema.py"))
+                elif not literal.startswith("fleet."):
+                    findings.append(Finding(
+                        "fleet-event", _rel(root, path), node.lineno,
+                        literal,
+                        f"fleet/ journals non-fleet event "
+                        f"{literal!r} — the fleet layer owns (only) "
+                        f"the fleet.* namespace"))
+                elif literal not in events:
+                    findings.append(Finding(
+                        "fleet-event", _rel(root, path), node.lineno,
+                        literal,
+                        f"unregistered fleet event {literal!r} "
+                        f"(register it in obs/schema.py EVENT_TYPES)"))
+            elif literal is not None and literal.startswith("fleet."):
+                findings.append(Finding(
+                    "fleet-event", _rel(root, path), node.lineno,
+                    literal,
+                    f"{literal!r} journaled outside fleet/ — fleet.* "
+                    f"events must be attributable to the fleet layer"))
 
 
 def _check_env_knobs(root: str, trees: Dict[str, ast.Module],
@@ -722,6 +781,7 @@ def lint_tree(root: str) -> List[Finding]:
             docs_resilience = f.read()
     findings: List[Finding] = []
     _check_journal_events(root, trees, findings)
+    _check_fleet_events(root, trees, findings)
     _check_env_knobs(root, trees, docs, findings)
     _check_plan_caches(root, trees, findings)
     _check_fault_points(root, trees, docs_resilience, findings)

@@ -1,0 +1,50 @@
+"""Multi-mesh fleet federation — routing, health, failover, scaling.
+
+Everything below this package assumes ONE resident mesh; this layer
+federates N of them.  A :class:`~pencilarrays_tpu.fleet.router.
+FleetRouter` owns client admission and places requests across N
+:class:`~pencilarrays_tpu.serve.PlanService` back-ends over the
+existing KV wire, priced through the two-tier ICI/DCN cost model
+(:mod:`~pencilarrays_tpu.fleet.cost` — intra-mesh traffic is cheap,
+cross-mesh moves pay the data-center network, following AccFFT's
+hierarchy framing).  Per-mesh health leases
+(:mod:`~pencilarrays_tpu.fleet.health`) turn whole-mesh death into a
+typed :class:`~pencilarrays_tpu.fleet.errors.MeshFailureError` in
+~ttl seconds, and failover re-binds the dead mesh's tickets to a
+sibling — every submitted request still resolves exactly once.  The
+flagged :class:`~pencilarrays_tpu.fleet.scale.FleetSupervisor` turns
+the autoscaler's journaled ``acted=false`` demand signals into
+actually-launched workers.  See ``docs/Fleet.md``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .cost import FleetCost
+from .errors import FleetError, MeshFailureError, MeshLeftError
+from .health import MeshBoard, MeshLease
+from .router import FleetRouter
+from .scale import FleetSupervisor
+from .worker import MeshWorker
+
+__all__ = [
+    "FleetCost", "FleetError", "FleetRouter", "FleetSupervisor",
+    "MeshBoard", "MeshFailureError", "MeshLease", "MeshLeftError",
+    "MeshWorker", "mesh_id", "MESH_ENV",
+]
+
+# this process's fleet mesh identity, for the faults layer's %mesh<k>
+# selector (a sibling of the cluster layer's rank resolution): worker
+# launchers set it; a process that never joined a mesh answers -1 and
+# matches no %mesh rule
+MESH_ENV = "PENCILARRAYS_TPU_FLEET_MESH"
+
+
+def mesh_id() -> int:
+    """This process's mesh id (``PENCILARRAYS_TPU_FLEET_MESH``, else
+    -1 = not a mesh worker)."""
+    try:
+        return int(os.environ[MESH_ENV])
+    except (KeyError, ValueError):
+        return -1

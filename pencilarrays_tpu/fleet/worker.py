@@ -1,0 +1,226 @@
+"""The mesh-side fleet back-end: one PlanService behind the KV wire.
+
+A :class:`MeshWorker` is what a back-end mesh's coordinator process
+runs: it heartbeats the mesh's health lease
+(:class:`~pencilarrays_tpu.fleet.health.MeshLease`), exports its
+service's :class:`~pencilarrays_tpu.serve.slo.LoadTracker` projection
+and warm plan fingerprints for the router's placement scoring, polls
+its ``req/m<k>`` directory for routed requests, executes them through
+the wrapped :class:`~pencilarrays_tpu.serve.PlanService`, and
+publishes results.
+
+The worker consults the ``fleet.route`` fault point once per routed
+request it takes — with ``PENCILARRAYS_TPU_FLEET_MESH`` set in the
+worker's environment, a single shared spec like
+``fleet.route:kill%mesh1@4`` SIGKILLs exactly mesh 1's admission path
+on its 4th routed request and nobody else's (the whole-mesh chaos
+drill).
+
+Request keys are deleted only AFTER the result is published (the
+result key is the commit point): a worker that dies between the two
+leaves a request whose result already exists, and both the router and
+a replacement worker treat the published result as authoritative —
+execution is at-least-once under failover (FFT dispatch is pure), but
+every ticket *resolves* exactly once on the router side.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Iterable, Optional
+
+import numpy as np
+
+from . import wire
+from .health import MeshLease
+
+__all__ = ["MeshWorker"]
+
+
+class MeshWorker:
+    """One mesh's back-end loop (owns nothing it was not given: the
+    ``service`` — and through it the engine/topology — is built by the
+    caller so drills, benches and deployments control their own mesh
+    shape)."""
+
+    def __init__(self, kv, mesh: int, *, service, namespace: str = "pa",
+                 ttl: float = 5.0, interval: Optional[float] = None,
+                 tier: str = "dcn", result_timeout_s: float = 60.0,
+                 load_every_s: float = 0.05):
+        self.kv = kv
+        self.mesh = int(mesh)
+        self.service = service
+        self.ns = namespace
+        self.tier = tier
+        self.result_timeout_s = float(result_timeout_s)
+        self.load_every_s = float(load_every_s)
+        self.lease = MeshLease(kv, self.mesh, ttl=ttl,
+                               interval=interval, namespace=namespace)
+        self._warm: set = set()     # plan names executed at least once
+        self._handled = 0
+        self._stopped = False
+        self._t_load = 0.0
+
+    # -- placement inputs ---------------------------------------------------
+    def prewarm(self, names: Iterable[str]) -> None:
+        """Mark plan names as compile-warm without executing them —
+        what a mesh restored from a compile cache (or deliberately
+        prewarmed, see ``Autoscaler.prewarm_plans``) advertises."""
+        self._warm.update(names)
+
+    def publish_load(self, *, force: bool = False) -> None:
+        """Export this mesh's placement inputs: the service's live
+        load projection plus the name->fingerprint map and the warm
+        set (``plan_key()`` strings — the compile-cache locality term
+        of the router's scoring)."""
+        now = time.time()
+        if not force and now - self._t_load < self.load_every_s:
+            return
+        self._t_load = now
+        plans = {}
+        for name, plan in getattr(self.service, "_named", {}).items():
+            try:
+                plans[name] = plan.plan_key()
+            except Exception:   # pragma: no cover - a broken plan must
+                continue        # not unpublish the healthy ones
+        warm = sorted(plans[n] for n in self._warm if n in plans)
+        self.kv.set(wire.load_key(self.ns, self.mesh), json.dumps({
+            "t": now, "mesh": self.mesh, "tier": self.tier,
+            "projection": self.service.load_projection(),
+            "plans": plans, "warm": warm,
+        }))
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        """First beat + load export synchronously (the router must be
+        able to place onto this mesh the moment ``start`` returns),
+        then heartbeat from a daemon thread."""
+        self.lease.start()
+        self.publish_load(force=True)
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.lease.stop()
+
+    def leave(self) -> None:
+        """Graceful retire: durable leave record, then stop."""
+        self.lease.leave()
+        self._stopped = True
+
+    def close(self) -> None:
+        self.stop()
+        self.service.close(drain=False)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    @property
+    def handled(self) -> int:
+        return self._handled
+
+    # -- the wire loop ------------------------------------------------------
+    def step(self) -> int:
+        """One poll round: honor a stop signal, take every pending
+        routed request, execute through the service, publish results.
+        Returns the number of requests completed this round."""
+        if self._stopped:
+            return 0
+        if self.kv.try_get(wire.stop_key(self.ns, self.mesh)) is not None:
+            self.leave()
+            return 0
+        taken: list = []
+        for key in sorted(self.kv.list_dir(wire.req_dir(self.ns,
+                                                        self.mesh))):
+            tid = wire.ticket_id_of(key)
+            if self.kv.try_get(wire.res_key(self.ns, tid)) is not None:
+                # a predecessor died between publish and req-GC: the
+                # result is authoritative, never re-execute
+                self.kv.delete(key)
+                continue
+            raw = self.kv.try_get(key)
+            if raw is None:
+                continue        # router re-bound it away mid-listing
+            try:
+                req = wire.decode_request(raw)
+            except Exception:   # pragma: no cover - a torn publish is
+                continue        # retried by the next poll
+            if self._take(key, tid, req):
+                taken.append((key, tid, req))
+        done = 0
+        if taken:
+            self.service.drain()
+            for key, tid, req in taken:
+                self._publish(key, tid, req)
+                done += 1
+        self.publish_load(force=bool(taken))
+        return done
+
+    def _take(self, key: str, tid: str, req: dict) -> bool:
+        """Admit one routed request into the service (the mesh's
+        admission path — the ``fleet.route`` injection point fires
+        here, addressable per mesh via ``%mesh<k>``).  Returns False
+        when the request resolved typed at admission."""
+        from ..resilience import faults
+        from ..serve.errors import ServeError
+
+        self._handled += 1
+        try:
+            faults.fire("fleet.route", mesh=self.mesh, ticket=tid,
+                        tenant=req["tenant"])
+            ticket = self.service.submit(
+                req["tenant"], np.ascontiguousarray(req["payload"]),
+                name=req["name"], direction=req["direction"])
+        except Exception as e:
+            if not isinstance(e, (ServeError, faults.InjectedFault)):
+                raise
+            self.kv.set(wire.res_key(self.ns, tid),
+                        wire.encode_result(tid, error=e,
+                                           mesh=self.mesh))
+            self.kv.delete(key)
+            return False
+        req["_ticket"] = ticket
+        req["_t0"] = time.monotonic()
+        self._warm.add(req["name"])
+        return True
+
+    def _publish(self, key: str, tid: str, req: dict) -> None:
+        ticket = req["_ticket"]
+        if not ticket.done():   # drain() returned without resolving it
+            try:                # (a reform mid-batch): wait it out
+                ticket.result(self.result_timeout_s)
+            except Exception:
+                pass
+        err = ticket.error()
+        seconds = time.monotonic() - req["_t0"]
+        if err is None and ticket.done():
+            from ..parallel.gather import gather
+
+            value = np.asarray(gather(ticket.result(0)))
+            payload = wire.encode_result(tid, value=value,
+                                         seconds=seconds,
+                                         mesh=self.mesh)
+        else:
+            if err is None:
+                err = TimeoutError(
+                    f"mesh {self.mesh}: request {tid} did not resolve "
+                    f"within {self.result_timeout_s}s")
+            payload = wire.encode_result(tid, error=err,
+                                         seconds=seconds,
+                                         mesh=self.mesh)
+        # result first, THEN req-GC: the result key is the commit point
+        self.kv.set(wire.res_key(self.ns, tid), payload)
+        self.kv.delete(key)
+
+    def run(self, *, poll_s: float = 0.01,
+            max_seconds: Optional[float] = None) -> None:
+        """The subprocess main loop: poll until a stop signal (or
+        ``max_seconds``, a drill safety net)."""
+        t0 = time.monotonic()
+        while not self._stopped:
+            self.step()
+            if (max_seconds is not None
+                    and time.monotonic() - t0 > max_seconds):
+                break
+            time.sleep(poll_s)

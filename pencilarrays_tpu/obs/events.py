@@ -112,6 +112,13 @@ CRITICAL_EVENTS = frozenset({
     # behavior (failures, capacity moves) — the record must survive
     # the crash that often follows the overload that caused it
     "serve.slo_violation", "serve.pressure", "serve.scale",
+    # fleet federation: a whole-mesh failover gates every re-bound
+    # ticket, and a supervisor scale action moves real capacity —
+    # both must survive the crash cascade that usually surrounds
+    # them.  fleet.lease expiry (not routine acquire) and fleet.scale
+    # dry-run signals opt in/out per record via the _fsync override;
+    # fleet.route is high-rate and only flushes.
+    "fleet.failover",
 })
 
 _lock = threading.Lock()

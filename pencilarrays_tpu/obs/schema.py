@@ -123,6 +123,18 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     # reformation boundary (queued dispatches dropped typed, fresh
     # RuntimeConfig snapshot, new generation)
     "engine.reform": ("gen", "stage"),
+    # multi-mesh fleet federation (fleet/): a placement/rebind
+    # decision with its bytes-equivalent score (fleet.route), a mesh
+    # health-lease transition (fleet.lease — acquired/expired/left;
+    # expiry rides record_event's per-record fsync override), a
+    # whole-mesh failover sweep (fleet.failover — always
+    # fsync-critical: the router may be about to re-bind onto a mesh
+    # that dies too) and a supervisor scaling action (fleet.scale)
+    "fleet.route": ("ticket", "tenant", "mesh", "reason",
+                    "score_bytes"),
+    "fleet.lease": ("mesh", "status"),
+    "fleet.failover": ("mesh", "tickets", "detect_s"),
+    "fleet.scale": ("action", "reason"),
     # static analysis (analysis/): one record per certification —
     # ``PlanService.certify()`` registry sweeps, pa-lint SPMD runs and
     # direct ``certify_plan`` calls; non-ok outcomes are fsync-critical

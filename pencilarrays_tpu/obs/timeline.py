@@ -316,13 +316,14 @@ def merge_journals(directory: str, *, correct_skew: bool = True,
 
 # per-rank tracks (Perfetto "threads"): stable ids + display order
 _TRACKS = {"run": 0, "hops": 1, "io": 2, "ckpt": 3, "recovery": 4,
-           "cluster": 5, "serve": 6}
+           "cluster": 5, "serve": 6, "fleet": 7}
 
 # dispatches that carry a priority lane (schema v5) render on dynamic
-# per-lane tracks BELOW the serve track, so cross-lane overlap — a
-# whale batch in flight while a minnow batch issues — is visible as
-# two concurrent spans instead of interleaved instants on one line
-_LANE_TRACK_BASE = 7
+# per-lane tracks BELOW the serve and fleet tracks, so cross-lane
+# overlap — a whale batch in flight while a minnow batch issues — is
+# visible as two concurrent spans instead of interleaved instants on
+# one line
+_LANE_TRACK_BASE = 8
 
 _TRACK_OF = {
     "hop": "hops",
@@ -340,6 +341,8 @@ _TRACK_OF = {
     "serve.dispatch": "serve", "serve.complete": "serve",
     "serve.slo_violation": "serve", "serve.pressure": "serve",
     "serve.scale": "serve",
+    "fleet.route": "fleet", "fleet.lease": "fleet",
+    "fleet.failover": "fleet", "fleet.scale": "fleet",
 }
 
 # events exported as complete ("X") spans: payload field holding the
@@ -425,6 +428,30 @@ def _span_name(e: dict) -> str:
         return (f"scale {e.get('direction', '?')} "
                 f"[{e.get('reason', '?')}]"
                 f"{f' {det}' if det else ''}{acted}")
+    if ev == "fleet.route":
+        sb = e.get("score_bytes")
+        score = (f" {sb / 1e6:.2f}MBe"
+                 if isinstance(sb, (int, float)) else "")
+        return (f"route {e.get('tenant', '?')}#{e.get('ticket', '?')}"
+                f"->m{e.get('mesh', '?')} [{e.get('reason', '?')}]"
+                f"{score}")
+    if ev == "fleet.lease":
+        age = e.get("age_s")
+        suffix = (f" age={age:.2f}s"
+                  if isinstance(age, (int, float)) else "")
+        return f"mesh-lease m{e.get('mesh', '?')}:{e.get('status', '?')}" \
+               + suffix
+    if ev == "fleet.failover":
+        d = e.get("detect_s")
+        det = f" detect={d:.2f}s" if isinstance(d, (int, float)) else ""
+        return (f"FAILOVER m{e.get('mesh', '?')} "
+                f"tickets={e.get('tickets', '?')}{det}")
+    if ev == "fleet.scale":
+        acted = "" if e.get("acted") else " (signal)"
+        mesh = e.get("mesh")
+        return (f"fleet-scale {e.get('action', '?')} "
+                f"[{e.get('reason', '?')}]"
+                f"{f' m{mesh}' if mesh is not None else ''}{acted}")
     return ev
 
 
@@ -569,7 +596,12 @@ def render(tl: MergedTimeline, *, max_groups: int = 200) -> str:
                           # the overload plane's decisions gate
                           # client-visible behavior: spell them out
                           "serve.slo_violation", "serve.pressure",
-                          "serve.scale"):
+                          "serve.scale",
+                          # fleet health/failover/scaling decisions
+                          # gate whole meshes: always spelled out
+                          # (fleet.route is high-rate and counted)
+                          "fleet.lease", "fleet.failover",
+                          "fleet.scale"):
                     loud.append(_span_name(e))
                 elif (ev == "plan.build"
                       and isinstance(e.get("decomposition"), dict)

@@ -29,12 +29,18 @@ Registered points (see ``docs/Resilience.md``):
                           SLO checks — ``error`` fails THIS submitter
                           typed, ``delay`` drags admission: the
                           overload and flaky-client drills)
+``fleet.route``           the fleet's routed-admission path: once in
+                          the router's ``submit`` and once on the
+                          back-end mesh as it takes the routed
+                          request — with ``%mesh<k>`` one shared spec
+                          kills/delays/errors exactly ONE mesh's
+                          admission path (the whole-mesh chaos drill)
 ========================  ====================================================
 
 Rules are **counter-based, never random** — the same spec replays the
 same failure.  Spec grammar (comma/semicolon-separated)::
 
-    point:mode[%rank<k>][*times][@nth]
+    point:mode[%rank<k>|%mesh<k>][*times][@nth]
 
 * ``mode`` — ``error`` (raise :class:`InjectedFault`), ``kill``
   (``SIGKILL`` this process: the un-catchable crash), ``torn``
@@ -56,6 +62,13 @@ same failure.  Spec grammar (comma/semicolon-separated)::
   environment can kill/corrupt/hang a *specific* rank:
   ``hop.exchange:corrupt%rank1@2`` poisons rank 1's second hop and
   nobody else's.  ``@nth`` counts that rank's own local hits.
+* ``%mesh<k>`` — mesh-addressed injection (the rank selector's fleet
+  sibling): the rule triggers only in a process whose fleet mesh id
+  is ``k`` (``PENCILARRAYS_TPU_FLEET_MESH``, set by the mesh worker's
+  launcher; a non-fleet process answers -1 and never matches), so ONE
+  spec shared by every mesh's environment addresses a *whole mesh*:
+  ``fleet.route:kill%mesh1@4`` SIGKILLs mesh 1 as it takes its 4th
+  routed request — the whole-mesh loss drill.
 * ``*times`` — trigger on that many consecutive hits (default: ``error``
   and ``corrupt`` forever, ``kill``/``torn`` once).
 * ``@nth`` — first trigger on the *nth* hit of the point (1-based,
@@ -110,6 +123,7 @@ POINTS = frozenset({
     "barrier",
     "hop.exchange",
     "serve.submit",
+    "fleet.route",
 })
 
 MODES = frozenset({"error", "kill", "torn", "corrupt", "delay"})
@@ -134,6 +148,7 @@ class Rule:
     times: Optional[int]       # consecutive triggering hits (None = forever)
     first: int = 1             # 1-based hit index of the first trigger
     rank: Optional[int] = None   # %rank<k> selector (None = every rank)
+    mesh: Optional[int] = None   # %mesh<k> selector (None = every mesh)
 
     def triggers(self, hit: int) -> bool:
         if hit < self.first:
@@ -170,21 +185,27 @@ def parse(spec: str) -> List[Rule]:
         else:
             mode, times = rhs, None
         rank: Optional[int] = None
+        mesh: Optional[int] = None
         if "%" in mode:
             mode, sel = mode.split("%", 1)
-            m = re.match(r"^rank(\d+)$", sel.strip())
+            m = re.match(r"^(rank|mesh)(\d+)$", sel.strip())
             if not m:
                 raise ValueError(
                     f"fault rule {raw!r}: selector {sel!r} is not "
-                    f"'rank<k>' (e.g. hop.exchange:corrupt%rank1@2)")
-            rank = int(m.group(1))
+                    f"'rank<k>' or 'mesh<k>' (e.g. "
+                    f"hop.exchange:corrupt%rank1@2, "
+                    f"fleet.route:kill%mesh1@4)")
+            if m.group(1) == "rank":
+                rank = int(m.group(2))
+            else:
+                mesh = int(m.group(2))
         mode = mode.strip()
         if mode not in MODES:
             raise ValueError(
                 f"fault rule {raw!r}: mode {mode!r} not in {sorted(MODES)}")
         if times is None and mode in ("kill", "torn"):
             times = 1  # a crash repeats at most per-process anyway
-        rules.append(Rule(point, mode, times, first, rank))
+        rules.append(Rule(point, mode, times, first, rank, mesh))
     return rules
 
 
@@ -248,9 +269,10 @@ def armed(point: str) -> bool:
     """Cheap probe: does any current rule target ``point``?  Hot paths
     use this to keep their no-faults fast path untouched (e.g. the
     binary writer's in-thread block copies).  Deliberately ignores the
-    ``%rank`` selector (resolving identity is not probe-cheap): a rule
-    addressed to another rank makes this rank take the instrumented
-    path, where :func:`fire` then correctly does nothing."""
+    ``%rank``/``%mesh`` selectors (resolving identity is not
+    probe-cheap): a rule addressed to another rank or mesh makes this
+    process take the instrumented path, where :func:`fire` then
+    correctly does nothing."""
     return any(r.point == point for r in _current_rules())
 
 
@@ -263,6 +285,17 @@ def _self_rank() -> int:
     from ..cluster import rank
 
     return rank()
+
+
+def _self_mesh() -> int:
+    """This process's fleet mesh id for ``%mesh<k>`` matching —
+    delegated to the fleet layer's ONE identity rule (the
+    ``PENCILARRAYS_TPU_FLEET_MESH`` env var a mesh worker's launcher
+    sets; -1 = not a mesh worker, matches no selector).  Resolved
+    lazily, like :func:`_self_rank`."""
+    from ..fleet import mesh_id
+
+    return mesh_id()
 
 
 def kill_now() -> None:
@@ -313,6 +346,8 @@ def fire(point: str, **ctx) -> Optional[str]:
             continue
         if r.rank is not None and r.rank != _self_rank():
             continue   # addressed to another rank; counters still tick
+        if r.mesh is not None and r.mesh != _self_mesh():
+            continue   # addressed to another mesh; counters still tick
         _obs_firing(point, r.mode, hit, ctx)
         if r.mode == "delay":
             # the deterministic straggler: stall, then proceed — the

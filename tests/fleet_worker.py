@@ -1,0 +1,69 @@
+"""Worker for the fleet federation chaos drills.
+
+One OS process = one back-end MESH: a self-contained single-process
+jax (1 local device, no cross-process collectives — the drill runs on
+any backend) wrapping a :class:`~pencilarrays_tpu.serve.PlanService`
+in a :class:`~pencilarrays_tpu.fleet.MeshWorker`, joined to the
+front-end router ONLY through a shared ``FileKV`` directory.  That
+isolates exactly what the fleet layer adds: placement, health leases,
+whole-mesh failover — the machinery that must behave identically over
+the jax distributed KV store across real slices.
+
+Identity is the environment: the launcher sets
+``PENCILARRAYS_TPU_FLEET_MESH=<k>`` so one fault spec shared by every
+process addresses a single mesh — the acceptance drill's
+``fleet.route:kill%mesh1@4`` SIGKILLs exactly mesh 1 as it takes its
+4th routed request, and ``PENCILARRAYS_TPU_CLUSTER_RANK=<k>`` so each
+mesh's journal lands in its own ``journal.r<k>.jsonl`` for the
+cross-process timeline merge.
+
+Usage::
+
+    python fleet_worker.py <kvroot> <mesh> <tmpdir> [max_seconds]
+"""
+
+import os
+import sys
+
+
+def main():
+    kvroot, mesh, tmpdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    max_seconds = float(sys.argv[4]) if len(sys.argv) > 4 else 60.0
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1")
+    # mesh identity BEFORE importing anything heavy: the %mesh fault
+    # selector and the journal attribution are env-read
+    os.environ["PENCILARRAYS_TPU_FLEET_MESH"] = str(mesh)
+    os.environ.setdefault("PENCILARRAYS_TPU_CLUSTER_RANK", str(mesh))
+    os.environ.setdefault("PENCILARRAYS_TPU_OBS",
+                          os.path.join(tmpdir, "obs"))
+    ttl = float(os.environ.get("PA_FLEET_TEST_TTL", "2.0"))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu.cluster.kv import FileKV
+    from pencilarrays_tpu.fleet import MeshWorker
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+    from pencilarrays_tpu.serve import PlanService
+
+    topo = pa.Topology((1,), devices=jax.devices()[:1])
+    svc = PlanService(max_batch=4, max_wait_s=0.0)
+    svc.register_plan("minnow",
+                      lambda ctx: PencilFFTPlan(topo, (8, 6, 4)))
+    svc.register_plan("whale",
+                      lambda ctx: PencilFFTPlan(topo, (16, 12, 8)))
+    worker = MeshWorker(FileKV(kvroot), mesh, service=svc, ttl=ttl)
+    worker.prewarm(["minnow", "whale"])
+    worker.start()
+    print(f"READY mesh={mesh} pid={os.getpid()}", flush=True)
+    try:
+        worker.run(poll_s=0.01, max_seconds=max_seconds)
+    finally:
+        print(f"EXITED mesh={mesh} handled={worker.handled}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,715 @@
+"""Multi-mesh fleet federation (ISSUE 17): the two-tier placement
+cost model, the KV wire codec, mesh health leases with one-round-lag
+beat GC, whole-mesh failover with the exactly-once resolution
+contract, the ``%mesh`` fault selector, the joiner-spawning
+supervisor, and the ``fleet-event`` lint rule.
+
+Boundary contracts under test (the satellite checklist):
+
+* a week of heartbeats holds <= 2 live beat keys per mesh (the
+  one-round-lag GC regression count);
+* lease expiry is typed ``MeshFailureError`` (with ``age_s``), clean
+  departure typed ``MeshLeftError`` — never conflated;
+* double failover (A dies -> rebind B -> B dies -> rebind C) resolves
+  the ticket EXACTLY once, on C, with the correct result;
+* a mesh that published its result and THEN died resolves from the
+  result — zero rebinds, never a duplicate;
+* typed serve errors cross the wire as the SAME class; kwargs that
+  fail to reconstruct degrade to ``FleetError``, never raise inside
+  the decoder;
+* every ``fleet.*`` journal literal is registered and emitted only
+  from ``fleet/`` (the ``fleet-event`` rule).
+"""
+
+import os
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu import obs
+from pencilarrays_tpu.analysis.lint import lint_tree
+from pencilarrays_tpu.cluster.kv import FileKV
+from pencilarrays_tpu.fleet import (
+    MESH_ENV,
+    FleetCost,
+    FleetRouter,
+    FleetSupervisor,
+    MeshBoard,
+    MeshFailureError,
+    MeshLease,
+    MeshLeftError,
+    MeshWorker,
+    mesh_id,
+)
+from pencilarrays_tpu.fleet import wire
+from pencilarrays_tpu.fleet.errors import FleetError
+from pencilarrays_tpu.obs import events as obs_events
+from pencilarrays_tpu.obs import metrics as obs_metrics
+from pencilarrays_tpu.ops.fft import PencilFFTPlan
+from pencilarrays_tpu.resilience import faults
+from pencilarrays_tpu.resilience.errors import InjectedFault
+from pencilarrays_tpu.serve import (
+    SLO,
+    AdmissionError,
+    DeadlineError,
+    PlanService,
+)
+
+pytestmark = pytest.mark.usefixtures("devices")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in (obs.ENV_VAR, faults.ENV_VAR, MESH_ENV,
+                "PENCILARRAYS_TPU_FLEET_SPAWN",
+                "PENCILARRAYS_TPU_FLEET_DCN_LATENCY_BYTES",
+                "PENCILARRAYS_TPU_FLEET_DCN_FACTOR",
+                "PENCILARRAYS_TPU_FLEET_COMPILE_PENALTY"):
+        monkeypatch.delenv(var, raising=False)
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    yield
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+
+
+def _kv(tmp_path, sub="kv"):
+    return FileKV(os.path.join(str(tmp_path), sub))
+
+
+def _service(devices, shape=(8, 6, 4), name="fft"):
+    topo = pa.Topology((1,), devices=devices[:1])
+    svc = PlanService(max_batch=4, max_wait_s=0.0)
+    svc.register_plan(name, lambda ctx: PencilFFTPlan(topo, shape))
+    return svc
+
+
+def _worker(kv, mesh, devices, *, ttl=0.3, warm=True, **kw):
+    w = MeshWorker(kv, mesh, service=_service(devices), ttl=ttl, **kw)
+    if warm:
+        w.prewarm(["fft"])
+    return w
+
+
+def _host(seed, shape=(8, 6, 4)):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape)).astype(np.complex64)
+
+
+# ---------------------------------------------------------------------------
+# the two-tier cost model
+# ---------------------------------------------------------------------------
+
+
+def test_cost_model_units():
+    c = FleetCost()
+    # colo: the router's own failure domain pays no DCN toll
+    assert c.wire_bytes(nbytes_in=1000, nbytes_out=1000,
+                        tier="colo") == 0.0
+    # dcn: 2x latency toll + per-byte factor, both directions
+    assert c.wire_bytes(nbytes_in=1000, nbytes_out=500, tier="dcn") \
+        == 2 * c.dcn_latency_bytes + c.dcn_byte_factor * 1500
+    assert c.affinity_bytes(warm=True) == 0.0
+    assert c.affinity_bytes(warm=False) == float(c.compile_penalty_bytes)
+    # SLO tenants weight queue depth harder
+    assert c.backlog_bytes(backlog=100.0, deadline_s=None) == 100.0
+    assert c.backlog_bytes(backlog=100.0, deadline_s=1.0) \
+        == c.slo_drain_weight * 100.0
+    s = c.score(nbytes_in=10, nbytes_out=10, tier="dcn", warm=False,
+                backlog=5.0)
+    assert s["total"] == s["wire"] + s["affinity"] + s["backlog"]
+
+
+def test_cost_from_env(monkeypatch):
+    monkeypatch.setenv("PENCILARRAYS_TPU_FLEET_DCN_LATENCY_BYTES", "100")
+    monkeypatch.setenv("PENCILARRAYS_TPU_FLEET_DCN_FACTOR", "2.5")
+    monkeypatch.setenv("PENCILARRAYS_TPU_FLEET_COMPILE_PENALTY", "77")
+    c = FleetCost.from_env()
+    assert c.dcn_latency_bytes == 100
+    assert c.dcn_byte_factor == 2.5
+    assert c.compile_penalty_bytes == 77
+    # garbage falls back to defaults, never raises
+    monkeypatch.setenv("PENCILARRAYS_TPU_FLEET_DCN_FACTOR", "fast")
+    assert FleetCost.from_env().dcn_byte_factor \
+        == FleetCost().dcn_byte_factor
+
+
+# ---------------------------------------------------------------------------
+# the KV wire: key layout + codec
+# ---------------------------------------------------------------------------
+
+
+def test_wire_key_layout():
+    # zero-padded sequence numbers: lexical order IS numeric order,
+    # so MeshBoard's max() over a listing finds the newest beat
+    k9 = wire.beat_key("pa", 1, 9)
+    k10 = wire.beat_key("pa", 1, 10)
+    assert k9 < k10
+    assert k9.startswith("pa/fleet/beat/m1/")
+    assert wire.ticket_id_of(wire.req_key("pa", 2, "abc")) == "abc"
+    assert wire.ticket_id_of(wire.res_key("pa", "abc")) == "abc"
+    assert wire.req_key("pa", 2, "abc").startswith(
+        wire.req_dir("pa", 2) + "/")
+
+
+def test_wire_request_roundtrip():
+    payload = _host(0)
+    raw = wire.encode_request(
+        "t1", tenant="acme", name="fft", direction="forward",
+        payload=payload, t_submit=123.0, deadline_s=1.5, rebinds=2)
+    req = wire.decode_request(raw)
+    assert req["tenant"] == "acme" and req["name"] == "fft"
+    assert req["deadline_s"] == 1.5 and req["rebinds"] == 2
+    assert req["payload"].dtype == payload.dtype
+    np.testing.assert_array_equal(req["payload"], payload)
+
+
+def test_wire_result_roundtrips():
+    value = _host(1)
+    meta, got, err = wire.decode_result(
+        wire.encode_result("t1", value=value, seconds=0.5, mesh=3))
+    assert err is None and meta["mesh"] == 3
+    np.testing.assert_array_equal(got, value)
+
+    # typed serve errors re-raise as the SAME class with their kwargs
+    e = AdmissionError("no", tenant="acme", reason="shed")
+    _, _, got_e = wire.decode_result(wire.encode_result("t2", error=e))
+    assert isinstance(got_e, AdmissionError)
+    assert got_e.tenant == "acme" and got_e.reason == "shed"
+
+    e2 = DeadlineError("late", tenant="acme", reason="projected",
+                       deadline_s=2.0, projected_s=3.5)
+    _, _, got_e2 = wire.decode_result(wire.encode_result("t3", error=e2))
+    assert isinstance(got_e2, DeadlineError)
+    assert got_e2.deadline_s == 2.0 and got_e2.projected_s == 3.5
+
+    # an unknown type degrades to FleetError carrying the name —
+    # never arbitrary reconstruction, never a silent swallow
+    _, _, got_e3 = wire.decode_result(wire.encode_result(
+        "t4", error=ValueError("boom")))
+    assert isinstance(got_e3, FleetError)
+    assert "ValueError" in str(got_e3)
+
+    # kwargs that fail to reconstruct degrade too (a registry class
+    # whose required kwargs were stripped must not raise in the decoder)
+    import json as _json
+
+    raw = _json.loads(wire.encode_result(
+        "t5", error=AdmissionError("no", tenant="a", reason="shed")))
+    raw["error"]["kwargs"] = {}
+    _, _, got_e4 = wire.decode_result(_json.dumps(raw))
+    assert isinstance(got_e4, FleetError)
+
+    with pytest.raises(ValueError):
+        wire.encode_result("t6")            # neither value nor error
+    with pytest.raises(ValueError):
+        wire.encode_result("t7", value=value, error=e)
+
+
+# ---------------------------------------------------------------------------
+# the %mesh fault selector
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_selector_parse():
+    r, = faults.parse("fleet.route:kill%mesh1@4")
+    assert r.point == "fleet.route" and r.mode == "kill"
+    assert r.mesh == 1 and r.rank is None and r.first == 4
+    r2, = faults.parse("hop.exchange:error%rank2")
+    assert r2.rank == 2 and r2.mesh is None
+
+
+def test_mesh_selector_addresses_one_mesh(monkeypatch):
+    assert mesh_id() == -1          # not a mesh worker by default
+    with faults.active("fleet.route:error%mesh1"):
+        faults.fire("fleet.route")  # mesh -1: not addressed
+        monkeypatch.setenv(MESH_ENV, "2")
+        faults.fire("fleet.route")  # mesh 2: not addressed
+        monkeypatch.setenv(MESH_ENV, "1")
+        assert mesh_id() == 1
+        with pytest.raises(InjectedFault):
+            faults.fire("fleet.route")
+    # an unaddressed rule fires for every process
+    with faults.active("fleet.route:error"):
+        with pytest.raises(InjectedFault):
+            faults.fire("fleet.route")
+
+
+# ---------------------------------------------------------------------------
+# health leases: beat GC, expiry, clean departure
+# ---------------------------------------------------------------------------
+
+
+def test_beat_gc_bounded(tmp_path):
+    """The one-round-lag GC regression count: many renewals, <= 2 live
+    beat keys — the KV store cannot grow with uptime."""
+    kv = _kv(tmp_path)
+    lease = MeshLease(kv, 0, ttl=5.0)
+    for _ in range(50):
+        lease.renew()
+    assert lease.renewals == 50
+    live = kv.list_dir(wire.beat_dir("pa", 0))
+    assert 1 <= len(live) <= 2
+    board = MeshBoard(kv, ttl=5.0)
+    age = board.mesh_age(0)
+    assert age is not None and age < 1.0
+
+
+def test_lease_expiry_is_typed_mesh_failure(tmp_path):
+    kv = _kv(tmp_path)
+    MeshLease(kv, 0, ttl=0.2).renew()       # one beat, then silence
+    board = MeshBoard(kv, ttl=0.2, join_grace=0.2)
+    assert board.live_meshes([0]) == [0]
+    time.sleep(0.35)
+    dead = board.dead_meshes([0])
+    assert len(dead) == 1
+    mesh, err = dead[0]
+    assert mesh == 0 and isinstance(err, MeshFailureError)
+    assert err.mesh == 0 and err.age_s is not None and err.age_s > 0.2
+    with pytest.raises(MeshFailureError):
+        board.check([0])
+    assert board.live_meshes([0]) == []
+
+
+def test_clean_departure_is_typed_mesh_left(tmp_path):
+    kv = _kv(tmp_path)
+    lease = MeshLease(kv, 3, ttl=0.2)
+    lease.renew()
+    lease.leave()
+    board = MeshBoard(kv, ttl=0.2, join_grace=0.2)
+    assert board.live_meshes([3]) == []     # left: never a candidate
+    time.sleep(0.3)
+    (mesh, err), = board.dead_meshes([3])
+    assert mesh == 3 and isinstance(err, MeshLeftError)
+    assert not isinstance(err, MeshFailureError)
+
+
+def test_never_seen_mesh_respects_join_grace(tmp_path):
+    kv = _kv(tmp_path)
+    board = MeshBoard(kv, ttl=0.2, join_grace=10.0)
+    assert board.live_meshes([7]) == []     # not alive until 1st beat
+    assert board.dead_meshes([7]) == []     # but not dead either: grace
+    board2 = MeshBoard(kv, ttl=0.2, join_grace=0.05)
+    time.sleep(0.1)
+    (_, err), = board2.dead_meshes([7])
+    assert isinstance(err, MeshFailureError) and err.age_s is None
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+
+def _fake_mesh(kv, mesh, *, queued=0, warm=True, fp="fp-1"):
+    """A mesh that exists only as wire state: one beat + one load
+    export — placement inputs without a real worker."""
+    MeshLease(kv, mesh, ttl=5.0).renew()
+    import json
+
+    kv.set(wire.load_key("pa", mesh), json.dumps({
+        "t": time.time(), "mesh": mesh, "tier": "dcn",
+        "projection": {"queued_cost_bytes": queued,
+                       "inflight_cost_bytes": 0},
+        "plans": {"fft": fp}, "warm": [fp] if warm else [],
+    }))
+
+
+def test_placement_prefers_warm_fingerprint(tmp_path):
+    kv = _kv(tmp_path)
+    _fake_mesh(kv, 1, warm=False)
+    _fake_mesh(kv, 2, warm=True)
+    router = FleetRouter(kv, ttl=5.0)
+    router.register_mesh(1)
+    router.register_mesh(2)
+    mesh, score = router._place("fft", 1024, None)
+    assert mesh == 2 and score["affinity"] == 0.0
+
+
+def test_placement_prefers_shallow_backlog_and_colo(tmp_path):
+    kv = _kv(tmp_path)
+    _fake_mesh(kv, 1, queued=512 * 1024 * 1024)
+    _fake_mesh(kv, 2, queued=0)
+    router = FleetRouter(kv, ttl=5.0)
+    router.register_mesh(1)
+    router.register_mesh(2)
+    mesh, _ = router._place("fft", 1024, None)
+    assert mesh == 2
+    # identical load: the colo tier's zero DCN toll wins
+    kv2 = _kv(tmp_path, "kv2")
+    _fake_mesh(kv2, 1)
+    _fake_mesh(kv2, 2)
+    router2 = FleetRouter(kv2, ttl=5.0)
+    router2.register_mesh(1, tier="colo")
+    router2.register_mesh(2)
+    mesh2, score2 = router2._place("fft", 1024, None)
+    assert mesh2 == 1 and score2["wire"] == 0.0
+
+
+def test_no_live_mesh_is_typed_admission_error(tmp_path):
+    router = FleetRouter(_kv(tmp_path), ttl=0.2)
+    router.register_mesh(1)                 # registered but never beat
+    with pytest.raises(AdmissionError) as ei:
+        router.submit("acme", np.zeros((4, 4), np.complex64),
+                      name="fft")
+    assert ei.value.reason == "no-mesh" and ei.value.tenant == "acme"
+    assert router.stats()["submitted"] == 0
+
+
+def test_discover_registers_exporting_meshes(tmp_path):
+    kv = _kv(tmp_path)
+    _fake_mesh(kv, 4)
+    _fake_mesh(kv, 9)
+    router = FleetRouter(kv, ttl=5.0)
+    assert sorted(router.discover()) == [4, 9]
+    assert router.meshes() == [4, 9]
+    assert router.discover() == []          # idempotent
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over the wire (in-process workers, stepped manually)
+# ---------------------------------------------------------------------------
+
+
+def test_single_mesh_end_to_end(tmp_path, devices):
+    obs.enable(str(tmp_path / "obs"))
+    kv = _kv(tmp_path)
+    worker = _worker(kv, 1, devices)
+    worker.start()
+    router = FleetRouter(kv, ttl=0.3)
+    router.register_mesh(1)
+    try:
+        u = _host(2)
+        t = router.submit("acme", u, name="fft")
+        assert worker.step() == 1
+        router.pump()
+        got = np.asarray(t.result(5.0))
+        np.testing.assert_allclose(got, np.fft.fftn(u), rtol=1e-4,
+                                   atol=1e-4)
+        stats = router.stats()
+        assert stats["completed"] == 1 and stats["pending"] == 0
+        # the wire is empty after resolution (req + res both GC'd)
+        assert kv.list_dir(wire.req_dir("pa", 1)) == {}
+        assert kv.try_get(wire.res_key("pa", t.id)) is None
+    finally:
+        worker.close()
+        router.close()
+        obs.disable()
+    events = obs_events.read_journal(str(tmp_path / "obs"))
+    evs = [e["ev"] for e in events]
+    assert "fleet.lease" in evs
+    routes = [e for e in events if e["ev"] == "fleet.route"]
+    assert [r["reason"] for r in routes] == ["placed"]
+    assert routes[0]["mesh"] == 1 and routes[0]["tenant"] == "acme"
+    assert obs.lint_journal(events) == []
+
+
+def test_typed_error_crosses_the_wire(tmp_path, devices):
+    """A worker-side failure resolves the router-side ticket with the
+    SAME typed error — here an InjectedFault from the mesh's own
+    ``fleet.route`` admission point (hit 2: the router's submit-side
+    fire is hit 1)."""
+    kv = _kv(tmp_path)
+    worker = _worker(kv, 1, devices)
+    worker.start()
+    router = FleetRouter(kv, ttl=0.3)
+    router.register_mesh(1)
+    try:
+        with faults.active("fleet.route:error@2"):
+            t = router.submit("acme", _host(3), name="fft")
+            worker.step()
+        router.pump()
+        with pytest.raises(InjectedFault):
+            t.result(5.0)
+        assert t.error().point == "fleet.route"
+        assert router.stats()["failed"] == 1
+    finally:
+        worker.close()
+        router.close()
+
+
+def test_router_deadline_safety_net(tmp_path, devices):
+    """A ticket whose mesh is alive but never executes fails typed at
+    its SLO deadline — the router's own enforcement point for budgets
+    that lapse before any service sees the request."""
+    kv = _kv(tmp_path)
+    worker = _worker(kv, 1, devices)
+    worker.start()                          # heartbeats, never steps
+    router = FleetRouter(kv, ttl=5.0,
+                         slos={"acme": SLO(deadline_s=0.05)})
+    router.register_mesh(1)
+    try:
+        t = router.submit("acme", _host(4), name="fft")
+        time.sleep(0.1)
+        router.pump()
+        err = t.error()
+        assert isinstance(err, DeadlineError)
+        assert err.reason == "expired" and err.deadline_s == 0.05
+        assert router.stats()["expired"] == 1
+    finally:
+        worker.close()
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# failover
+# ---------------------------------------------------------------------------
+
+
+def test_failover_rebinds_to_sibling(tmp_path, devices):
+    obs.enable(str(tmp_path / "obs"))
+    kv = _kv(tmp_path)
+    w1 = _worker(kv, 1, devices)
+    w2 = _worker(kv, 2, devices, warm=False)
+    w1.start()
+    w2.start()
+    router = FleetRouter(kv, ttl=0.3)
+    router.register_mesh(1)
+    router.register_mesh(2)
+    try:
+        u = _host(5)
+        t = router.submit("acme", u, name="fft")    # warm: mesh 1
+        assert kv.list_dir(wire.req_dir("pa", 1)) != {}
+        w1.stop()                           # whole-mesh death
+        time.sleep(0.5)
+        router.pump()                       # detect + park + rebind
+        assert w2.step() == 1
+        router.pump()
+        np.testing.assert_allclose(np.asarray(t.result(5.0)),
+                                   np.fft.fftn(u), rtol=1e-4, atol=1e-4)
+        stats = router.stats()
+        assert stats["rebound"] == 1 and stats["completed"] == 1
+        assert stats["dead_meshes"] == [1]
+    finally:
+        w1.close()
+        w2.close()
+        router.close()
+        obs.disable()
+    events = obs_events.read_journal(str(tmp_path / "obs"))
+    fo = [e for e in events if e["ev"] == "fleet.failover"]
+    assert len(fo) == 1 and fo[0]["mesh"] == 1 and fo[0]["tickets"] == 1
+    assert fo[0]["detect_s"] > 0.3          # ~ttl, never instant
+    reasons = [e["reason"] for e in events if e["ev"] == "fleet.route"]
+    assert reasons == ["placed", "rebind"]
+    assert obs.lint_journal(events) == []
+
+
+def test_double_failover_resolves_exactly_once(tmp_path, devices):
+    """The satellite drill: A dies -> rebind to B -> B dies -> rebind
+    to C -> resolves exactly once, correct, on C."""
+    kv = _kv(tmp_path)
+    workers = {m: _worker(kv, m, devices, warm=(m == 1))
+               for m in (1, 2, 3)}
+    for w in workers.values():
+        w.start()
+    router = FleetRouter(kv, ttl=0.3)
+    for m in workers:
+        router.register_mesh(m)
+    try:
+        u = _host(6)
+        t = router.submit("acme", u, name="fft")    # warm: mesh 1
+        workers[1].stop()
+        time.sleep(0.5)
+        router.pump()                       # rebind 1 (cold tie -> 2)
+        assert kv.list_dir(wire.req_dir("pa", 2)) != {}
+        workers[2].stop()                   # the sibling dies too
+        time.sleep(0.5)
+        router.pump()                       # rebind 2 -> mesh 3
+        assert workers[3].step() == 1
+        router.pump()
+        np.testing.assert_allclose(np.asarray(t.result(5.0)),
+                                   np.fft.fftn(u), rtol=1e-4, atol=1e-4)
+        stats = router.stats()
+        assert stats["completed"] == 1 and stats["failed"] == 0
+        assert stats["rebound"] == 2 and stats["duplicates"] == 0
+        assert stats["dead_meshes"] == [1, 2]
+        assert stats["pending"] == 0
+    finally:
+        for w in workers.values():
+            w.close()
+        router.close()
+
+
+def test_published_result_survives_mesh_death(tmp_path, devices):
+    """A mesh that completed the work and THEN died resolves from its
+    published result — zero rebinds, zero wasted re-execution."""
+    kv = _kv(tmp_path)
+    w1 = _worker(kv, 1, devices)
+    w2 = _worker(kv, 2, devices)
+    w1.start()
+    w2.start()
+    router = FleetRouter(kv, ttl=0.3)
+    router.register_mesh(1)
+    router.register_mesh(2)
+    try:
+        u = _host(7)
+        t = router.submit("acme", u, name="fft")
+        assert w1.step() == 1               # result published...
+        w1.stop()                           # ...then the mesh dies
+        time.sleep(0.5)
+        router.pump()
+        np.testing.assert_allclose(np.asarray(t.result(5.0)),
+                                   np.fft.fftn(u), rtol=1e-4, atol=1e-4)
+        stats = router.stats()
+        assert stats["completed"] == 1 and stats["rebound"] == 0
+    finally:
+        w1.close()
+        w2.close()
+        router.close()
+
+
+def test_all_meshes_dead_fails_typed(tmp_path, devices):
+    """Whole-fleet loss: the pending ticket ends in a typed
+    ``AdmissionError(reason="no-mesh")`` — exactly one outcome, never
+    a hang."""
+    kv = _kv(tmp_path)
+    w1 = _worker(kv, 1, devices)
+    w1.start()
+    router = FleetRouter(kv, ttl=0.3)
+    router.register_mesh(1)
+    try:
+        t = router.submit("acme", _host(8), name="fft")
+        w1.stop()
+        time.sleep(0.5)
+        router.pump()
+        err = t.error()
+        assert isinstance(err, AdmissionError)
+        assert err.reason == "no-mesh"
+        assert router.stats()["pending"] == 0
+    finally:
+        w1.close()
+        router.close()
+
+
+def test_retire_via_stop_key_is_clean_departure(tmp_path, devices):
+    kv = _kv(tmp_path)
+    sup = FleetSupervisor(spawn=lambda m: None, kv=kv)
+    w = _worker(kv, 5, devices)
+    w.start()
+    sup.retire(5)
+    assert w.step() == 0
+    assert w.stopped
+    assert kv.try_get(wire.left_key("pa", 5)) is not None
+    board = MeshBoard(kv, ttl=5.0)
+    assert board.mesh_left(5)
+    w.close()
+
+
+# ---------------------------------------------------------------------------
+# the fleet supervisor (demand-signal consumer)
+# ---------------------------------------------------------------------------
+
+
+def _demand(reason="overload"):
+    return {"direction": "up", "acted": False, "detail": "no-joiner",
+            "reason": reason}
+
+
+def test_supervisor_is_flag_gated():
+    spawned = []
+    sup = FleetSupervisor(spawn=spawned.append, cooldown_s=0.0)
+    assert not sup.enabled                  # env flag off by default
+    assert not sup.observe(_demand())
+    assert spawned == []
+
+
+def test_supervisor_spawns_with_cooldown_and_cap():
+    spawned = []
+    sup = FleetSupervisor(spawn=spawned.append, enabled=True,
+                          cooldown_s=30.0, max_meshes=2, next_mesh=1)
+    assert sup.observe(_demand())
+    assert spawned == [1]
+    assert not sup.observe(_demand())       # cooldown
+    sup2 = FleetSupervisor(spawn=spawned.append, enabled=True,
+                           cooldown_s=0.0, max_meshes=2, next_mesh=1)
+    assert sup2.observe(_demand()) and sup2.observe(_demand())
+    assert not sup2.observe(_demand())      # at-capacity
+    assert sup2.spawned == [1, 2]
+    # non-demand records are ignored outright
+    assert not sup2.observe({"direction": "down", "acted": True})
+    assert not sup2.observe({"direction": "up", "acted": True,
+                             "detail": "no-joiner"})
+
+
+def test_supervisor_scan_dedupes_by_journal_identity(tmp_path):
+    """Replaying the same journal never double-spawns: consumed
+    signals are keyed by ``(proc, seq)``."""
+    jdir = str(tmp_path / "obs")
+    obs.enable(jdir)
+    obs.record_event("serve.scale", action="grow", reason="overload",
+                     direction="up", acted=False, detail="no-joiner")
+    obs.record_event("serve.scale", action="grow", reason="overload",
+                     direction="up", acted=False, detail="no-joiner")
+    obs.record_event("serve.scale", action="grow", reason="overload",
+                     direction="up", acted=True)      # not a demand
+    obs.disable()
+    spawned = []
+    sup = FleetSupervisor(spawn=spawned.append, enabled=True,
+                          cooldown_s=0.0)
+    assert sup.scan(jdir) == 2
+    assert spawned == [1, 2]
+    assert sup.scan(jdir) == 0              # replay: all deduped
+    assert spawned == [1, 2]
+    assert sup.stats()["signals_seen"] == 3
+
+
+# ---------------------------------------------------------------------------
+# the fleet-event lint rule
+# ---------------------------------------------------------------------------
+
+
+def _write(root, rel, content):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(content))
+
+
+def _lint_fixture(tmp_path, fleet_src, outside_src=""):
+    root = str(tmp_path / "repo")
+    _write(root, "pencilarrays_tpu/obs/schema.py", """
+        EVENT_TYPES = {"fleet.route": ("ticket",), "hop": ("method",)}
+        """)
+    _write(root, "pencilarrays_tpu/resilience/faults.py", """
+        POINTS = frozenset({"io.open"})
+        """)
+    _write(root, "docs/Resilience.md", "| `io.open` |")
+    _write(root, "README.md", "docs")
+    _write(root, "pencilarrays_tpu/fleet/router.py", fleet_src)
+    if outside_src:
+        _write(root, "pencilarrays_tpu/serve/thing.py", outside_src)
+    return root
+
+
+def test_lint_fleet_event_rules(tmp_path):
+    root = _lint_fixture(tmp_path, """
+        def f(obs, name):
+            obs.record_event("fleet.route", ticket="t")   # fine
+            obs.record_event("fleet.bogus", ticket="t")   # unregistered
+            obs.record_event(name, ticket="t")            # dynamic
+            obs.record_event("hop", method="x")           # not fleet.*
+        """, outside_src="""
+        def g(obs):
+            obs.record_event("fleet.route", ticket="t")   # wrong layer
+        """)
+    found = sorted((f.ident, f.path.replace(os.sep, "/"))
+                   for f in lint_tree(root) if f.check == "fleet-event")
+    assert found == [
+        ("fleet.bogus", "pencilarrays_tpu/fleet/router.py"),
+        ("fleet.route", "pencilarrays_tpu/serve/thing.py"),
+        ("fleet.router:dynamic", "pencilarrays_tpu/fleet/router.py"),
+        ("hop", "pencilarrays_tpu/fleet/router.py"),
+    ]
+
+
+def test_lint_clean_fleet_fixture(tmp_path):
+    root = _lint_fixture(tmp_path, """
+        def f(obs):
+            obs.record_event("fleet.route", ticket="t")
+        """)
+    assert [f for f in lint_tree(root) if f.check == "fleet-event"] == []

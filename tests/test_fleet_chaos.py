@@ -1,0 +1,243 @@
+"""The ISSUE 17 acceptance drill: whole-mesh chaos across real OS
+processes.
+
+Two (three in the slow variant) subprocess ``PlanService`` meshes
+(``fleet_worker.py``) join a front-end :class:`FleetRouter` through a
+shared ``FileKV`` directory; a mixed whale/minnow storm is submitted;
+one whole mesh is SIGKILLed mid-storm by the fleet-addressed fault
+spec ``fleet.route:kill%mesh1@4`` (the SAME spec in every worker's
+environment — the ``%mesh`` selector does the addressing).  The
+router must detect the loss by lease expiry (typed
+``MeshFailureError``, ``detect_s`` well under 20 s), re-bind the dead
+mesh's tickets to the sibling, and resolve EVERY submitted ticket
+exactly once with the bit-correct FFT — after which the merged fleet
+timeline must render lint-clean through the real ``pa-obs`` CLI.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from pencilarrays_tpu import obs
+from pencilarrays_tpu.cluster.kv import FileKV
+from pencilarrays_tpu.fleet import FleetRouter, MeshBoard
+from pencilarrays_tpu.obs import events as obs_events
+from pencilarrays_tpu.obs import metrics as obs_metrics
+from pencilarrays_tpu.resilience import faults
+
+TTL = 2.0
+BOOT_S = 90.0       # jax import + plan compile on a cold worker
+SHAPES = {"minnow": (8, 6, 4), "whale": (16, 12, 8)}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    yield
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+
+
+def _spawn(kvroot, mesh, tmpdir, *, fault=""):
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(here),
+        "PA_FLEET_TEST_TTL": str(TTL),
+        "PENCILARRAYS_TPU_FAULTS": fault,
+    })
+    env.pop("PENCILARRAYS_TPU_FLEET_MESH", None)
+    env.pop("PENCILARRAYS_TPU_CLUSTER_RANK", None)
+    return subprocess.Popen(
+        [sys.executable, os.path.join(here, "fleet_worker.py"),
+         kvroot, str(mesh), tmpdir, "120"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+def _await_live(kv, meshes):
+    board = MeshBoard(kv, ttl=TTL)
+    deadline = time.monotonic() + BOOT_S
+    while time.monotonic() < deadline:
+        if board.live_meshes(meshes) == sorted(meshes):
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"meshes {meshes} never all came alive")
+
+
+def _reap(procs, timeout=30):
+    outs = {}
+    for mesh, p in procs.items():
+        try:
+            outs[mesh], _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs[mesh], _ = p.communicate()
+    return outs
+
+
+def _host(seed, shape):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape)).astype(np.complex64)
+
+
+def test_whole_mesh_loss_mid_storm(tmp_path):
+    """The acceptance drill proper: 2 subprocess meshes, mixed storm,
+    mesh 1 SIGKILLed by its own 4th routed request."""
+    kvroot = str(tmp_path / "kv")
+    obsdir = str(tmp_path / "obs")
+    kv = FileKV(kvroot)
+    procs = {m: _spawn(kvroot, m, str(tmp_path),
+                       fault="fleet.route:kill%mesh1@4")
+             for m in (1, 2)}
+    router = None
+    try:
+        _await_live(kv, [1, 2])
+        obs.enable(obsdir)
+        router = FleetRouter(kv, ttl=TTL)
+        router.register_mesh(1)
+        router.register_mesh(2)
+
+        # wave 1: a mixed burst — placement sends it to one mesh
+        # (both warm, zero backlog: the tie breaks low), whose 4th
+        # take is the SIGKILL
+        tickets = []
+        for i in range(12):
+            tenant = "whale" if i % 3 == 0 else "minnow"
+            u = _host(i, SHAPES[tenant])
+            tickets.append((router.submit(tenant, u, name=tenant), u))
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            router.pump()
+            if router.stats()["dead_meshes"]:
+                break
+            time.sleep(0.05)
+        assert router.stats()["dead_meshes"] == [1]
+        assert procs[1].wait(timeout=30) == -signal.SIGKILL
+
+        # wave 2: the storm continues against the surviving sibling
+        for i in range(12, 16):
+            tenant = "whale" if i % 3 == 0 else "minnow"
+            u = _host(i, SHAPES[tenant])
+            tickets.append((router.submit(tenant, u, name=tenant), u))
+
+        assert router.drain(60.0) == 0
+        stats = router.stats()
+        # every submitted ticket resolved exactly once, bit-correct
+        assert stats["completed"] == len(tickets)
+        assert stats["failed"] == 0 and stats["duplicates"] == 0
+        for t, u in tickets:
+            np.testing.assert_allclose(np.asarray(t.result(1.0)),
+                                       np.fft.fftn(u),
+                                       rtol=1e-3, atol=1e-3)
+    finally:
+        if router is not None:
+            router.close()
+        obs.disable()
+        for m in (1, 2):
+            kv.set(f"pa/fleet/stop/m{m}", "stop")
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        outs = _reap(procs)
+
+    # mesh 2 survived the whole drill and executed the failed-over work
+    assert "EXITED mesh=2" in outs[2], outs[2]
+
+    # the merged journal tells the failover story, typed and timed
+    events = obs_events.read_journal(obsdir)
+    fo = [e for e in events if e["ev"] == "fleet.failover"]
+    assert len(fo) == 1 and fo[0]["mesh"] == 1
+    assert fo[0]["tickets"] >= 1
+    assert fo[0]["error"] == "MeshFailureError"
+    assert TTL <= fo[0]["detect_s"] < 20.0
+    expired = [e for e in events if e["ev"] == "fleet.lease"
+               and e.get("status") == "expired"]
+    assert any(e["mesh"] == 1 for e in expired)
+    reasons = [e["reason"] for e in events if e["ev"] == "fleet.route"]
+    assert reasons.count("rebind") >= 1
+    # the injected kill itself was journaled by the dying mesh
+    killed = [e for e in events if e["ev"] == "fault"
+              and e.get("point") == "fleet.route"]
+    assert any(e.get("mode") == "kill" for e in killed)
+
+    # fleet timeline lint-clean through the real pa-obs CLI
+    from pencilarrays_tpu.obs.__main__ import main
+
+    assert main(["lint", obsdir]) == 0
+    assert main(["timeline", obsdir]) == 0
+    assert main(["trace", obsdir, "-o",
+                 str(tmp_path / "trace.json")]) == 0
+
+
+@pytest.mark.slow
+def test_double_failover_across_processes(tmp_path):
+    """The slow satellite variant: THREE subprocess meshes; the placed
+    mesh is SIGKILLed, then the re-bind target is SIGKILLed too — the
+    ticket must resolve exactly once on the third."""
+    kvroot = str(tmp_path / "kv")
+    kv = FileKV(kvroot)
+    procs = {m: _spawn(kvroot, m, str(tmp_path)) for m in (1, 2, 3)}
+    router = None
+    try:
+        _await_live(kv, [1, 2, 3])
+        router = FleetRouter(kv, ttl=TTL)
+        for m in procs:
+            router.register_mesh(m)
+        u = _host(99, SHAPES["minnow"])
+        # submit AND kill the placed mesh before it can poll the
+        # request off the wire is racy across processes; instead kill
+        # first and let placement route around the corpse twice
+        first = router._place("minnow", u.nbytes, None)[0]
+        procs[first].send_signal(signal.SIGKILL)
+        procs[first].wait(timeout=15)
+        t = router.submit("acme", u, name="minnow")
+        with router._lock:
+            placed = next(iter(router._pending.values())).mesh
+        if placed == first:     # placed onto the corpse: must rebind
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                router.pump()
+                if router.stats()["dead_meshes"]:
+                    break
+                time.sleep(0.05)
+            with router._lock:
+                pend = next(iter(router._pending.values()), None)
+            placed = pend.mesh if pend is not None else None
+        if placed is not None:
+            # second failure: kill whichever mesh now holds the ticket
+            procs[placed].send_signal(signal.SIGKILL)
+            procs[placed].wait(timeout=15)
+        assert router.drain(60.0) == 0
+        np.testing.assert_allclose(np.asarray(t.result(1.0)),
+                                   np.fft.fftn(u), rtol=1e-3, atol=1e-3)
+        stats = router.stats()
+        assert stats["completed"] == 1 and stats["failed"] == 0
+        assert stats["duplicates"] == 0
+        assert len(stats["dead_meshes"]) >= 1
+    finally:
+        if router is not None:
+            router.close()
+        for m in procs:
+            kv.set(f"pa/fleet/stop/m{m}", "stop")
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        _reap(procs)
