@@ -796,6 +796,21 @@ def main():
                              "the BENCH_WIRE.json artifact)")
     parser.add_argument("--wire-n", type=int, default=32,
                         help="cube edge of the wire arm's grid")
+    parser.add_argument("--loadgen", action="store_true",
+                        help="also run the production-shaped load arm "
+                             "(benchmarks/loadgen.py): a seeded "
+                             "heavy-tailed trace replayed through the "
+                             "public submit API — per-tenant p50/p99 "
+                             "vs SLO, shed precision/recall, burn-rate "
+                             "trajectory with the alert pinned inside "
+                             "the injected overload window, and the "
+                             "tracing-disabled overhead repeats; "
+                             "writes BENCH_LOADGEN.json")
+    parser.add_argument("--loadgen-only", action="store_true",
+                        help="run ONLY the --loadgen arm (used to "
+                             "commit the BENCH_LOADGEN.json artifact)")
+    parser.add_argument("--loadgen-n", type=int, default=10_000,
+                        help="requests in the loadgen replay trace")
     args = parser.parse_args()
 
     import jax
@@ -1030,6 +1045,28 @@ def main():
                     "n_devices": len(devs)}, "BENCH_WIRE.json",
                    devs=devs)
         if args.wire_only:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+            print(json.dumps(results, indent=1))
+            return
+
+    # -- 19. loadgen: production-shaped load + tracing/burn planes ---------
+    # The ISSUE 18 acceptance: a deterministic seeded trace (heavy-tailed
+    # tenant mix, diurnal ramp, correlated bursts, one injected overload
+    # window) replayed at >=10^4 requests through the public submit API
+    # with request tracing and the burn-rate monitor live — committed as
+    # BENCH_LOADGEN.json.
+    if args.loadgen or args.loadgen_only:
+        import tempfile
+
+        from benchmarks.loadgen import run_loadgen_suite
+        from benchmarks.loadgen import write_artifact as write_loadgen
+
+        with tempfile.TemporaryDirectory() as wd:
+            results["loadgen"] = run_loadgen_suite(
+                devs, n_requests=args.loadgen_n, workdir=wd)
+        write_loadgen(results["loadgen"], "BENCH_LOADGEN.json", devs=devs)
+        if args.loadgen_only:
             with open(args.out, "w") as f:
                 json.dump(results, f, indent=1)
             print(json.dumps(results, indent=1))

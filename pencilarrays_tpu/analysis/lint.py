@@ -53,6 +53,19 @@ can enforce at runtime:
     through the sanctioned ``analysis.spmd`` entry points
     (``step_hop_peak`` / ``predicted_peak_hbm`` / ``verify_hbm``), so
     a second, diverging footprint model cannot grow anywhere (empty
+    allowlist);
+``trace-ctx``
+    the request trace context (schema v6, ``obs/requestflow.py``) is
+    minted ONLY at the two admission points — ``fleet/router.py`` and
+    ``serve/service.py``, plus the definition site — and PROPAGATED
+    everywhere else: every ``encode_request(`` call in ``fleet/``
+    passes ``trace=`` (a cross-wire re-encode that re-minted would
+    shear the causal chain exactly at the failover the post-mortem
+    cares about), ``fleet/worker.py`` admits into its service only
+    under a ``requestflow.installed(...)`` block (so the serve layer
+    ADOPTS the inbound trace instead of minting a fresh one), and the
+    serve dispatch-meta builder carries the ``"trace"`` key so
+    engine-side records join the request's timeline (empty
     allowlist).
 
 Everything is parsed from source with :mod:`ast` — the linter never
@@ -100,7 +113,7 @@ _MUTATING_METHODS = frozenset({
 
 CHECKS = ("journal-event", "fleet-event", "env-knob", "plan-cache",
           "fault-point", "unlocked-state", "thread-spawn", "wire-cast",
-          "hop-peak")
+          "hop-peak", "trace-ctx")
 
 # the exchange-program sources the wire-cast check audits: whole
 # modules whose traced bodies build exchange programs, plus named
@@ -115,6 +128,17 @@ WIRE_CAST_FUNCTIONS = {"ops/fft.py": ("_fused_hop_fn",)}
 # (hop-peak check); everything else bounds through analysis.spmd
 HOP_PEAK_NAME = "_hop_peak_bytes"
 HOP_PEAK_MODULES = ("parallel/routing.py", "analysis/spmd.py")
+
+# trace-ctx check: the only modules allowed to MINT a request trace
+# (the two admission points plus the definition site), the worker
+# whose service admissions must run under installed(), and the serve
+# module whose dispatch-meta builder must carry the trace key
+TRACE_MINT_NAME = "mint_trace"
+TRACE_MINT_MODULES = ("obs/requestflow.py", "fleet/router.py",
+                      "serve/service.py")
+TRACE_WORKER_MODULE = "fleet/worker.py"
+TRACE_META_MODULE = "serve/service.py"
+TRACE_META_FUNCTION = "_dispatch_meta"
 
 
 @dataclass(frozen=True)
@@ -758,6 +782,119 @@ def _check_hop_peak(root: str, trees: Dict[str, ast.Module],
         visit(tree, "<module>")
 
 
+def _is_installed_ctx(expr: ast.AST) -> bool:
+    """``requestflow.installed(...)`` / ``installed(...)`` as a with-
+    item context expression."""
+    if not isinstance(expr, ast.Call):
+        return False
+    f = expr.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "installed"
+    return isinstance(f, ast.Name) and f.id == "installed"
+
+
+def _check_trace_ctx(root: str, trees: Dict[str, ast.Module],
+                     findings: List[Finding]) -> None:
+    """The request trace context is minted at the two admission points
+    and PROPAGATED everywhere else (module docstring).  Three
+    sub-rules, each anchored on a concrete site: the ``mint_trace``
+    choke point (everywhere), cross-wire ``encode_request`` calls in
+    ``fleet/`` must pass ``trace=``, the worker's service admissions
+    must run under ``requestflow.installed(...)``, and the serve
+    dispatch-meta builder must carry the ``"trace"`` key.  Rules
+    anchored on files or functions a tree does not have skip silently
+    (a fixture repo without a fleet layer has nothing to propagate).
+    The ident is ``<dotted module>.<enclosing function>`` (the
+    thread-spawn convention)."""
+    mint_allowed = {os.path.join(root, PACKAGE, *m.split("/"))
+                    for m in TRACE_MINT_MODULES}
+    fleet_prefix = os.path.join(root, PACKAGE, "fleet") + os.sep
+    worker_path = os.path.join(root, PACKAGE,
+                               *TRACE_WORKER_MODULE.split("/"))
+    meta_path = os.path.join(root, PACKAGE,
+                             *TRACE_META_MODULE.split("/"))
+    for path, tree in trees.items():
+        dotted = _module_dotted(root, path)
+        in_fleet = path.startswith(fleet_prefix)
+
+        def visit(node: ast.AST, scope: str, installed: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                inner, inst = scope, installed
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    inner = child.name
+                    inst = False    # a nested def runs later, outside
+                    # the enclosing with's dynamic extent
+                if isinstance(child, ast.With) and any(
+                        _is_installed_ctx(i.context_expr)
+                        for i in child.items):
+                    inst = True
+                # (a) the mint choke point: only the admission points
+                # (and the definition site) may reference mint_trace
+                if path not in mint_allowed and (
+                        (isinstance(child, ast.Name)
+                         and child.id == TRACE_MINT_NAME)
+                        or (isinstance(child, ast.Attribute)
+                            and child.attr == TRACE_MINT_NAME)
+                        or (isinstance(child, ast.ImportFrom) and any(
+                            a.name == TRACE_MINT_NAME
+                            for a in child.names))):
+                    findings.append(Finding(
+                        "trace-ctx", _rel(root, path), child.lineno,
+                        f"{dotted}.{scope}",
+                        f"{TRACE_MINT_NAME} referenced in {dotted}."
+                        f"{scope} — a trace is minted ONCE at "
+                        f"admission (fleet/router.py or serve/"
+                        f"service.py); minting mid-path shears the "
+                        f"request's causal chain"))
+                if isinstance(child, ast.Call):
+                    f = child.func
+                    fname = (f.attr if isinstance(f, ast.Attribute)
+                             else f.id if isinstance(f, ast.Name)
+                             else None)
+                    # (b) cross-wire re-encodes propagate the trace
+                    if (in_fleet and fname == "encode_request"
+                            and not any(k.arg in ("trace", None)
+                                        for k in child.keywords)):
+                        findings.append(Finding(
+                            "trace-ctx", _rel(root, path),
+                            child.lineno, f"{dotted}.{scope}",
+                            f"encode_request call in {dotted}.{scope} "
+                            f"does not pass trace= — a re-encode that "
+                            f"drops (or re-mints) the trace shears "
+                            f"the causal chain exactly at the "
+                            f"rebind/failover the post-mortem needs"))
+                    # (c) worker admissions adopt the inbound trace
+                    if (path == worker_path and fname == "submit"
+                            and isinstance(f, ast.Attribute)
+                            and not inst):
+                        findings.append(Finding(
+                            "trace-ctx", _rel(root, path),
+                            child.lineno, f"{dotted}.{scope}",
+                            f".submit( in {dotted}.{scope} outside a "
+                            f"requestflow.installed(...) block — the "
+                            f"serve layer would mint a fresh trace "
+                            f"for a routed request instead of "
+                            f"adopting the wire's"))
+                visit(child, inner, inst)
+
+        visit(tree, "<module>", False)
+        # (d) the dispatch-meta builder carries the trace key (the
+        # engine installs it around the run — dropping it silently
+        # orphans every engine/guard/retry record from its request)
+        if path == meta_path:
+            fn = next((n for n in ast.walk(tree)
+                       if isinstance(n, ast.FunctionDef)
+                       and n.name == TRACE_META_FUNCTION), None)
+            if fn is not None and "trace" not in _dict_str_keys(fn):
+                findings.append(Finding(
+                    "trace-ctx", _rel(root, path), fn.lineno,
+                    f"{dotted}.{TRACE_META_FUNCTION}",
+                    f"{TRACE_META_FUNCTION} builds no dict with a "
+                    f"'trace' key — engine-side records would journal "
+                    f"with no request attribution"))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -789,6 +926,7 @@ def lint_tree(root: str) -> List[Finding]:
     _check_thread_spawn(root, trees, findings)
     _check_wire_cast(root, trees, findings)
     _check_hop_peak(root, trees, findings)
+    _check_trace_ctx(root, trees, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.check, f.ident))
     return findings
 

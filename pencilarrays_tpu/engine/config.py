@@ -92,6 +92,39 @@ WATCHED_VARS: Tuple[str, ...] = (
     ENGINE_STARVE_VAR,
 )
 
+# ``current()`` probes every watched var on EVERY call — it sits under
+# ``obs.enabled()``/``guard`` gates on per-dispatch hot paths.
+# ``os.environ.get`` pays a raised-and-caught KeyError per MISSING var
+# (Mapping.get over __getitem__), which at 27 mostly-unset vars is
+# tens of microseconds per probe.  Probing the backing dict with its
+# encoded keys is exception-free and ~15x cheaper; the values are only
+# compared for equality, so bytes vs str never matters.  Falls back to
+# the portable path when the private mapping is absent (non-CPython).
+try:
+    _ENV_DATA = os.environ._data
+    _ENC_KEYS: Tuple = tuple(
+        os.environ.encodekey(v) for v in WATCHED_VARS)
+
+    def _env_key() -> Tuple:
+        d = _ENV_DATA
+        return tuple(d.get(k) for k in _ENC_KEYS)
+
+    # one import-time probe: a mutation through os.environ must be
+    # visible to the fast path, or a late-armed var would silently
+    # never re-resolve — on any disagreement fall back wholesale
+    _k, _saved = WATCHED_VARS[0], os.environ.get(WATCHED_VARS[0])
+    os.environ[_k] = "_pa_cfg_probe"
+    _seen = _ENV_DATA.get(os.environ.encodekey(_k))
+    if _saved is None:
+        del os.environ[_k]
+    else:
+        os.environ[_k] = _saved
+    if _seen != os.environ.encodevalue("_pa_cfg_probe"):
+        raise AttributeError("os.environ._data not authoritative")
+except (AttributeError, TypeError, KeyError):
+    def _env_key() -> Tuple:
+        return tuple(os.environ.get(v) for v in WATCHED_VARS)
+
 
 def _float(raw: Optional[str], default: float) -> float:
     try:
@@ -243,7 +276,7 @@ def current() -> RuntimeConfig:
     contract).  Steady path: one tuple of getenv reads, one compare,
     no lock."""
     global _cache_pair
-    key = tuple(os.environ.get(v) for v in WATCHED_VARS)
+    key = _env_key()
     pair = _cache_pair
     if pair is not None and pair[0] == key:
         return pair[1]

@@ -898,9 +898,16 @@ class Engine:
             else:
                 operand = task.pack_future._result
         if err is None:
+            from ..obs import requestflow
+
             try:
-                out = (task.run() if operand is _NO_OPERAND
-                       else task.run(operand))
+                # the task's request trace (dispatch meta) is ambient
+                # for the whole run: guard.recover / retry / fault
+                # records fired inside journal under the request's id
+                # even though they execute on the consumer thread
+                with requestflow.installed(task.meta.get("trace")):
+                    out = (task.run() if operand is _NO_OPERAND
+                           else task.run(operand))
             except BaseException as e:
                 # NEVER re-raise on the consumer: a dead consumer
                 # strands every queued future with no symptom.  The

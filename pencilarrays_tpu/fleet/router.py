@@ -43,6 +43,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from . import wire
+from ..obs import requestflow
 from .cost import FleetCost
 from .health import MeshBoard
 
@@ -54,10 +55,10 @@ class _Pending:
 
     __slots__ = ("ticket", "tid", "tenant", "name", "direction",
                  "payload", "nbytes", "deadline_s", "t_submit",
-                 "mesh", "rebinds")
+                 "mesh", "rebinds", "trace")
 
     def __init__(self, ticket, tid, tenant, name, direction, payload,
-                 nbytes, deadline_s):
+                 nbytes, deadline_s, trace=None):
         self.ticket = ticket
         self.tid = tid
         self.tenant = tenant
@@ -69,6 +70,7 @@ class _Pending:
         self.t_submit = time.time()
         self.mesh: Optional[int] = None     # None = parked
         self.rebinds = 0
+        self.trace = trace                  # minted ONCE at admission
 
 
 class FleetRouter:
@@ -204,16 +206,20 @@ class FleetRouter:
         deadline_s = slo.deadline_s if slo is not None else None
         ticket = Ticket(tenant, "fleet", f"fleet:{name}:{direction}")
         tid = str(ticket.id)
+        # the request's trace context, minted ONCE here at fleet
+        # admission and propagated through every re-encode/rebind
+        # (obs/requestflow.py; the trace-ctx lint audits the path)
+        trace = requestflow.mint_trace()
         placed = self._place(name, nbytes, deadline_s)
         if placed is None:
-            self._journal_route(tid, tenant, -1, "no-mesh", None)
+            self._journal_route(tid, tenant, -1, "no-mesh", None, trace)
             raise AdmissionError(
                 f"tenant {tenant!r}: no live mesh can take "
                 f"{name!r} (fleet has {len(self.meshes())} registered, "
                 f"0 placeable)", tenant=tenant, reason="no-mesh")
         mesh, score = placed
         p = _Pending(ticket, tid, tenant, name, direction, payload,
-                     nbytes, deadline_s)
+                     nbytes, deadline_s, trace)
         p.mesh = mesh
         with self._lock:
             self._pending[tid] = p
@@ -222,17 +228,19 @@ class FleetRouter:
                     wire.encode_request(
                         tid, tenant=tenant, name=name,
                         direction=direction, payload=payload,
-                        t_submit=p.t_submit, deadline_s=deadline_s))
-        self._journal_route(tid, tenant, mesh, "placed", score)
+                        t_submit=p.t_submit, deadline_s=deadline_s,
+                        trace=trace))
+        self._journal_route(tid, tenant, mesh, "placed", score, trace)
         return ticket
 
-    def _journal_route(self, tid, tenant, mesh, reason, score) -> None:
+    def _journal_route(self, tid, tenant, mesh, reason, score,
+                       trace) -> None:
         from .. import obs
 
         if not obs.enabled():
             return
         fields = {"ticket": tid, "tenant": tenant, "mesh": mesh,
-                  "reason": reason,
+                  "reason": reason, "trace": trace,
                   "score_bytes": (score["total"] if score else None)}
         if score:
             fields.update(wire_bytes=score["wire"],
@@ -300,7 +308,7 @@ class FleetRouter:
                 self._stats["expired"] += 1
             self._journal_route(p.tid, p.tenant, p.mesh
                                 if p.mesh is not None else -1,
-                                "expired", None)
+                                "expired", None, p.trace)
             self._resolve(p.tid, error=DeadlineError(
                 f"tenant {p.tenant!r}: request {p.tid} missed its "
                 f"{p.deadline_s}s deadline in the fleet queue",
@@ -328,9 +336,14 @@ class FleetRouter:
             newly_dead.append(mesh)
             detect_s = getattr(err, "age_s", None)
             if obs.enabled():
+                # the parked tickets' trace ids ride the failover
+                # record: pa-obs request joins each affected request's
+                # timeline to the ONE sweep that re-bound it
                 obs.record_event(
                     "fleet.failover", mesh=mesh, tickets=len(parked),
                     detect_s=detect_s, error=type(err).__name__,
+                    traces=[p.trace for p in parked
+                            if p.trace is not None],
                     _fsync=True)
         return newly_dead
 
@@ -352,7 +365,7 @@ class FleetRouter:
             p.rebinds += 1
             if p.rebinds > self.max_rebinds:
                 self._journal_route(p.tid, p.tenant, -1,
-                                    "rebind-exhausted", None)
+                                    "rebind-exhausted", None, p.trace)
                 self._resolve(p.tid, error=AdmissionError(
                     f"tenant {p.tenant!r}: request {p.tid} re-bound "
                     f"{self.max_rebinds}x and still found no stable "
@@ -362,7 +375,7 @@ class FleetRouter:
             placed = self._place(p.name, p.nbytes, p.deadline_s)
             if placed is None:
                 self._journal_route(p.tid, p.tenant, -1, "no-mesh",
-                                    None)
+                                    None, p.trace)
                 self._resolve(p.tid, error=AdmissionError(
                     f"tenant {p.tenant!r}: request {p.tid} lost its "
                     f"mesh and no live sibling remains",
@@ -376,8 +389,10 @@ class FleetRouter:
                             direction=p.direction, payload=p.payload,
                             t_submit=p.t_submit,
                             deadline_s=p.deadline_s,
-                            rebinds=p.rebinds))
-            self._journal_route(p.tid, p.tenant, mesh, "rebind", score)
+                            rebinds=p.rebinds,
+                            trace=p.trace))
+            self._journal_route(p.tid, p.tenant, mesh, "rebind", score,
+                                p.trace)
             with self._lock:
                 self._stats["rebound"] += 1
             rebound += 1

@@ -116,14 +116,21 @@ def decode_array(d: dict) -> np.ndarray:
 def encode_request(ticket_id: str, *, tenant: str, name: str,
                    direction: str, payload, t_submit: float,
                    deadline_s: Optional[float] = None,
-                   rebinds: int = 0) -> str:
+                   rebinds: int = 0,
+                   trace: Optional[str] = None) -> str:
     """One routed request as a KV value.  ``name`` addresses a plan
     registered on the back-end (requests cross meshes by NAME, never
-    by plan object — each mesh builds the plan on its own topology)."""
+    by plan object — each mesh builds the plan on its own topology).
+    ``trace`` is the request's trace context (obs/requestflow.py),
+    minted once at router admission and PROPAGATED on every re-encode
+    — a rebind that re-minted would shear the causal chain exactly at
+    the failover the post-mortem cares about (the trace-ctx lint
+    audits every call site)."""
     return json.dumps({
         "ticket": ticket_id, "tenant": tenant, "name": name,
         "direction": direction, "t_submit": t_submit,
         "deadline_s": deadline_s, "rebinds": rebinds,
+        "trace": trace,
         "payload": encode_array(payload),
     })
 

@@ -162,16 +162,21 @@ class MeshWorker:
         admission path — the ``fleet.route`` injection point fires
         here, addressable per mesh via ``%mesh<k>``).  Returns False
         when the request resolved typed at admission."""
+        from ..obs import requestflow
         from ..resilience import faults
         from ..serve.errors import ServeError
 
         self._handled += 1
         try:
-            faults.fire("fleet.route", mesh=self.mesh, ticket=tid,
-                        tenant=req["tenant"])
-            ticket = self.service.submit(
-                req["tenant"], np.ascontiguousarray(req["payload"]),
-                name=req["name"], direction=req["direction"])
+            # install the inbound trace as ambient context: the serve
+            # layer adopts it (never re-mints — trace-ctx lint) and a
+            # fault fired HERE journals under the dying request's id
+            with requestflow.installed(req.get("trace")):
+                faults.fire("fleet.route", mesh=self.mesh, ticket=tid,
+                            tenant=req["tenant"])
+                ticket = self.service.submit(
+                    req["tenant"], np.ascontiguousarray(req["payload"]),
+                    name=req["name"], direction=req["direction"])
         except Exception as e:
             if not isinstance(e, (ServeError, faults.InjectedFault)):
                 raise

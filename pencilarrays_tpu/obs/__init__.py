@@ -43,6 +43,16 @@ PR 7 grows this into the **mesh-wide observability plane**:
   CLI: ``merge`` / ``lint`` / ``timeline`` / ``trace`` / ``drift`` /
   ``bundle``.
 
+PR 18 adds the **request-flow plane**:
+
+* :mod:`~pencilarrays_tpu.obs.requestflow` — the request trace
+  context (``trace`` — 16 hex chars minted once at fleet/serve
+  admission, schema v6), carried across the fleet wire and stamped
+  into every record on a request's path, plus the per-request causal
+  reconstruction behind ``pa-obs request <trace_id>`` /
+  ``pa-obs requests`` (critical-path decomposition across router +
+  N mesh journals; wreckage degrades to warnings).
+
 Everything is **off by default** and near-zero overhead when off: call
 sites guard with :func:`enabled` (one cached env lookup) and never build
 payloads on the disabled path — the observability analog of the
@@ -79,6 +89,11 @@ from .drift import drift_report, drift_tracker, record_hop_sample  # noqa: F401
 from .schema import lint_event, lint_journal  # noqa: F401
 from .correlate import current_step, next_step, set_plan, step  # noqa: F401
 from .timeline import merge_journals, to_trace, write_trace  # noqa: F401
+from .requestflow import (  # noqa: F401
+    current_trace,
+    list_requests,
+    reconstruct_request,
+)
 
 __all__ = [
     "ENV_VAR",
@@ -113,4 +128,8 @@ __all__ = [
     "merge_journals",
     "to_trace",
     "write_trace",
+    # request-flow plane (PR 18)
+    "current_trace",
+    "reconstruct_request",
+    "list_requests",
 ]

@@ -20,6 +20,14 @@ dead mesh, and get the merged cross-rank story.
                              verdicts
     trace DIR [-o FILE]      Chrome/Perfetto trace_event JSON (default
                              DIR/trace.json) — load at ui.perfetto.dev
+    request DIR TRACE_ID     ONE request's causal timeline across
+                             router + N mesh journals (schema v6
+                             trace ids) with critical-path
+                             decomposition; exit 1 if the id appears
+                             in no record (warnings alone exit 0)
+    requests DIR             index every traced request: tenant,
+                             ranks touched, rebinds, total seconds,
+                             outcome
     drift DIR                per-hop predicted-vs-measured drift table
                              (mesh_metrics.json when present, else
                              metrics.json)
@@ -98,6 +106,32 @@ def _cmd_trace(args) -> int:
     print(f"wrote {len(trace['traceEvents'])} trace events for rank(s) "
           f"{trace['otherData'].get('ranks', [])} to {out} "
           f"(load at https://ui.perfetto.dev)")
+    return 0
+
+
+def _cmd_request(args) -> int:
+    from .requestflow import reconstruct_request, render_request
+
+    rt, warnings = reconstruct_request(
+        args.dir, args.trace_id, correct_skew=not args.no_skew)
+    for w in warnings:
+        print(f"pa-obs: WARNING: {w}", file=sys.stderr)
+    if rt is None:
+        print(f"trace {args.trace_id!r} appears in no record under "
+              f"{args.dir} (pa-obs requests lists known ids)")
+        return 1
+    print(render_request(rt))
+    return 0
+
+
+def _cmd_requests(args) -> int:
+    from .requestflow import list_requests, render_index
+
+    summaries, warnings = list_requests(
+        args.dir, correct_skew=not args.no_skew)
+    for w in warnings:
+        print(f"pa-obs: WARNING: {w}", file=sys.stderr)
+    print(render_index(summaries))
     return 0
 
 
@@ -191,9 +225,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             ("merge", _cmd_merge, "merged causally-ordered journal"),
             ("lint", _cmd_lint, "schema lint + merge warnings"),
             ("timeline", _cmd_timeline, "per-step cross-rank timeline"),
-            ("trace", _cmd_trace, "Perfetto trace_event JSON")):
+            ("trace", _cmd_trace, "Perfetto trace_event JSON"),
+            ("request", _cmd_request,
+             "one request's cross-journal causal timeline"),
+            ("requests", _cmd_requests, "index every traced request")):
         sp = add(name, fn, help_)
         sp.add_argument("dir", help="journal directory")
+        if name == "request":
+            sp.add_argument("trace_id",
+                            help="schema-v6 trace id (16 hex chars)")
         sp.add_argument("--no-skew-correct", dest="no_skew",
                         action="store_true",
                         help="keep raw per-host wall clocks")

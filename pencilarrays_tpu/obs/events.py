@@ -85,7 +85,14 @@ DEFAULT_DIR = "pa_obs"
 # ``lane`` (the priority lane the batch was submitted on) and ``chain``
 # (the dependency chain it orders within) — see obs/schema.py
 # V5_EVENT_FIELDS.  Earlier journals again stay lint-clean.
-SCHEMA_VERSION = 5
+# v6 (PR 18): the request-flow plane — every ``fleet.route`` /
+# ``serve.request`` / ``serve.coalesce`` / ``serve.dispatch`` /
+# ``serve.complete`` record additionally carries the request trace id
+# ``trace`` (obs/requestflow.py; a coalesced batch's records also
+# journal the B-way ``traces`` fan-in), the key ``pa-obs request``
+# joins one ticket's causal timeline across router + mesh journals
+# by.  v1-v5 journals again stay lint-clean.
+SCHEMA_VERSION = 6
 
 # events whose loss would blind a post-mortem: fsync'd under the default
 # "critical" policy.  High-rate events (per-hop dispatch) only flush.
@@ -112,6 +119,10 @@ CRITICAL_EVENTS = frozenset({
     # behavior (failures, capacity moves) — the record must survive
     # the crash that often follows the overload that caused it
     "serve.slo_violation", "serve.pressure", "serve.scale",
+    # an error-budget burn alert gates paging/shedding policy, and it
+    # fires exactly when the process is most likely to die of the
+    # overload that tripped it — the record must outlive the crash
+    "serve.burn_alert",
     # fleet federation: a whole-mesh failover gates every re-bound
     # ticket, and a supervisor scale action moves real capacity —
     # both must survive the crash cascade that usually surrounds
@@ -180,10 +191,11 @@ def _reset_for_tests() -> None:
         _run_id = None
         _seq = 0
     from ..engine import config as _rtc
-    from . import correlate
+    from . import correlate, requestflow
 
     _rtc._reset_for_tests()
     correlate._reset_for_tests()
+    requestflow._reset_for_tests()
 
 
 def journal_dir() -> str:
@@ -388,7 +400,7 @@ def _rotate_locked() -> None:
 def _write_locked(ev: str, fields: dict, proc: Optional[int] = None,
                   fsync: Optional[bool] = None) -> None:
     global _seq
-    from . import correlate
+    from . import correlate, requestflow
 
     _seq += 1
     rec = {"v": SCHEMA_VERSION, "ev": ev, "run": run_id(),
@@ -405,6 +417,12 @@ def _write_locked(ev: str, fields: dict, proc: Optional[int] = None,
     # the global counter reads at write time (a concurrent advance
     # between payload construction and this lock must not rewrite it)
     for k, v in correlate.stamp().items():
+        rec.setdefault(k, v)
+    # the ambient request trace (obs/requestflow.py) folds in by the
+    # same discipline: the serve/fleet emitters pass trace= explicitly
+    # (their records are written from pump/engine threads with no
+    # ambient context), and that explicit value always wins
+    for k, v in requestflow.stamp().items():
         rec.setdefault(k, v)
     _file.write(json.dumps(rec, separators=(",", ":")) + "\n")
     _file.flush()

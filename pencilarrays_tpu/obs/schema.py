@@ -15,7 +15,8 @@ from typing import Dict, Iterable, List, Tuple, Union
 from .events import SCHEMA_VERSION
 
 __all__ = ["COMMON_FIELDS", "EVENT_TYPES", "V4_EVENT_FIELDS",
-           "V5_EVENT_FIELDS", "lint_event", "lint_journal"]
+           "V5_EVENT_FIELDS", "V6_EVENT_FIELDS", "lint_event",
+           "lint_journal"]
 
 # fields every record carries (written by events.record_event itself)
 COMMON_FIELDS: Tuple[str, ...] = (
@@ -55,6 +56,22 @@ V4_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
 # lint-clean, as with the earlier versioned stamps.
 V5_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     "serve.dispatch": ("lane", "chain"),
+}
+
+# per-event fields required since schema v6 (the request-flow plane):
+# every record on a request's path carries the trace id minted once at
+# admission (obs/requestflow.py) — the key ``pa-obs request`` joins
+# one ticket's causal timeline across router + N mesh journals by.  A
+# coalesced batch's formation record additionally journals the B-way
+# fan-in (``traces``: every member's id) so one dispatch span is
+# attributable to each member request.  v1-v5 journals stay
+# lint-clean, as with every earlier versioned stamp.
+V6_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
+    "fleet.route": ("trace",),
+    "serve.request": ("trace",),
+    "serve.coalesce": ("trace", "traces"),
+    "serve.dispatch": ("trace", "traces"),
+    "serve.complete": ("trace",),
 }
 
 # ev -> required payload fields (extra fields are allowed; missing ones
@@ -119,6 +136,12 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     "serve.slo_violation": ("tenant", "req", "deadline_s", "late_s"),
     "serve.pressure": ("state", "prev", "drain_s"),
     "serve.scale": ("direction", "reason", "projection"),
+    # the SLO error-budget burn-rate monitor (serve/slo.py): a
+    # tenant's budget is burning faster than the alert threshold —
+    # always fsync-critical, the record must outlive the overload
+    # that tripped it
+    "serve.burn_alert": ("tenant", "burn_rate", "threshold",
+                         "window_s"),
     # per-mesh task-graph executor (engine/): one record per engine
     # reformation boundary (queued dispatches dropped typed, fresh
     # RuntimeConfig snapshot, new generation)
@@ -195,6 +218,12 @@ def lint_event(e: dict) -> List[str]:
                 errors.append(
                     f"v{v} event {ev!r} missing required field {f!r} "
                     f"(DAG-engine lane fields, schema v5): {e!r}")
+    if isinstance(v, (int, float)) and v >= 6:
+        for f in V6_EVENT_FIELDS.get(ev, ()):
+            if f not in e:
+                errors.append(
+                    f"v{v} event {ev!r} missing required field {f!r} "
+                    f"(request-trace fields, schema v6): {e!r}")
     return errors
 
 

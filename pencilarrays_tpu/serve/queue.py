@@ -116,6 +116,7 @@ class _Entry:
     shed_priority: int = 0        # the tenant's SLO shed tier
     cost_bytes: int = 0           # priced B=1 cost (projection currency)
     departed: bool = False        # left _pending (lazy SLO-heap skip)
+    trace: Optional[str] = None   # request trace context (schema v6)
 
 
 @dataclass
@@ -197,6 +198,19 @@ class AdmissionQueue:
         # scan accounting (the scaling assertion's deterministic pin)
         self._take_calls = 0
         self._groups_scanned = 0
+        # -- the depth index (load-export fix) --
+        # depth() sits on the fleet worker's 50ms load-export path
+        # (service.load_projection -> publish_load): the v1 body
+        # re-counted every queued entry per call — O(depth) per export,
+        # superlinear across a burst.  Queued-entry counts (distinct
+        # from _tenant_requests/_bytes, which also cover EXECUTING
+        # work and release at completion) are now maintained at offer
+        # and at every _pending departure; depth() just reads them.
+        # depth_entries_scanned stays 0 on the O(1) path — the
+        # scaling assertion's pin (reintroducing a scan must bump it).
+        self._depth_total = 0
+        self._depth_tenant: Dict[str, int] = {}
+        self._depth_entries_scanned = 0
 
     # -- admission ---------------------------------------------------------
     def quota_for(self, tenant: str) -> TenantQuota:
@@ -233,6 +247,8 @@ class AdmissionQueue:
             entry.departed = False
             self._tenant_requests[t] = n + 1
             self._tenant_bytes[t] = b + entry.nbytes
+            self._depth_total += 1
+            self._depth_tenant[t] = self._depth_tenant.get(t, 0) + 1
             group = self._pending.setdefault(entry.ticket.key, [])
             group.append(entry)
             if len(group) == 1:
@@ -255,6 +271,20 @@ class AdmissionQueue:
         path's lock — nothing can slip in after this returns)."""
         with self._lock:
             self._closed = True
+
+    def _depart_locked(self, entry: _Entry) -> None:
+        """One entry leaves ``_pending`` (taken, shed or evicted):
+        flag it for the lazy heaps and settle the depth index.  Every
+        departure path MUST come through here — the depth counters are
+        only as honest as their bookkeeping.  Caller holds the lock."""
+        entry.departed = True
+        t = entry.ticket.tenant
+        self._depth_total -= 1
+        left = self._depth_tenant.get(t, 0) - 1
+        if left > 0:
+            self._depth_tenant[t] = left
+        else:
+            self._depth_tenant.pop(t, None)
 
     def release(self, entry: _Entry) -> None:
         """Return one request's quota (called at completion, ok or
@@ -320,7 +350,7 @@ class AdmissionQueue:
         if len(live) != len(entries):
             for e in entries:
                 if e.deadline is not None and now > e.deadline:
-                    e.departed = True
+                    self._depart_locked(e)
                     self._expired.append(e)
                     self.load.note_removed(e.cost_bytes)
             entries = live
@@ -330,13 +360,13 @@ class AdmissionQueue:
                              entries[self.max_batch:])
             self._pending[key] = entries
             for e in take:
-                e.departed = True
+                self._depart_locked(e)
             out.append(self._mk_batch(key, take, "full"))
         if entries and (flush or now - entries[0].ticket.t_submit
                         >= self.max_wait_s):
             del self._pending[key]
             for e in entries:
-                e.departed = True
+                self._depart_locked(e)
             out.append(self._mk_batch(
                 key, entries, "flush" if flush else "deadline"))
         elif not entries:
@@ -392,10 +422,15 @@ class AdmissionQueue:
     def scan_stats(self) -> dict:
         """Take-path scan accounting — ``groups_scanned`` across
         ``take_calls`` is what the depth-stress scaling assertion pins
-        (it must track DUE work, not queue breadth)."""
+        (it must track DUE work, not queue breadth).
+        ``depth_entries_scanned`` pins the depth-index fix the same
+        way: it must stay 0 no matter how often :meth:`depth` is
+        polled at depth (the load-export path reads counters, never
+        rescans the queue)."""
         with self._lock:
             return {"take_calls": self._take_calls,
-                    "groups_scanned": self._groups_scanned}
+                    "groups_scanned": self._groups_scanned,
+                    "depth_entries_scanned": self._depth_entries_scanned}
 
     @staticmethod
     def _mk_batch(key: str, entries: List[_Entry], reason: str) -> Batch:
@@ -428,7 +463,7 @@ class AdmissionQueue:
                 if len(keep) != len(entries):
                     for e in entries:
                         if e.shed_priority < protected_priority:
-                            e.departed = True
+                            self._depart_locked(e)
                             evicted.append(e)
                             self.load.note_removed(e.cost_bytes)
                     if keep:
@@ -569,11 +604,13 @@ class AdmissionQueue:
         return max(0.0, due - now) if due is not None else None
 
     def depth(self, tenant: Optional[str] = None) -> int:
+        """Queued entries, total or for one tenant — O(1) from the
+        depth index (this sits on the fleet load-export path, polled
+        every 50ms per mesh; see ``_depart_locked``)."""
         with self._lock:
             if tenant is None:
-                return sum(len(v) for v in self._pending.values())
-            return sum(1 for v in self._pending.values()
-                       for e in v if e.ticket.tenant == tenant)
+                return self._depth_total
+            return self._depth_tenant.get(tenant, 0)
 
     def tenants(self) -> Dict[str, dict]:
         """Per-tenant accounting snapshot (admitted, not yet done)."""

@@ -57,7 +57,19 @@ The full request lifecycle is journaled (``serve.request`` →
 ``serve.coalesce`` → ``serve.dispatch`` → ``serve.complete``,
 schema-registered in ``obs/schema.py``) and metered per tenant
 (``serve.*`` counters/histograms/gauges), so ``pa-obs timeline``
-renders a served run end to end.
+renders a served run end to end.  Every record on one request's path
+carries its **trace context** (schema v6, ``obs/requestflow.py``):
+admission ADOPTS an inbound ambient trace (a fleet worker installs
+the routed request's id — the trace-ctx lint forbids re-minting
+mid-path) and mints one only for direct submissions, so ``pa-obs
+request <trace_id>`` reconstructs the causal timeline across the
+router's and every mesh's journals — coalesced batches journal the
+B-way fan-in (``traces``) so one shared dispatch span is attributable
+to each member request.  Completions also feed the per-tenant SLO
+error-budget :class:`~pencilarrays_tpu.serve.slo.BurnRateMonitor`:
+when a tenant's budget burns faster than the alert threshold, ONE
+fsync-critical ``serve.burn_alert`` record fires per overload episode
+(edge-triggered with hysteresis).
 """
 
 from __future__ import annotations
@@ -76,7 +88,7 @@ from .errors import (AdmissionError, DeadlineError, ServeError,
 from .queue import AdmissionQueue, Batch, TenantQuota, Ticket, _Entry
 from .registry import PlanRegistry
 from .shed import PressureGate, PressurePolicy
-from .slo import SLO
+from .slo import SLO, BurnRateMonitor
 
 __all__ = ["PlanService"]
 
@@ -148,6 +160,12 @@ class PlanService:
         the load-shedding gate (water marks on the projected queue
         drain time).  ``None`` (default): no shedding, PR-10 admission
         semantics.
+    burn:
+        A :class:`~pencilarrays_tpu.serve.slo.BurnRateMonitor` for
+        per-tenant SLO error-budget burn tracking (default: one with
+        the monitor's own defaults).  Only tenants with a
+        ``deadline_s`` SLO feed it; a threshold crossing journals ONE
+        fsync-critical ``serve.burn_alert`` per overload episode.
     """
 
     def __init__(self, *, max_batch: int = 8, max_wait_s: float = 0.002,
@@ -157,7 +175,8 @@ class PlanService:
                  retry=None, registry: Optional[PlanRegistry] = None,
                  engine=None, hbm_limit: Optional[int] = None,
                  slos: Optional[Dict[str, SLO]] = None,
-                 pressure: Optional[PressurePolicy] = None):
+                 pressure: Optional[PressurePolicy] = None,
+                 burn: Optional[BurnRateMonitor] = None):
         self.registry = registry or PlanRegistry()
         self.hbm_limit = int(hbm_limit) if hbm_limit is not None else None
         self.queue = AdmissionQueue(
@@ -175,6 +194,7 @@ class PlanService:
                 raise TypeError(f"slos[{t!r}] is not an SLO: {s!r}")
         self._gate = PressureGate(pressure) if pressure is not None \
             else None
+        self.burn = burn if burn is not None else BurnRateMonitor()
         self._force_priced = False      # ensure_priced(): an attached
         # Autoscaler needs the projection even with no SLOs/gate
         self._protected = max(
@@ -316,6 +336,7 @@ class PlanService:
         snap["queue_depth"] = self.queue.depth()
         snap["pressure"] = (self._gate.state if self._gate is not None
                             else None)
+        snap["burn"] = self.burn.snapshot()
         return snap
 
     # -- submission --------------------------------------------------------
@@ -488,11 +509,19 @@ class PlanService:
     def _admit(self, entry: _Entry, *, direction: Optional[str] = None
                ) -> None:
         from .. import obs
+        from ..obs import requestflow
         from ..resilience import faults
 
         if self._closed:
             raise ServiceClosedError("service is closed")
         t = entry.ticket.tenant
+        # trace context: ADOPT the ambient inbound trace (a fleet
+        # worker installed the routed request's id — re-minting here
+        # would shear the cross-mesh causal chain; the trace-ctx lint
+        # audits this site), mint only for direct submissions — the
+        # serve layer is the second of the two admission points
+        entry.trace = (requestflow.current_trace()
+                       or requestflow.mint_trace())
         # the admission-boundary injection point: overload and
         # flaky-client drills inject here like at every other layer
         # (error raises InjectedFault to THIS submitter, delay drags
@@ -513,7 +542,7 @@ class PlanService:
                 self.queue.depth(t))
             fields = dict(tenant=t, req=entry.ticket.id,
                           kind=entry.ticket.kind, key=entry.ticket.key,
-                          nbytes=entry.nbytes)
+                          nbytes=entry.nbytes, trace=entry.trace)
             if direction is not None:
                 fields["direction"] = direction
             obs.record_event("serve.request", **fields)
@@ -926,10 +955,15 @@ class PlanService:
             # ONE logical dispatch = one coalesce/dispatch record —
             # a reformation-parked resubmission re-enters here but
             # must not double-journal or double-count
+            # the fan-in record: the leader's trace plus every
+            # member's (one dispatch span is SHARED by B requests —
+            # pa-obs request finds this record through either field)
             obs.record_event(
                 "serve.coalesce", key=batch.key, n=B,
                 reqs=[e.ticket.id for e in batch.entries],
-                reason=batch.reason, wait_s=wait_s)
+                reason=batch.reason, wait_s=wait_s,
+                trace=batch.entries[0].trace,
+                traces=[e.trace for e in batch.entries])
             obs.histogram("serve.batch_size", kind=batch.kind).observe(B)
         # per-entry payload validation BEFORE the shared dispatch: a
         # problem only one request can be blamed for (a stale device
@@ -961,7 +995,9 @@ class PlanService:
                 "serve.dispatch", key=batch.key, n=len(survivors),
                 tenants=tenants, score_bytes=batch.cost,
                 reason=batch.reason, lane=lane,
-                chain="|".join(writes) if writes else "*")
+                chain="|".join(writes) if writes else "*",
+                trace=survivors[0].trace,
+                traces=[e.trace for e in survivors])
         with self._lock:
             if not resubmit:
                 self._dispatches += 1
@@ -1108,8 +1144,13 @@ class PlanService:
         typed instead of certifying cleanly, and mixed-precision
         traffic is auditable per dispatch."""
         B = len(batch.entries)
+        # "trace" (the leader's) rides the engine task meta: the
+        # executor installs it as ambient context around the dispatch,
+        # so engine/guard/retry records journal under the request's id
+        # (trace-ctx lint: this dict must carry the inbound trace)
         meta = {"service": self._sid, "kind": batch.kind,
-                "key": batch.key, "n": B, "cost": batch.cost}
+                "key": batch.key, "n": B, "cost": batch.cost,
+                "trace": batch.entries[0].trace}
         if batch.kind == "fft":
             e0 = batch.entries[0]
             extra = (B,) if B > 1 else ()
@@ -1323,6 +1364,7 @@ class PlanService:
                 "serve.complete", _fsync=(error is not None),
                 tenant=t.tenant, req=t.id, outcome=outcome,
                 seconds=t.t_done - t.t_submit, key=batch_key,
+                trace=e.trace,
                 **({"error": str(error)} if error is not None else {}))
             if late:
                 # the completion enforcement point: the answer is
@@ -1334,7 +1376,26 @@ class PlanService:
                 obs.record_event(
                     "serve.slo_violation", tenant=t.tenant, req=t.id,
                     deadline_s=e.deadline - t.t_submit,
-                    late_s=t.t_done - e.deadline, key=batch_key)
+                    late_s=t.t_done - e.deadline, key=batch_key,
+                    trace=e.trace)
+        slo = self._slos.get(t.tenant)
+        if slo is not None and slo.deadline_s is not None:
+            # every deadline-carrying completion is a burn sample: a
+            # late answer and a deadline-typed failure (expired /
+            # projected shed) both spend the tenant's error budget
+            alert = self.burn.note(
+                t.tenant, late or isinstance(error, DeadlineError))
+            if obs.enabled():
+                obs.gauge("serve.burn_rate", tenant=t.tenant).set(
+                    self.burn.burn_rate(t.tenant) or 0.0)
+                if alert is not None:
+                    # the page: the budget is burning threshold-x too
+                    # fast — fsync-critical (an overload episode must
+                    # be on the record even if the process dies in it)
+                    obs.counter("serve.burn_alerts",
+                                tenant=t.tenant).inc()
+                    obs.record_event("serve.burn_alert", _fsync=True,
+                                     **alert)
         with self._lock:
             self._completed[outcome] = self._completed.get(outcome, 0) + 1
             if late:

@@ -40,6 +40,27 @@ dispatch in its window it projects ``None`` and admission lets
 everything through — a service that has never measured itself has no
 basis to reject, and the completion-point accounting will seed the
 window within one batch.
+
+PR 18 adds :class:`BurnRateMonitor` — the SLO **error-budget burn
+rate**: each tenant's budget allows a fraction of completions to bust
+their deadline (``budget``, e.g. 0.01 = 1%); the monitor tracks the
+observed violation fraction over a sliding time window and reports it
+as a multiple of the budget (burn rate 1.0 = burning exactly at
+budget; 4.0 = the budget will be gone in a quarter of the period).
+``PlanService`` feeds it at completion, exports per-tenant
+``serve.burn_rate`` gauges into the metrics snapshot (and so the
+mesh/fleet fold), and journals a fsync-critical ``serve.burn_alert``
+the moment a tenant crosses the alert threshold — edge-triggered with
+hysteresis, so an overload window produces ONE durable alert record,
+not one per completion.
+
+Every projection here is O(1) per call: the arrival window keeps a
+running cost sum (maintained against the deque's own evictions) and
+the burn windows keep running violation counts — the loadgen harness
+(``benchmarks/loadgen.py``) drives these paths at 10⁴–10⁵ depth,
+where a per-call window scan would quietly turn the admission hot
+path superlinear (``scan_stats`` pins that in
+``tests/test_serve_depth.py``).
 """
 
 from __future__ import annotations
@@ -48,9 +69,9 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Optional
+from typing import Dict, Optional
 
-__all__ = ["SLO", "LoadTracker"]
+__all__ = ["SLO", "LoadTracker", "BurnRateMonitor"]
 
 
 @dataclass(frozen=True)
@@ -105,6 +126,11 @@ class LoadTracker:
         self._inflight_cost = 0     # taken, not yet completed
         self._queued_n = 0
         self._inflight_n = 0
+        # running sum of the arrival window — arrival_cost_per_s is
+        # read on the load-export path (every 50 ms under a fleet
+        # router), so it must not re-scan the window per call
+        self._arrival_cost_sum = 0
+        self._arrivals_scanned = 0  # scan_stats: pins the O(1) claim
         # the rate is read on EVERY admission (hot path) but changes
         # only at completions: cache it per completion-window version
         self._version = 0
@@ -115,7 +141,12 @@ class LoadTracker:
                      now: Optional[float] = None) -> None:
         now = time.monotonic() if now is None else now
         with self._lock:
+            # the deque evicts its oldest element when appended at
+            # capacity: the running sum must shed that element first
+            if len(self._arrivals) == self._arrivals.maxlen:
+                self._arrival_cost_sum -= self._arrivals[0][1]
             self._arrivals.append((now, int(cost_bytes)))
+            self._arrival_cost_sum += int(cost_bytes)
             self._queued_cost += int(cost_bytes)
             self._queued_n += 1
 
@@ -189,16 +220,27 @@ class LoadTracker:
 
     def arrival_cost_per_s(self) -> Optional[float]:
         """Offered load over the arrival window (bytes-equivalent per
-        second); ``None`` with fewer than two arrivals."""
+        second); ``None`` with fewer than two arrivals.  O(1): the
+        window sum is maintained at arrival, never re-scanned — this
+        is on the 50 ms load-export path a fleet router polls."""
         with self._lock:
             if len(self._arrivals) < 2:
                 return None
             t0, _ = self._arrivals[0]
             t1, _ = self._arrivals[-1]
-            cost = sum(c for _, c in self._arrivals)
+            cost = self._arrival_cost_sum
         if t1 <= t0:
             return None
         return cost / (t1 - t0)
+
+    def scan_stats(self) -> dict:
+        """Work counters for the scaling-pin tests
+        (``tests/test_serve_depth.py``): ``arrivals_scanned`` counts
+        arrival-window elements walked by the projection — the fixed
+        running-sum path never walks any, so it stays 0 at any
+        depth."""
+        with self._lock:
+            return {"arrivals_scanned": self._arrivals_scanned}
 
     def snapshot(self) -> dict:
         """The projection record journaled with every pressure
@@ -228,5 +270,115 @@ class LoadTracker:
             self._arrivals.clear()
             self._queued_cost = self._inflight_cost = 0
             self._queued_n = self._inflight_n = 0
+            self._arrival_cost_sum = 0
+            self._arrivals_scanned = 0
             self._version += 1
             self._rate_cache = (-1, None)
+
+
+class BurnRateMonitor:
+    """Per-tenant SLO error-budget burn rate over a sliding window.
+
+    ``budget`` is the violation fraction a tenant's error budget
+    allows (0.01 = 1% of completions may bust their deadline).  The
+    observed violation fraction over the trailing ``window_s`` seconds,
+    divided by the budget, is the **burn rate**: 1.0 = burning exactly
+    at budget, ``threshold`` (default 4x) = alert.  Below
+    ``min_events`` completions in the window the monitor reports
+    ``None`` — a two-request sample must not page anyone.
+
+    Alerts are edge-triggered with 2x hysteresis: :meth:`note` returns
+    the alert payload exactly once when a tenant's rate crosses the
+    threshold, and re-arms only after the rate falls below half of it
+    — an overload window produces ONE durable ``serve.burn_alert``
+    record, not one per completion.  Thread-safe; every operation is
+    O(1) amortized (running counts, each window element evicted once).
+    """
+
+    def __init__(self, budget: float = 0.01, threshold: float = 4.0,
+                 window_s: float = 30.0, min_events: int = 16):
+        if budget <= 0:
+            raise ValueError(f"budget must be positive, got {budget}")
+        if threshold <= 0:
+            raise ValueError(
+                f"threshold must be positive, got {threshold}")
+        self.budget = float(budget)
+        self.threshold = float(threshold)
+        self.window_s = float(window_s)
+        self.min_events = max(1, int(min_events))
+        self._lock = threading.Lock()
+        self._win: Dict[str, deque] = {}      # tenant -> (t, violated)
+        self._n: Dict[str, int] = {}
+        self._viol: Dict[str, int] = {}
+        self._alerting: Dict[str, bool] = {}
+
+    def _evict_locked(self, tenant: str, now: float) -> None:
+        win = self._win[tenant]
+        cutoff = now - self.window_s
+        while win and win[0][0] < cutoff:
+            _, violated = win.popleft()
+            self._n[tenant] -= 1
+            if violated:
+                self._viol[tenant] -= 1
+
+    def _rate_locked(self, tenant: str) -> Optional[float]:
+        n = self._n.get(tenant, 0)
+        if n < self.min_events:
+            return None
+        return (self._viol.get(tenant, 0) / n) / self.budget
+
+    def note(self, tenant: str, violated: bool,
+             now: Optional[float] = None) -> Optional[dict]:
+        """Feed one completion.  Returns the ``serve.burn_alert``
+        payload exactly once per threshold crossing, else ``None``."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            win = self._win.setdefault(tenant, deque())
+            win.append((now, bool(violated)))
+            self._n[tenant] = self._n.get(tenant, 0) + 1
+            if violated:
+                self._viol[tenant] = self._viol.get(tenant, 0) + 1
+            self._evict_locked(tenant, now)
+            rate = self._rate_locked(tenant)
+            if rate is None:
+                return None
+            if not self._alerting.get(tenant, False) \
+                    and rate >= self.threshold:
+                self._alerting[tenant] = True
+                return {"tenant": tenant, "burn_rate": rate,
+                        "threshold": self.threshold,
+                        "window_s": self.window_s}
+            if self._alerting.get(tenant, False) \
+                    and rate < 0.5 * self.threshold:
+                self._alerting[tenant] = False
+        return None
+
+    def burn_rate(self, tenant: str,
+                  now: Optional[float] = None) -> Optional[float]:
+        """The tenant's current burn rate (``None``: unknown tenant or
+        too few completions in the window)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if tenant not in self._win:
+                return None
+            self._evict_locked(tenant, now)
+            return self._rate_locked(tenant)
+
+    def snapshot(self, now: Optional[float] = None
+                 ) -> Dict[str, Optional[float]]:
+        """Every tracked tenant's burn rate — what the service folds
+        into its stats and the per-tenant gauges ride."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            out = {}
+            for t in list(self._win):
+                self._evict_locked(t, now)
+                out[t] = self._rate_locked(t)
+            return out
+
+    def _reset_for_tests(self) -> None:
+        with self._lock:
+            self._win.clear()
+            self._n.clear()
+            self._viol.clear()
+            self._alerting.clear()

@@ -584,6 +584,89 @@ def test_lint_hop_peak_outside_sanctioned_modules(tmp_path):
     assert [f for f in findings if f.check == "hop-peak"] == []
 
 
+def test_lint_trace_ctx_mint_choke_point(tmp_path):
+    """``mint_trace`` references outside the two admission points (and
+    the definition site) are findings — a mid-path mint shears the
+    request's causal chain."""
+    rogue = """
+        from ..obs.requestflow import mint_trace
+
+        def helper():
+            return mint_trace()
+        """
+    sanctioned = """
+        def submit(requestflow):
+            return requestflow.mint_trace()
+        """
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/io/rogue.py", rogue),
+        ("pencilarrays_tpu/fleet/router.py", sanctioned),
+        ("pencilarrays_tpu/serve/service.py", sanctioned)])
+    found = sorted(f.ident for f in lint_tree(root)
+                   if f.check == "trace-ctx")
+    assert found == ["io.rogue.<module>", "io.rogue.helper"]
+
+
+def test_lint_trace_ctx_wire_and_worker_propagation(tmp_path):
+    """Cross-wire ``encode_request`` calls in fleet/ must pass
+    ``trace=``, and fleet/worker.py service admissions must run under
+    ``requestflow.installed(...)`` — each violation is its own stable
+    finding; a ``**kwargs`` splat is statically unknowable and passes."""
+    router = """
+        from . import wire
+
+        def place(kv, tid, payload, trace):
+            kv.set("k", wire.encode_request(
+                tid, tenant="t", payload=payload, trace=trace))
+
+        def rebind(kv, tid, payload):
+            kv.set("k", wire.encode_request(
+                tid, tenant="t", payload=payload))   # drops the trace
+
+        def dynamic(kv, tid, kw):
+            kv.set("k", wire.encode_request(tid, **kw))  # unknowable
+        """
+    worker = """
+        from ..obs import requestflow
+
+        def take_good(service, req):
+            with requestflow.installed(req.get("trace")):
+                return service.submit(req["tenant"], req["payload"])
+
+        def take_bad(service, req):
+            return service.submit(req["tenant"], req["payload"])
+        """
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/fleet/router.py", router),
+        ("pencilarrays_tpu/fleet/worker.py", worker)])
+    found = sorted(f.ident for f in lint_tree(root)
+                   if f.check == "trace-ctx")
+    assert found == ["fleet.router.rebind", "fleet.worker.take_bad"]
+
+
+def test_lint_trace_ctx_dispatch_meta_key(tmp_path):
+    """serve/service.py's ``_dispatch_meta`` must build a dict carrying
+    the ``"trace"`` key (the engine installs it around the run); a
+    fixture repo without the function skips silently (the clean-fixture
+    test pins that)."""
+    missing = """
+        def _dispatch_meta(batch):
+            return {"kind": batch.kind, "n": len(batch.entries)}
+        """
+    carrying = """
+        def _dispatch_meta(batch):
+            return {"kind": batch.kind, "trace": batch.entries[0].trace}
+        """
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/serve/service.py", missing)])
+    found = [f.ident for f in lint_tree(root) if f.check == "trace-ctx"]
+    assert found == ["serve.service._dispatch_meta"]
+
+    root2 = _fixture_repo(tmp_path / "ok", [
+        ("pencilarrays_tpu/serve/service.py", carrying)])
+    assert [f for f in lint_tree(root2) if f.check == "trace-ctx"] == []
+
+
 def test_allowlist_roundtrip(tmp_path):
     """Allowlist round-trip: a justified entry suppresses its finding,
     stale entries are reported unused, unjustified/malformed lines are

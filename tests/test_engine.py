@@ -335,6 +335,32 @@ def test_layer_accessors_delegate_and_late_arm(monkeypatch):
     assert not guard.enabled()
 
 
+def test_env_key_fast_path_sees_every_mutation(monkeypatch):
+    """PR 18: ``current()`` is the gate probe under ``obs.enabled()``
+    on per-dispatch hot paths, so its change-detection key is built
+    from exception-free backing-dict probes instead of 27
+    ``os.environ.get`` KeyError round-trips.  The fast path must see
+    set, CHANGE, and delete for every watched var — a stale key here
+    silently breaks late arming for a whole subsystem."""
+    for var in eng_config.WATCHED_VARS:
+        monkeypatch.delenv(var, raising=False)  # normalize: unset
+        before = eng_config._env_key()
+        monkeypatch.setenv(var, "_pin_a")
+        a = eng_config._env_key()
+        assert a != before, f"{var}: set invisible to the fast path"
+        monkeypatch.setenv(var, "_pin_b")
+        b = eng_config._env_key()
+        assert b != a, f"{var}: change invisible to the fast path"
+        monkeypatch.delenv(var)
+        assert eng_config._env_key() == before, \
+            f"{var}: delete invisible to the fast path"
+    # and the snapshot itself re-resolves through the fast-path key
+    monkeypatch.setenv("PENCILARRAYS_TPU_OBS", "1")
+    assert eng_config.current().obs_on
+    monkeypatch.delenv("PENCILARRAYS_TPU_OBS")
+    assert not eng_config.current().obs_on
+
+
 def test_engine_snapshot_frozen_at_construction(monkeypatch):
     monkeypatch.setenv("PENCILARRAYS_TPU_GUARD_TIMEOUT", "11")
     engine = Engine("frozen")

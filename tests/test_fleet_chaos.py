@@ -175,13 +175,49 @@ def test_whole_mesh_loss_mid_storm(tmp_path):
               and e.get("point") == "fleet.route"]
     assert any(e.get("mode") == "kill" for e in killed)
 
-    # fleet timeline lint-clean through the real pa-obs CLI
+    # fleet timeline lint-clean through the real pa-obs CLI —
+    # schema v6: every fleet.route record carries its trace id
     from pencilarrays_tpu.obs.__main__ import main
 
     assert main(["lint", obsdir]) == 0
     assert main(["timeline", obsdir]) == 0
     assert main(["trace", obsdir, "-o",
                  str(tmp_path / "trace.json")]) == 0
+
+    # the ISSUE 18 acceptance: pick a ticket that CROSSED the failover
+    # (its trace has a rebind record) and reconstruct one causal
+    # timeline for it across the router's and both meshes' journals
+    from pencilarrays_tpu.obs.requestflow import reconstruct_request
+
+    rebind_traces = sorted({e["trace"] for e in events
+                            if e["ev"] == "fleet.route"
+                            and e["reason"] == "rebind"})
+    assert rebind_traces, "no rebind carried a trace id"
+    trace_id = rebind_traces[0]
+    rt, _warnings = reconstruct_request(obsdir, trace_id)
+    # a SIGKILLed mesh may leave a torn tail — warnings are fine,
+    # the reconstruction itself must not be
+    assert rt is not None and rt.trace == trace_id
+    # the span hops processes: the router's journal (admission, route,
+    # failover, rebind) plus the surviving mesh's (admission, dispatch,
+    # completion) — at least two ranks in ONE timeline
+    assert len(rt.ranks) >= 2, rt.ranks
+    assert rt.rebinds >= 1
+    assert rt.outcome == "ok"
+    evs = [e["ev"] for e in rt.events]
+    assert "fleet.route" in evs          # admission → route
+    assert "fleet.failover" in evs       # joined via the traces list
+    assert "serve.complete" in evs       # exactly-once resolution
+    # causal order: the failover re-bind precedes the completion
+    assert (evs.index("fleet.failover")
+            < max(i for i, e in enumerate(evs)
+                  if e == "serve.complete"))
+
+    # the CLI renders it (exit 0), indexes every traced request, and
+    # pins exit 1 for an id appearing in no record
+    assert main(["request", obsdir, trace_id]) == 0
+    assert main(["requests", obsdir]) == 0
+    assert main(["request", obsdir, "feedfacedeadbeef"]) == 1
 
 
 @pytest.mark.slow

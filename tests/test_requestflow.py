@@ -1,0 +1,360 @@
+"""Request-scoped tracing (obs/requestflow.py): hostile reconstruction
++ the burn-rate monitor's alert discipline.
+
+The contracts under test (ISSUE 18 satellites):
+
+* a coalesced batch journals ONE dispatch span shared by its B member
+  traces — every member reconstructs through it (``fan_in``), none
+  invents a private dispatch;
+* reconstruction over wreckage DEGRADES: a missing mesh journal, a
+  torn tail, and pre-v6 (traceless) journals each produce warnings,
+  never exceptions — and the ``pa-obs request``/``requests`` exit
+  codes are pinned (found 0 / unknown id 1 / index always 0; warnings
+  alone never fail);
+* :class:`~pencilarrays_tpu.serve.slo.BurnRateMonitor` alerts are
+  edge-triggered with hysteresis (one alert per crossing), gated by
+  the ``min_events`` floor, and the sliding window actually evicts.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu import obs
+from pencilarrays_tpu.obs import events as obs_events
+from pencilarrays_tpu.obs import metrics as obs_metrics
+from pencilarrays_tpu.obs.__main__ import main
+from pencilarrays_tpu.obs.requestflow import (
+    RequestTrace,
+    list_requests,
+    reconstruct_request,
+    render_index,
+    render_request,
+)
+from pencilarrays_tpu.obs.schema import lint_journal
+from pencilarrays_tpu.ops.fft import PencilFFTPlan
+from pencilarrays_tpu.serve import PlanService
+from pencilarrays_tpu.serve.slo import BurnRateMonitor
+
+pytestmark = pytest.mark.usefixtures("devices")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(obs.ENV_VAR, raising=False)
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    yield
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# synthetic journals: full control over ranks, tears and versions
+# ---------------------------------------------------------------------------
+
+def _rec(proc, seq, t, ev, v=6, **fields):
+    """One schema-clean journal record with the full envelope."""
+    rec = {"v": v, "ev": ev, "run": f"run-r{proc}", "proc": proc,
+           "seq": seq, "t_wall": t, "t_mono": t,
+           "step_idx": 0, "epoch": 0}
+    rec.update(fields)
+    return rec
+
+
+def _write_rank(directory, proc, records):
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"journal.r{proc}.jsonl")
+    with open(path, "a") as f:
+        for r in records:
+            f.write(json.dumps(r, separators=(",", ":")) + "\n")
+    return path
+
+
+A, B, C = "aaaa000011112222", "bbbb000011112222", "cccc000011112222"
+
+
+def _mesh_story(t0=100.0):
+    """Rank 1's story: three admissions coalescing into one dispatch."""
+    recs = [_rec(1, 1, t0, "run.start", pid=1)]
+    for i, tr in enumerate((A, B, C)):
+        recs.append(_rec(1, 2 + i, t0 + 0.01 * i, "serve.request",
+                         tenant="acme", req=i, kind="fft", key="k",
+                         nbytes=1024, trace=tr))
+    recs.append(_rec(1, 5, t0 + 0.05, "serve.coalesce", key="k", n=3,
+                     reqs=[0, 1, 2], reason="full", wait_s=0.04,
+                     trace=A, traces=[A, B, C]))
+    recs.append(_rec(1, 6, t0 + 0.06, "serve.dispatch", key="k", n=3,
+                     tenants=["acme"], score_bytes=3072, reason="full",
+                     lane=0, chain="*", trace=A, traces=[A, B, C]))
+    for i, tr in enumerate((A, B, C)):
+        recs.append(_rec(1, 7 + i, t0 + 0.2 + 0.01 * i, "serve.complete",
+                         tenant="acme", req=i, outcome="ok",
+                         seconds=0.1, key="k", trace=tr))
+    return recs
+
+
+def _router_story(t0=100.0):
+    recs = [_rec(0, 1, t0 - 1.0, "run.start", pid=0)]
+    for i, tr in enumerate((A, B, C)):
+        recs.append(_rec(0, 2 + i, t0 - 0.5 + 0.01 * i, "fleet.route",
+                         ticket=f"t{i}", tenant="acme", mesh=1,
+                         reason="placed", score_bytes=1024, trace=tr))
+    return recs
+
+
+def test_synthetic_fan_in_shared_dispatch_span(tmp_path):
+    """Every member of a coalesced batch reconstructs THROUGH the one
+    shared dispatch record — joined by ``traces`` membership."""
+    d = str(tmp_path / "obs")
+    _write_rank(d, 0, _router_story())
+    _write_rank(d, 1, _mesh_story())
+    assert lint_journal(obs_events.read_journal(d)) == []
+    for tr in (A, B, C):        # the leader AND both followers
+        rt, warnings = reconstruct_request(d, tr)
+        assert isinstance(rt, RequestTrace) and rt.trace == tr
+        assert warnings == []
+        assert rt.fan_in == 3
+        assert rt.ranks == [0, 1]
+        assert rt.outcome == "ok" and rt.tenant == "acme"
+        evs = [e["ev"] for e in rt.events]
+        # one route, one shared coalesce+dispatch, ONE own completion
+        assert evs.count("fleet.route") == 1
+        assert evs.count("serve.coalesce") == 1
+        assert evs.count("serve.dispatch") == 1
+        assert evs.count("serve.complete") == 1
+        assert {"wire_s", "admission_wait_s", "coalesce_wait_s",
+                "compute_s", "lane_wait_s"} <= set(rt.critical_path)
+        assert rt.critical_path["compute_s"] == pytest.approx(0.1)
+        text = render_request(rt)
+        assert tr in text and "critical path:" in text
+    # the B and C spans are the SAME journal record as A's, not copies
+    rt_a, _ = reconstruct_request(d, A)
+    rt_b, _ = reconstruct_request(d, B)
+    disp_a = next(e for e in rt_a.events if e["ev"] == "serve.dispatch")
+    disp_b = next(e for e in rt_b.events if e["ev"] == "serve.dispatch")
+    assert disp_a["seq"] == disp_b["seq"] == 6
+    # the index counts shared fan-in records toward every member
+    summaries, warnings = list_requests(d)
+    assert warnings == []
+    assert [s["trace"] for s in summaries] == [A, B, C]
+    for s in summaries:
+        # route + request + coalesce + dispatch + complete — the
+        # shared fan-in records count ONCE for each member
+        assert s["events"] == 5 and s["outcome"] == "ok"
+        assert s["ranks"] == [0, 1]
+    assert A in render_index(summaries)
+
+
+def test_missing_mesh_journal_degrades_to_warnings(tmp_path):
+    """The placed mesh's journal never made it to shared storage: the
+    reconstruction keeps the router's half of the story and WARNS —
+    both about the rank hole and the missing admission record."""
+    d = str(tmp_path / "obs")
+    _write_rank(d, 0, _router_story())
+    # rank 2 exists so the rank-1 hole is visible as a hole
+    _write_rank(d, 2, [_rec(2, 1, 99.5, "run.start", pid=2)])
+    rt, warnings = reconstruct_request(d, A)
+    assert rt is not None and rt.trace == A
+    assert rt.ranks == [0]
+    assert rt.outcome is None and rt.fan_in is None
+    assert any("rank 1: no journal found" in w for w in warnings)
+    assert any("no serve.request record" in w for w in warnings)
+    assert any("no serve.complete record" in w for w in warnings)
+    # warnings alone never fail the CLI; an unknown id does
+    assert main(["request", d, A]) == 0
+    assert main(["requests", d]) == 0
+    assert main(["request", d, "feedfacedeadbeef"]) == 1
+
+
+def test_torn_tail_degrades_to_warnings(tmp_path):
+    """A SIGKILL mid-append tears the mesh journal's final line (and a
+    disk hiccup mangles a mid-file one): both are warnings, and every
+    intact record still reconstructs."""
+    d = str(tmp_path / "obs")
+    _write_rank(d, 0, _router_story())
+    path = _write_rank(d, 1, _mesh_story())
+    with open(path) as f:
+        lines = f.read().splitlines()
+    lines[3] = lines[3][: len(lines[3]) // 2]       # mid-file mangle
+    torn = "\n".join(lines) + "\n" + '{"v":6,"ev":"serve.comp'
+    with open(path, "w") as f:
+        f.write(torn)
+    rt, warnings = reconstruct_request(d, A)
+    assert rt is not None and rt.outcome == "ok"
+    assert any("torn final line" in w for w in warnings)
+    assert any("unparseable mid-file" in w for w in warnings)
+    assert main(["request", d, A]) == 0
+    assert main(["requests", d]) == 0
+
+
+def test_v5_journals_stay_clean_and_traceless(tmp_path):
+    """Pre-v6 journals carry no trace fields: they lint clean (the
+    requirement is versioned), index empty, and the CLI reports rather
+    than raises."""
+    d = str(tmp_path / "obs")
+    recs = [
+        _rec(0, 1, 10.0, "run.start", v=5, pid=0),
+        _rec(0, 2, 10.1, "serve.request", v=5, tenant="acme", req=0,
+             kind="fft", key="k", nbytes=64),
+        _rec(0, 3, 10.2, "serve.dispatch", v=5, key="k", n=1,
+             tenants=["acme"], score_bytes=64, reason="full",
+             lane=0, chain="*"),
+        _rec(0, 4, 10.3, "serve.complete", v=5, tenant="acme", req=0,
+             outcome="ok", seconds=0.05, key="k"),
+    ]
+    _write_rank(d, 0, recs)
+    assert lint_journal(obs_events.read_journal(d)) == []
+    summaries, warnings = list_requests(d)
+    assert summaries == [] and warnings == []
+    assert "no traced requests" in render_index(summaries)
+    rt, warnings = reconstruct_request(d, A)
+    assert rt is None
+    assert main(["requests", d]) == 0
+    assert main(["request", d, A]) == 1
+    # and an empty directory is a warning, not a crash
+    empty = str(tmp_path / "nothing")
+    os.makedirs(empty)
+    rt, warnings = reconstruct_request(empty, A)
+    assert rt is None
+    assert any("no journal files" in w for w in warnings)
+    assert main(["request", empty, A]) == 1
+    assert main(["requests", empty]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the real service: coalesced fan-in stamps end to end
+# ---------------------------------------------------------------------------
+
+def test_real_coalesced_batch_shares_one_dispatch(tmp_path, devices):
+    """Three same-plan requests coalescing into ONE batch journal one
+    coalesce/dispatch pair carrying all three minted trace ids — and
+    each member reconstructs through the shared span."""
+    jdir = str(tmp_path / "obs")
+    obs.enable(jdir)
+    try:
+        topo = pa.Topology((2,), devices=devices[:2])
+        plan = PencilFFTPlan(topo, (8, 6, 4))
+        rng = np.random.default_rng(0)
+        # max_batch=3 + a long wait: the batch dispatches exactly when
+        # the third member arrives — deterministically ONE batch
+        svc = PlanService(max_batch=3, max_wait_s=60.0)
+        us = [(rng.standard_normal((8, 6, 4))
+               + 1j * rng.standard_normal((8, 6, 4))).astype(np.complex64)
+              for _ in range(3)]
+        tickets = [svc.submit("acme", u, plan=plan) for u in us]
+        assert svc.drain() == 1
+        for t, u in zip(tickets, us):
+            np.testing.assert_allclose(np.asarray(t.result(5.0)),
+                                       np.fft.fftn(u), rtol=1e-3,
+                                       atol=1e-3)
+        svc.close()
+    finally:
+        obs.disable()
+    events = obs_events.read_journal(jdir)
+    assert lint_journal(events) == []
+    reqs = [e for e in events if e["ev"] == "serve.request"]
+    assert len(reqs) == 3
+    minted = [e["trace"] for e in reqs]
+    assert len(set(minted)) == 3        # one FRESH id per admission
+    disp = [e for e in events if e["ev"] == "serve.dispatch"]
+    coal = [e for e in events if e["ev"] == "serve.coalesce"]
+    assert len(disp) == 1 and len(coal) == 1
+    assert sorted(disp[0]["traces"]) == sorted(minted)
+    assert sorted(coal[0]["traces"]) == sorted(minted)
+    assert disp[0]["trace"] == disp[0]["traces"][0]
+    done = [e for e in events if e["ev"] == "serve.complete"]
+    assert sorted(e["trace"] for e in done) == sorted(minted)
+    for tr in minted:
+        rt, warnings = reconstruct_request(jdir, tr)
+        assert rt is not None and warnings == []
+        assert rt.fan_in == 3 and rt.outcome == "ok"
+        assert main(["request", jdir, tr]) == 0
+
+
+# ---------------------------------------------------------------------------
+# BurnRateMonitor: edge-triggered alerts, hysteresis, eviction
+# ---------------------------------------------------------------------------
+
+def test_burn_alert_fires_exactly_once_per_crossing():
+    m = BurnRateMonitor(budget=0.1, threshold=2.0, window_s=1000.0,
+                        min_events=5)
+    alerts = []
+    for i in range(20):         # a sustained 100% violation storm
+        a = m.note("acme", True, now=float(i))
+        if a is not None:
+            alerts.append(a)
+    assert len(alerts) == 1     # edge-triggered: ONE alert, not 16
+    # and it fired the moment the min_events floor was met
+    assert alerts[0]["tenant"] == "acme"
+    assert alerts[0]["burn_rate"] == pytest.approx(10.0)
+    assert alerts[0]["threshold"] == 2.0
+    assert alerts[0]["window_s"] == 1000.0
+    assert m.burn_rate("acme", now=20.0) == pytest.approx(10.0)
+
+
+def test_burn_alert_rearms_below_half_threshold():
+    """Hysteresis: the alert re-arms only once the rate falls below
+    threshold/2, so a rate hovering AT threshold cannot flap."""
+    m = BurnRateMonitor(budget=0.1, threshold=2.0, window_s=1e6,
+                        min_events=5)
+    n_alerts = 0
+    t = [0.0]
+
+    def feed(violated, k):
+        nonlocal n_alerts
+        for _ in range(k):
+            t[0] += 1.0
+            if m.note("acme", violated, now=t[0]) is not None:
+                n_alerts += 1
+
+    feed(True, 5)               # frac 1.0 -> rate 10: first crossing
+    assert n_alerts == 1
+    feed(True, 10)              # still alerting: silent
+    assert n_alerts == 1
+    # dilute to frac 15/100 -> rate 1.5: above half-threshold, armed? NO
+    feed(False, 85)
+    assert m.burn_rate("acme") == pytest.approx(1.5)
+    feed(True, 1)               # 16/101 -> 1.58: still not re-armed
+    assert n_alerts == 1
+    # dilute below half-threshold (frac < 0.1): re-arms
+    feed(False, 100)            # 16/201 -> 0.796 < 1.0
+    assert m.burn_rate("acme") < 1.0
+    feed(True, 60)              # climbs back over 2.0: SECOND alert
+    assert m.burn_rate("acme") >= 2.0
+    assert n_alerts == 2
+
+
+def test_burn_min_events_floor_and_unknown_tenant():
+    m = BurnRateMonitor(budget=0.01, threshold=4.0, min_events=16)
+    assert m.burn_rate("ghost") is None
+    for i in range(15):         # one short of the floor: no rate yet
+        assert m.note("acme", True, now=float(i)) is None
+        assert m.burn_rate("acme", now=float(i)) is None
+    assert m.note("acme", True, now=15.0) is not None   # floor met
+    assert m.snapshot(now=15.0) == {"acme": pytest.approx(100.0 * 1.0)}
+
+
+def test_burn_window_evicts():
+    """Violations age out of the sliding window: a storm that ENDED
+    stops burning."""
+    m = BurnRateMonitor(budget=0.5, threshold=4.0, window_s=10.0,
+                        min_events=2)
+    for i in range(4):
+        m.note("acme", True, now=float(i))
+    assert m.burn_rate("acme", now=3.0) == pytest.approx(2.0)
+    # 20s later the whole storm is outside the window
+    m.note("acme", False, now=20.0)
+    m.note("acme", False, now=21.0)
+    assert m.burn_rate("acme", now=21.0) == pytest.approx(0.0)
+    assert m.snapshot(now=40.0) == {"acme": None}   # window empty again
+
+
+def test_burn_monitor_validates():
+    with pytest.raises(ValueError, match="budget"):
+        BurnRateMonitor(budget=0.0)
+    with pytest.raises(ValueError, match="threshold"):
+        BurnRateMonitor(threshold=-1.0)

@@ -13,6 +13,13 @@ The scaling pin is COUNTER-based, not wall-clock-based:
 not queue breadth — deterministic on any CI machine.  Batch formation
 and dispatch ordering are pinned unchanged by tests/test_serve.py; this
 file only pins what the take path *scans*.
+
+PR 18 extends the same discipline to the LOAD-EXPORT path (the fleet
+worker polls ``load_projection`` every 50 ms): ``depth()`` reads the
+maintained depth index (``depth_entries_scanned`` stays 0 at any
+depth, exact across every departure path) and the ``LoadTracker``
+arrival window keeps a running cost sum (``arrivals_scanned`` stays 0
+no matter how often the projection is read).
 """
 
 from __future__ import annotations
@@ -27,6 +34,7 @@ from pencilarrays_tpu.serve.queue import (
     Ticket,
     _Entry,
 )
+from pencilarrays_tpu.serve.slo import LoadTracker
 
 BIG = TenantQuota(max_requests=1 << 20, max_bytes=1 << 50)
 
@@ -171,3 +179,85 @@ def test_scan_work_tracks_due_work_not_depth():
 
     assert scans_at(200) == 0
     assert scans_at(2000) == 0
+
+
+# ---------------------------------------------------------------------------
+# PR 18: the load-export path (depth index + arrival window) is O(1)
+# ---------------------------------------------------------------------------
+
+def _brute_depth(q: AdmissionQueue, tenant: str = None) -> int:
+    entries = q.pending_entries()
+    if tenant is None:
+        return len(entries)
+    return sum(1 for e in entries if e.ticket.tenant == tenant)
+
+
+def test_depth_polls_scan_nothing_at_depth():
+    # depth() sits on the fleet worker's 50ms load-export path: 10^4
+    # queued entries, a thousand polls (total AND per-tenant), not one
+    # entry rescanned (the v1 body re-counted every entry per call)
+    base = time.monotonic()
+    q = AdmissionQueue(max_batch=8, max_wait_s=10.0, default_quota=BIG)
+    for g in range(1000):
+        for t in ("whale", "minnow"):
+            for _ in range(5):
+                q.offer(_entry(f"{t}{g}", base, tenant=t))
+    for _ in range(1000):
+        assert q.depth() == 10_000
+        assert q.depth("whale") == 5_000
+        assert q.depth("minnow") == 5_000
+        assert q.depth("ghost") == 0
+    assert q.scan_stats()["depth_entries_scanned"] == 0
+
+
+def test_depth_index_exact_across_every_departure_path():
+    """The index must decrement at ALL four departure sites — full
+    split, deadline flush, expired shed, pressure eviction — or the
+    fleet's published load drifts from reality."""
+    base = time.monotonic()
+    q = AdmissionQueue(max_batch=4, max_wait_s=1.0, default_quota=BIG)
+    # full split: 4 of 6 leave, the remainder stays indexed
+    for _ in range(6):
+        q.offer(_entry("k", base, tenant="a"))
+    q.take_ready(now=base + 0.01)
+    assert q.depth() == _brute_depth(q) == 2
+    assert q.depth("a") == _brute_depth(q, "a") == 2
+    # deadline flush: the remainder coalesces out
+    q.take_ready(now=base + 2.0)
+    assert q.depth() == _brute_depth(q) == 0
+    assert q.depth("a") == 0
+    # expired shed at the take point
+    q.offer(_entry("doomed", base, tenant="b", deadline=base + 0.1))
+    q.take_ready(now=base + 0.5)
+    assert [e.ticket.key for e in q.pop_expired()] == ["doomed"]
+    assert q.depth() == _brute_depth(q) == 0
+    assert q.depth("b") == 0
+    # pressure eviction: only the sheddable tier departs
+    q.offer(_entry("low", base, tenant="c"))
+    protected = _entry("high", base, tenant="d")
+    protected.shed_priority = 5
+    q.offer(protected)
+    evicted = q.evict_sheddable(protected_priority=1)
+    assert [e.ticket.tenant for e in evicted] == ["c"]
+    assert q.depth() == _brute_depth(q) == 1
+    assert q.depth("c") == 0 and q.depth("d") == 1
+    # none of the above ever rescanned the queue to answer depth()
+    assert q.scan_stats()["depth_entries_scanned"] == 0
+
+
+def test_load_tracker_arrival_window_is_o1_and_exact():
+    # the other half of the export path: arrival_cost_per_s must read
+    # the maintained running sum (never rescan the window), and the
+    # sum must stay exact under the deque's own evictions at 10^5
+    tr = LoadTracker(window=64)
+    now, costs = 1000.0, []
+    for i in range(100_000):
+        c = (i * 37) % 1000 + 1
+        tr.note_arrival(c, now=now + i * 0.001)
+        costs.append(c)
+    for _ in range(1000):
+        got = tr.arrival_cost_per_s()
+    t0 = now + (100_000 - 64) * 0.001
+    t1 = now + 99_999 * 0.001
+    assert got == pytest.approx(sum(costs[-64:]) / (t1 - t0))
+    assert tr.scan_stats()["arrivals_scanned"] == 0
