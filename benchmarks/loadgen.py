@@ -30,7 +30,12 @@ Measured verdicts (the repo's artifact contract):
   plane was actually on under load);
 * the tracing-disabled path: the same replay with observability OFF,
   repeated — the spread IS the noise floor the obs-on run is compared
-  against.
+  against;
+* the precision-downgrade arm (PR 19): the same flood against a
+  degrade-armed service serves STRICTLY more than the shed-only
+  baseline with zero measured ``max_rel_l2`` violations among the
+  degraded answers (every served budgeted request is gathered and
+  compared to its full-precision reference).
 
 CPU-mesh caveat: absolute requests/sec prices host dispatch of tiny
 FFTs on virtual devices, not TPU compute — the verdicts above are
@@ -403,6 +408,159 @@ def measure_tracing_overhead(devs, *, n: int = 1500, wall_s: float = 4.0,
     }
 
 
+DEGRADE_BUDGETS = {"spiky": 0.2, "cargo": 0.2, "dyno": 0.2}
+
+
+def measure_degrade_overload(devs, *, n: int = 1200, wall_s: float = 4.0,
+                             workdir: str = ".") -> dict:
+    """The precision-downgrade acceptance arm (PR 19): the SAME
+    flood-bearing trace replayed against two pressure-armed services —
+    shed-only (no accuracy budgets) vs degrade-armed (budgeted tenants
+    carry ``SLO(max_rel_l2=)``).  Verdicts:
+
+    * the degrade arm serves STRICTLY more requests than the shed-only
+      baseline (the rung's whole point: overload capacity that was
+      previously typed rejections);
+    * zero accuracy violations: every served budgeted-tenant answer is
+      gathered and compared against the full-precision reference of
+      its payload — measured rel-l2 must sit within the tenant's
+      declared ``max_rel_l2`` (and unbudgeted tenants stay at
+      full-precision error);
+    * zero lost / duplicate tickets in BOTH arms;
+    * every applied downgrade journaled ``serve.precision`` (counted).
+    """
+    import pencilarrays_tpu as pa
+    from pencilarrays_tpu import gather, obs
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+    from pencilarrays_tpu.serve import (PlanService, PressurePolicy,
+                                        TenantQuota)
+    from pencilarrays_tpu.serve.errors import AdmissionError, DeadlineError
+    from pencilarrays_tpu.serve.slo import SLO
+
+    trace = generate_trace(77, n)
+    pools = _payload_pool(np.random.default_rng(7))
+
+    topo = pa.Topology((len(devs),), devices=list(devs)) \
+        if len(devs) > 1 else pa.Topology((1,), devices=list(devs))
+    plans = {tier: PencilFFTPlan(topo, s, dtype=np.complex64)
+             for tier, s in SHAPES.items()}
+    # full-precision references, one per (tier, pool slot) — what a
+    # degraded answer is measured against
+    refs = {}
+    for tier, plan in plans.items():
+        for j, u in enumerate(pools[tier]):
+            x = pa.PencilArray.from_global(plan.input_pencil, u)
+            refs[(tier, j)] = np.asarray(gather(plan.forward(x)))
+
+    def one_arm(budgets: dict, obs_dir: Optional[str]) -> dict:
+        slos = {}
+        for name, _, _, _, pr in TENANTS:
+            kw = {"shed_priority": pr}
+            if name in budgets:
+                kw["max_rel_l2"] = budgets[name]
+            slos[name] = SLO(**kw)     # loose deadlines: only the
+        # pressure gate differentiates the two arms
+        svc = PlanService(
+            max_batch=8, max_wait_s=0.02,
+            quota=TenantQuota(max_requests=1 << 20, max_bytes=1 << 50),
+            slos=slos,
+            # evict pinned out of reach in BOTH arms: this drill
+            # isolates degrade-vs-shed (eviction has its own drills;
+            # letting it fire here just evicts the admitted degraded
+            # queue and measures eviction, not the rung)
+            pressure=PressurePolicy(high_water_s=0.06, low_water_s=0.005,
+                                    degrade_water_s=0.02,
+                                    evict_water_s=30.0))
+        if obs_dir is not None:
+            obs.enable(obs_dir)
+        try:
+            # warm with the gate disarmed: the tracker has no
+            # throughput sample yet, so its pessimistic drain would
+            # shed the warm-up compiles themselves
+            gate, svc._gate = svc._gate, None
+            _warm(svc, plans, pools, 8)
+            svc._gate = gate
+            svc.start()
+            t0 = time.perf_counter()
+            tickets, rejected = [], 0
+            for r in trace:
+                if not r["overload"]:
+                    target = t0 + r["t"] * wall_s
+                    while (delay := target - time.perf_counter()) > 0:
+                        time.sleep(min(delay, 0.02))
+                j = r["i"] % len(pools[r["tier"]])
+                try:
+                    t = svc.submit(r["tenant"], pools[r["tier"]][j],
+                                   plan=plans[r["tier"]])
+                    tickets.append((r, j, t))
+                except (AdmissionError, DeadlineError):
+                    rejected += 1
+            svc.drain()
+            served, expired, errs = 0, 0, []
+            worst_unbudgeted = 0.0
+            violations = 0
+            per_tenant: Dict[str, dict] = {}
+            for r, j, t in tickets:
+                try:
+                    got = np.asarray(gather(t.result(60.0)))
+                except (AdmissionError, DeadlineError):
+                    expired += 1
+                    continue
+                served += 1
+                ref = refs[(r["tier"], j)]
+                rel = float(
+                    np.linalg.norm((got - ref).ravel())
+                    / max(np.linalg.norm(ref.ravel()), 1e-300))
+                budget = budgets.get(r["tenant"])
+                rec = per_tenant.setdefault(
+                    r["tenant"], {"served": 0, "rel_l2_max": 0.0,
+                                  "max_rel_l2": budget})
+                rec["served"] += 1
+                rec["rel_l2_max"] = max(rec["rel_l2_max"], rel)
+                if budget is not None:
+                    errs.append(rel)
+                    if rel > budget:
+                        violations += 1
+                else:
+                    worst_unbudgeted = max(worst_unbudgeted, rel)
+            n_precision = 0
+            if obs_dir is not None:
+                from pencilarrays_tpu.obs import events as obs_events
+                evs = obs_events.read_journal(obs_dir)
+                n_precision = sum(1 for e in evs
+                                  if e["ev"] == "serve.precision")
+            stats = svc.stats()
+            svc.close()
+        finally:
+            if obs_dir is not None:
+                obs.disable()
+        return {
+            "served": served, "rejected": rejected, "expired": expired,
+            "resolved_exactly_once":
+                served + rejected + expired == len(trace),
+            "budget_violations": violations,
+            "budgeted_rel_l2_max": max(errs) if errs else 0.0,
+            "unbudgeted_rel_l2_max": worst_unbudgeted,
+            "tenants": per_tenant,
+            "serve_precision_records": n_precision,
+            "dispatches": stats["dispatches"],
+        }
+
+    shed_only = one_arm({}, None)
+    degrade = one_arm(DEGRADE_BUDGETS,
+                      os.path.join(workdir, "loadgen_degrade_obs"))
+    return {
+        "n_requests": n,
+        "budgets": dict(DEGRADE_BUDGETS),
+        "shed_only": shed_only,
+        "degrade": degrade,
+        "served_gain": degrade["served"] - shed_only["served"],
+        "degrade_serves_strictly_more":
+            degrade["served"] > shed_only["served"],
+        "zero_budget_violations": degrade["budget_violations"] == 0,
+    }
+
+
 def run_loadgen_suite(devs, *, n_requests: int = 10_000, seed: int = 2018,
                       wall_s: float = 20.0, max_batch: int = 8,
                       workdir: str = ".") -> dict:
@@ -413,6 +571,7 @@ def run_loadgen_suite(devs, *, n_requests: int = 10_000, seed: int = 2018,
                     obs_dir=obs_dir)
     journal = _journal_verdicts(obs_dir, result)
     overhead = measure_tracing_overhead(devs, workdir=workdir)
+    degrade = measure_degrade_overload(devs, workdir=workdir)
     return {
         "seed": seed,
         "trace_fingerprint": fp,
@@ -422,6 +581,7 @@ def run_loadgen_suite(devs, *, n_requests: int = 10_000, seed: int = 2018,
         "replay": result,
         "journal": journal,
         "tracing_overhead": overhead,
+        "degrade_overload": degrade,
         "caption": CPU_MESH_CAPTION,
     }
 

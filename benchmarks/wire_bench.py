@@ -4,7 +4,8 @@ Three questions, answered with measurements (the BENCH_* discipline:
 every claim carries its own noise floor):
 
 1. **speed** — seconds per transpose round trip at each wire format
-   (``None`` / ``bf16`` / ``f16``) on the actual mesh, via the hardened
+   (``None`` / ``bf16`` / ``f16`` / ``fp8_e4m3`` / ``fp8_e5m2``) on the
+   actual mesh, via the hardened
    K-differenced device-timing protocol (``utils/benchtime.py``).  On
    the CPU virtual mesh the "wire" is memcpy bandwidth, so the headline
    is a *validation* number (the packed program runs, bytes halve, the
@@ -21,7 +22,11 @@ every claim carries its own noise floor):
    exact propagator, each at every wire format, compared against the
    full-precision run — max/L2 relative error and "ULPs at scale"
    (max abs error over the f32 spacing at the field's magnitude), the
-   numbers ``docs/WirePrecision.md`` quotes when advising bf16 vs f16.
+   numbers ``docs/WirePrecision.md`` quotes when advising bf16 vs f16,
+   plus a **plan-roundtrip** arm (forward+backward FFT per wire format
+   vs full precision) — the exact shape of served fft traffic, and the
+   section the serving plane's calibrated precision-downgrade envelope
+   (``serve/precision.py::wire_error_envelope``) is keyed from.
 
 Usage: ``python benchmarks/wire_bench.py [--devices N] [--n 32]`` or
 ``python benchmarks/suite.py --wire`` (registered opt-in arm).
@@ -38,7 +43,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-WIRE_FORMATS = (None, "bf16", "f16")
+WIRE_FORMATS = (None, "bf16", "f16", "fp8_e4m3", "fp8_e5m2")
 
 
 def _err_stats(ref: np.ndarray, got: np.ndarray) -> dict:
@@ -105,6 +110,33 @@ def _transpose_arm(topo, shape, dtype, k1, repeats) -> dict:
             "speedup_vs_full": (t_full / t) if t_full else None,
         }
     return out
+
+
+def _plan_roundtrip_arm(topo, n) -> dict:
+    """Served-fft-shaped error envelope: one ``PencilFFTPlan``
+    forward+backward per wire format on a seeded random field, vs the
+    full-precision roundtrip.  This is the section the serving plane's
+    precision-downgrade envelope is calibrated from."""
+    from pencilarrays_tpu import PencilArray, gather
+    from pencilarrays_tpu.ops.fft import PencilFFTPlan
+
+    rng = np.random.default_rng(23)
+    u0_host = rng.standard_normal((n, n, n)).astype(np.float32)
+    ref = None
+    out: dict = {}
+    for wire in WIRE_FORMATS:
+        plan = PencilFFTPlan(topo, (n, n, n), real=True, wire_dtype=wire)
+        u0 = PencilArray.from_global(plan.input_pencil, u0_host)
+        back = np.asarray(gather(plan.backward(plan.forward(u0))))
+        if wire is None:
+            ref = back
+            out["none"] = {"rel_err_max": 0.0, "rel_err_l2": 0.0,
+                           "ulp_at_scale": 0.0}
+        else:
+            out[wire] = _err_stats(ref, back)
+    return {"what": f"r2c plan forward+backward {n}^3, physical-space "
+                    f"error vs full precision (serving envelope source)",
+            **out}
 
 
 def _ns_arm(topo, n, steps=3) -> dict:
@@ -181,6 +213,7 @@ def run_wire_suite(devs, n: int = 32, k1: int = 6, repeats: int = 3,
             e["hlo_pinned"]
             for arm in ("transpose_f32", "transpose_c64")
             for e in results[arm].values())
+    results["plan_roundtrip"] = _plan_roundtrip_arm(topo, n)
     results["workload_navier_stokes"] = _ns_arm(topo, n, steps=ns_steps)
     results["workload_diffusion"] = _diffusion_arm(topo, n)
     return results

@@ -124,6 +124,21 @@ CHECKS = ("journal-event", "fleet-event", "env-knob", "plan-cache",
 WIRE_CAST_MODULES = ("parallel/transpositions.py", "parallel/routing.py")
 WIRE_CAST_FUNCTIONS = {"ops/fft.py": ("_fused_hop_fn",)}
 
+# PR 19: the fp8/u8 wire family is additionally audited PACKAGE-WIDE,
+# not just in the exchange modules above — a ``bitcast_convert_type``
+# call, or an ``.astype(...)`` targeting a sub-16-bit wire element
+# type, ANYWHERE outside parallel/wire.py is a finding.  The per-tile
+# scale transport makes ad-hoc fp8 casts uniquely dangerous: a payload
+# quantized outside the choke point ships no scales, so it decodes to
+# garbage that the guard's widened wire tolerance may well accept.
+# The allowlist is empty ON PURPOSE: there are no grandfathered sites.
+WIRE_CAST_EXEMPT = ("parallel/wire.py",)
+WIRE_CAST_FP8_NAMES = frozenset({
+    "float8_e4m3fn", "float8_e4m3", "float8_e5m2",
+    "fp8_e4m3", "fp8_e5m2", "e4m3", "e5m2", "uint8",
+})
+WIRE_CAST_ALLOWLIST: Tuple[str, ...] = ()
+
 # the only modules allowed to reference the ONE footprint accounting
 # (hop-peak check); everything else bounds through analysis.spmd
 HOP_PEAK_NAME = "_hop_peak_bytes"
@@ -734,6 +749,70 @@ def _check_wire_cast(root: str, trees: Dict[str, ast.Module],
                 visit(child, in_scope, in_target)
 
         visit(tree, "<module>", only_fns is None)
+    _check_wire_cast_fp8(root, trees, findings)
+
+
+def _fp8_cast_target(node: ast.AST) -> bool:
+    """Does an ``astype`` argument name an fp8/u8 element type?  Covers
+    the attribute (``jnp.float8_e4m3fn`` / ``jnp.uint8``), bare-name
+    and string spellings."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in WIRE_CAST_FP8_NAMES
+    if isinstance(node, ast.Name):
+        return node.id in WIRE_CAST_FP8_NAMES
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        v = node.value.lower().replace("-", "_")
+        return v in WIRE_CAST_FP8_NAMES or "float8" in v or "fp8" in v
+    return False
+
+
+def _check_wire_cast_fp8(root: str, trees: Dict[str, ast.Module],
+                         findings: List[Finding]) -> None:
+    """The package-wide fp8/u8 family rule (PR 19, see the constants'
+    comment): ``bitcast_convert_type`` calls and fp8/u8-targeted
+    ``.astype`` casts are findings everywhere but parallel/wire.py.
+    ``WIRE_CAST_ALLOWLIST`` idents are exempt — and it is empty."""
+    exempt = {os.path.join(root, PACKAGE, *m.split("/"))
+              for m in WIRE_CAST_EXEMPT}
+    for path, tree in trees.items():
+        if path in exempt:
+            continue
+        dotted = _module_dotted(root, path)
+
+        def visit(node: ast.AST, scope: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                inner = scope
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    inner = child.name
+                what = None
+                if (isinstance(child, ast.Call)
+                        and isinstance(child.func, ast.Attribute)):
+                    if child.func.attr == "bitcast_convert_type":
+                        what = "bitcast_convert_type"
+                    elif (child.func.attr == "astype"
+                          and any(_fp8_cast_target(a)
+                                  for a in child.args)):
+                        what = "fp8/u8-targeted .astype"
+                elif (isinstance(child, ast.Call)
+                      and isinstance(child.func, ast.Name)
+                      and child.func.id == "bitcast_convert_type"):
+                    what = "bitcast_convert_type"
+                if what is not None:
+                    ident = f"{dotted}.{scope}"
+                    if ident not in WIRE_CAST_ALLOWLIST:
+                        findings.append(Finding(
+                            "wire-cast", _rel(root, path), child.lineno,
+                            ident,
+                            f"{what} in {ident} — sub-16-bit wire "
+                            f"forms carry per-tile scales that ONLY "
+                            f"parallel/wire.py's pack/unpack transport "
+                            f"correctly; an ad-hoc cast ships a "
+                            f"scale-less payload the guard's widened "
+                            f"wire tolerance may silently accept"))
+                visit(child, inner)
+
+        visit(tree, "<module>")
 
 
 def _check_hop_peak(root: str, trees: Dict[str, ast.Module],

@@ -92,7 +92,14 @@ DEFAULT_DIR = "pa_obs"
 # journal the B-way ``traces`` fan-in), the key ``pa-obs request``
 # joins one ticket's causal timeline across router + mesh journals
 # by.  v1-v5 journals again stay lint-clean.
-SCHEMA_VERSION = 6
+# v7 (PR 19): the precision-downgrade rung — every ``serve.precision``
+# record (a sheddable request served on a cheaper wire format instead
+# of shed) must carry the full contract it was degraded under: the
+# wire it moved from/to, the CALIBRATED error envelope promised
+# (``serve/precision.py``, ``BENCH_WIRE.json``) and the tenant's
+# declared ``max_rel_l2`` budget it fit inside — see obs/schema.py
+# V7_EVENT_FIELDS.  v1-v6 journals again stay lint-clean.
+SCHEMA_VERSION = 7
 
 # events whose loss would blind a post-mortem: fsync'd under the default
 # "critical" policy.  High-rate events (per-hop dispatch) only flush.
@@ -123,6 +130,10 @@ CRITICAL_EVENTS = frozenset({
     # fires exactly when the process is most likely to die of the
     # overload that tripped it — the record must outlive the crash
     "serve.burn_alert",
+    # a precision downgrade changes the answer a client receives — the
+    # record of what envelope it was served under must survive the
+    # overload that caused it (same plane as shed/burn above)
+    "serve.precision",
     # fleet federation: a whole-mesh failover gates every re-bound
     # ticket, and a supervisor scale action moves real capacity —
     # both must survive the crash cascade that usually surrounds

@@ -15,8 +15,8 @@ from typing import Dict, Iterable, List, Tuple, Union
 from .events import SCHEMA_VERSION
 
 __all__ = ["COMMON_FIELDS", "EVENT_TYPES", "V4_EVENT_FIELDS",
-           "V5_EVENT_FIELDS", "V6_EVENT_FIELDS", "lint_event",
-           "lint_journal"]
+           "V5_EVENT_FIELDS", "V6_EVENT_FIELDS", "V7_EVENT_FIELDS",
+           "lint_event", "lint_journal"]
 
 # fields every record carries (written by events.record_event itself)
 COMMON_FIELDS: Tuple[str, ...] = (
@@ -72,6 +72,21 @@ V6_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     "serve.coalesce": ("trace", "traces"),
     "serve.dispatch": ("trace", "traces"),
     "serve.complete": ("trace",),
+}
+
+# per-event fields required since schema v7 (the precision-downgrade
+# rung, PR 19): a ``serve.precision`` record — a sheddable request
+# served on a cheaper wire format instead of shed — must journal the
+# full contract the degradation was admitted under: the wire precision
+# it moved from and to, the calibrated worst-case relative-l2 envelope
+# promised for that rung (``serve/precision.py`` / ``BENCH_WIRE.json``)
+# and the tenant-declared ``max_rel_l2`` budget the envelope fit
+# inside, plus the trace id so ``pa-obs request`` reconstructs WHICH
+# answers were degraded.  v1-v6 journals stay lint-clean, as with
+# every earlier versioned stamp.
+V7_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
+    "serve.precision": ("trace", "wire_from", "wire_to", "envelope",
+                        "max_rel_l2"),
 }
 
 # ev -> required payload fields (extra fields are allowed; missing ones
@@ -142,6 +157,11 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     # that tripped it
     "serve.burn_alert": ("tenant", "burn_rate", "threshold",
                          "window_s"),
+    # the precision-downgrade rung (serve/precision.py, schema v7):
+    # one fsync-critical record per request served on a cheaper wire
+    # format under pressure — v7 requires the full degradation
+    # contract (V7_EVENT_FIELDS)
+    "serve.precision": ("tenant", "req", "key", "gate"),
     # per-mesh task-graph executor (engine/): one record per engine
     # reformation boundary (queued dispatches dropped typed, fresh
     # RuntimeConfig snapshot, new generation)
@@ -224,6 +244,12 @@ def lint_event(e: dict) -> List[str]:
                 errors.append(
                     f"v{v} event {ev!r} missing required field {f!r} "
                     f"(request-trace fields, schema v6): {e!r}")
+    if isinstance(v, (int, float)) and v >= 7:
+        for f in V7_EVENT_FIELDS.get(ev, ()):
+            if f not in e:
+                errors.append(
+                    f"v{v} event {ev!r} missing required field {f!r} "
+                    f"(precision-downgrade fields, schema v7): {e!r}")
     return errors
 
 

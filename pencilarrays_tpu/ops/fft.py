@@ -466,16 +466,18 @@ def _decomposition_candidates(nprocs: int, N: int, mode: str
 
 
 def _iter_priced_hops(steps: tuple):
-    """Yield ``(src, dst, hop_dtype, base, k_mult)`` for every exchange
-    step of a static schedule — the ONE definition of the ``t``/``ft``
-    step-tuple unpacking shared by :meth:`PencilFFTPlan.
+    """Yield ``(src, dst, hop_dtype, base, k_mult, chunk)`` for every
+    exchange step of a static schedule — the ONE definition of the
+    ``t``/``ft`` step-tuple unpacking shared by :meth:`PencilFFTPlan.
     collective_costs` (the HLO-pinned pricer) and
     :func:`_schedule_score` (the decomposition scorer), so the two can
     never diverge on chunk accounting.  ``base`` is ``None`` for a
     plain ``t`` hop (price it at the plan's method — ``transpose_cost``
     itself multiplies the count for a ``Pipelined`` method); for a
     fused ``ft`` hop it is the unwrapped AllToAll/Ring base whose
-    chunking the fused program owns (``k_mult`` = chunk count)."""
+    chunking the fused program owns (``k_mult`` = chunk count, and
+    ``chunk = (chunk_dim, bounds)`` carries the exact slicing for
+    ``transpose_cost``'s per-chunk fp8 byte accounting)."""
     for step in steps:
         if step[0] == "t":
             # a 5-element "t" step carries a per-hop method override
@@ -483,11 +485,11 @@ def _iter_priced_hops(steps: tuple):
             # base with k_mult=1 — ``transpose_cost`` itself owns a
             # Pipelined method's count multiplication
             yield step[1], step[2], step[3], (
-                step[4] if len(step) > 4 else None), 1
+                step[4] if len(step) > 4 else None), 1, None
         elif step[0] == "ft":
             (_, src, dst, hop_dtype, _post, _ops, _pc, base,
-             _c, bounds) = step
-            yield src, dst, hop_dtype, base, len(bounds)
+             c, bounds) = step
+            yield src, dst, hop_dtype, base, len(bounds), (c, bounds)
 
 
 def _schedule_score(plan: "PencilFFTPlan", extra_dims: Tuple[int, ...],
@@ -519,7 +521,8 @@ def _schedule_score(plan: "PencilFFTPlan", extra_dims: Tuple[int, ...],
 
         method = replace(method, mode="estimate")
     score = hops = total_bytes = total_count = 0
-    for src, dst, hop_dtype, base, k_mult in _iter_priced_hops(plan._steps):
+    for src, dst, hop_dtype, base, k_mult, chunk in _iter_priced_hops(
+            plan._steps):
         if base is None:
             # plain hop: the plan's method, resolved quietly — probe
             # candidates must not journal auto.verdict records for
@@ -529,13 +532,17 @@ def _schedule_score(plan: "PencilFFTPlan", extra_dims: Tuple[int, ...],
         else:
             m = base  # fused hop: its program owns the chunking (k_mult)
         try:
-            cost = transpose_cost(src, dst, extra_dims, hop_dtype, m)
+            # chunk threads the fused slicing so fp8 hops price their
+            # per-chunk scale payloads; the count is already multiplied
+            # then, so k_mult must not double-apply
+            cost = transpose_cost(src, dst, extra_dims, hop_dtype, m,
+                                  chunk=chunk)
         except (TypeError, ValueError):
             continue  # unpriceable hop: score what the model can see
         if not cost:
             continue  # local permute / trivial axis: nothing on the wire
         drift = trusted_drift(drift_hops, _hop_label(src, dst, m, hop_dtype))
-        count = sum(v["count"] for v in cost.values()) * k_mult
+        count = sum(v["count"] for v in cost.values())
         nbytes = sum(v["bytes"] for v in cost.values())
         score += int(count * latency_bytes + nbytes * drift
                      + cast_score_bytes(nbytes, hop_dtype,
@@ -665,15 +672,18 @@ class PencilFFTPlan:
     ``vmap``) over the same plan.  Headline metric: transforms/sec at
     fixed mesh (``benchmarks/throughput.py``, ``BENCH_THROUGHPUT.json``).
 
-    ``wire_dtype="bf16" | "f16"`` (default ``None`` = full precision,
-    bit-identical to today) opts every exchange hop into the
-    reduced-precision wire format: payloads are cast-packed to the wire
-    dtype immediately before each collective and restored immediately
-    after, inside the same jitted/shard_map program, so XLA fuses the
-    casts into the exchange boundaries and the collective itself moves
-    half the bytes (f32/c64 payloads; complex hops split-complex pack —
-    see ``docs/WirePrecision.md`` for the accuracy model and the
-    guard's typed :class:`~pencilarrays_tpu.guard.errors.
+    ``wire_dtype="bf16" | "f16" | "fp8_e4m3" | "fp8_e5m2"`` (default
+    ``None`` = full precision, bit-identical to today) opts every
+    exchange hop into the reduced-precision wire format: payloads are
+    cast-packed to the wire dtype immediately before each collective
+    and restored immediately after, inside the same jitted/shard_map
+    program, so XLA fuses the casts into the exchange boundaries and
+    the collective itself moves half the bytes on a 16-bit wire, a
+    quarter plus the per-tile scale toll on fp8 (f32/c64 payloads;
+    complex hops split-complex pack; fp8 block-scales per 256-element
+    tile with the scales riding the same exchange — see
+    ``docs/WirePrecision.md`` for the accuracy model and the guard's
+    typed :class:`~pencilarrays_tpu.guard.errors.
     WirePrecisionError` tolerance contract).  Transform math stays full
     precision.  Priced end-to-end: ``collective_costs`` reports the
     halved wire bytes (still HLO-pinned), ``plan_key()`` fingerprints
@@ -724,12 +734,13 @@ class PencilFFTPlan:
         global_shape = tuple(int(n) for n in global_shape)
         N = len(global_shape)
         # -- reduced-precision wire format --------------------------------
-        # ``wire_dtype="bf16" | "f16"`` (default None = full precision,
-        # bit-identical) packs EVERY exchange hop's payload down to the
-        # wire format immediately before its collective and restores it
-        # after, inside the same program (parallel/wire.py) — transform
-        # math and accumulation stay full precision; only the wire
-        # narrows (bytes ÷2 for f32/c64 payloads, HLO-pinned).  The
+        # ``wire_dtype="bf16" | "f16" | "fp8_e4m3" | "fp8_e5m2"``
+        # (default None = full precision, bit-identical) packs EVERY
+        # exchange hop's payload down to the wire format immediately
+        # before its collective and restores it after, inside the same
+        # program (parallel/wire.py) — transform math and accumulation
+        # stay full precision; only the wire narrows (bytes ÷2 on
+        # 16-bit wires, ÷4 + per-tile scales on fp8, HLO-pinned).  The
         # plan's method carries it (with_wire), so pricing, execution,
         # plan_key() and the guard's tolerance model all see one truth.
         from ..parallel.transpositions import with_wire
@@ -1234,6 +1245,50 @@ class PencilFFTPlan:
         journal records)."""
         return self.plan_key()
 
+    def with_wire_dtype(self, wire_dtype) -> "PencilFFTPlan":
+        """This schedule at a different wire precision — the serving
+        plane's downgrade lever (``serve/service.py``): under pressure
+        the gate swaps a sheddable tenant's plan for its
+        bf16/fp8 variant at admission, so the coalescer key
+        (:meth:`plan_key` — ``wire_dtype`` is part of the schedule
+        identity), the batch pricer, the registry's compiled-variant
+        cache and the dispatch log's wire-byte certification all see
+        the cheaper wire automatically, with NO new code path.
+
+        Reconstructs the plan from its own resolved attributes
+        (topology, transforms, method with the old wire stripped,
+        pipeline chunks, batch, hbm_limit), so the variant's schedule
+        is the SAME schedule — only the exchange payloads narrow.
+        ``wire_dtype=None`` variants of an unwired plan return
+        ``self``; variants are cached per canonical spelling (the
+        admission hot path must not rebuild a plan per request)."""
+        from ..parallel.transpositions import strip_wire, with_wire
+        from ..parallel.wire import canonical_wire_dtype
+
+        wire = canonical_wire_dtype(wire_dtype)
+        if wire == self.wire_dtype:
+            return self
+        cache = self.__dict__.setdefault("_wire_variant_cache", {})
+        if wire in cache:
+            return cache[wire]
+        variant = PencilFFTPlan(
+            self.topology, self.shape_physical,
+            transforms=self.transforms, dtype=self.dtype_physical,
+            permute=self.permute,
+            method=with_wire(strip_wire(self.method), wire),
+            normalization=self.normalization,
+            pipeline=(self.pipeline_chunks
+                      if self.pipeline_chunks > 1 else None),
+            batch=self.batch, hbm_limit=self.hbm_limit)
+        # an auto-decomposed parent resolved its topology before this
+        # reconstruction; carry the verdict so the variant fingerprints
+        # identically to a sibling built with the same decomposition=
+        # argument (wire_dtype stays the ONLY plan_key difference)
+        variant.decomposition = self.decomposition
+        variant.decomposition_verdict = self.decomposition_verdict
+        cache[wire] = variant
+        return variant
+
     def _obs_summary(self) -> dict:
         """The ``plan.build`` journal payload: the static schedule and
         its predicted collective costs — what a post-mortem needs to
@@ -1355,29 +1410,32 @@ class PencilFFTPlan:
         method = method if method is not None else self.method
         total: dict = {}
 
-        def add(src, dst, hop_dtype, m, k_mult=1):
+        def add(src, dst, hop_dtype, m, chunk=None):
+            # a fused hop's chunking rides the chunk kwarg: the count
+            # multiplies by the chunk count, bytes stay whole on 16-bit
+            # wires and sum per chunk on fp8 (each chunk packs its own
+            # scale tensor) — same rule as the Pipelined branch of
+            # transpose_cost, which owns it
             for op, c in transpose_cost(src, dst, extra_dims, hop_dtype,
-                                        m).items():
+                                        m, chunk=chunk).items():
                 e = total.setdefault(op, {"count": 0, "bytes": 0})
-                # chunking multiplies launches, never bytes (ceil chunks
-                # partition the block exactly) — same rule as the
-                # Pipelined branch of transpose_cost
-                e["count"] += c["count"] * k_mult
+                e["count"] += c["count"]
                 e["bytes"] += c["bytes"]
 
-        for src, dst, hop_dtype, base, k_mult in _iter_priced_hops(
+        for src, dst, hop_dtype, base, k_mult, chunk in _iter_priced_hops(
                 self._steps):
             if base is None:
                 add(src, dst, hop_dtype, method)
                 continue
             m = base if method is self.method else method
             if isinstance(m, Pipelined) and k_mult > 1:
-                # the fused hop owns the chunking (k_mult) — unwrap an
+                # the fused hop owns the chunking (chunk) — unwrap an
                 # override so the count is not multiplied twice.  A
                 # k_mult == 1 base is an hbm_limit "t"-hop Pipelined
                 # override whose count transpose_cost multiplies itself
                 m = m.base
-            add(src, dst, hop_dtype, m, k_mult=k_mult)
+            add(src, dst, hop_dtype, m,
+                chunk=chunk if k_mult > 1 else None)
         return total
 
     def predicted_wire_bytes(self, extra_dims: Optional[Tuple[int, ...]]

@@ -96,7 +96,7 @@ from .transpositions import (
     resolve_method,
     transpose_cost,
 )
-from .wire import cast_score_bytes, wire_itemsize
+from .wire import cast_score_bytes, wire_bytes
 
 __all__ = [
     "ReshardRoute",
@@ -278,20 +278,24 @@ def _hop_peak_bytes(pin: Pencil, pout: Pencil, R: Optional[int],
     if R is None:  # local permute: in + out blocks (nothing packs)
         return (pin.bytes_per_device(extra_dims, isize=isize)
                 + pout.bytes_per_device(extra_dims, isize=isize))
+    a, b = pin.decomposition[R], pout.decomposition[R]
     ext = _exchange_operand_extents(pin, pout, R)
     shape = tuple(ext) + tuple(extra_dims)
     elems = int(np.prod(shape, dtype=np.int64))
-    w = wire_itemsize(dtype, _method_wire(method))
     if bounds is None and isinstance(method, Pipelined):
-        chunk_dim = _pipeline_chunk_axis(
-            shape, pin.decomposition[R], pout.decomposition[R])
+        chunk_dim = _pipeline_chunk_axis(shape, a, b)
         if chunk_dim is not None:
             bounds = _chunk_bounds(shape[chunk_dim], method.chunks)
-    chunk_elems = elems
+    chunk_shape = shape
     if chunk_dim is not None and bounds is not None and len(bounds) > 1:
         widest = max(s1 - s0 for s0, s1 in bounds)
-        chunk_elems = elems // shape[chunk_dim] * widest
-    return elems * isize + chunk_elems * w
+        chunk_shape = (shape[:chunk_dim] + (widest,)
+                       + shape[chunk_dim + 1:])
+    # the in-flight packed chunk at the shared wire_bytes accounting —
+    # on an fp8 wire this includes the chunk's own scale side payload
+    packed = wire_bytes(dtype, _method_wire(method), chunk_shape,
+                        axes=(a, b))
+    return elems * isize + packed
 
 
 def _synthesize_chunked(psrc: Pencil, pdst: Pencil, R: int,

@@ -151,17 +151,37 @@ def with_wire(method: "AbstractTransposeMethod",
         f"exchanges are partitioner-owned and cannot be packed)")
 
 
+def strip_wire(method: "AbstractTransposeMethod"
+               ) -> "AbstractTransposeMethod":
+    """Return ``method`` with its ``wire_dtype`` removed throughout —
+    the inverse of :func:`with_wire`, used by
+    ``PencilFFTPlan.with_wire_dtype`` to re-derive precision variants
+    of one schedule (the serving plane's downgrade ladder) from a plan
+    whose method already carries a wire."""
+    from dataclasses import replace
+
+    if isinstance(method, (AllToAll, Ring, Auto)):
+        return (replace(method, wire_dtype=None)
+                if method.wire_dtype is not None else method)
+    if isinstance(method, Pipelined):
+        return replace(method, base=strip_wire(method.base))
+    return method
+
+
 @dataclass(frozen=True)
 class AllToAll(AbstractTransposeMethod):
     """Explicit single-axis ``lax.all_to_all`` under ``shard_map``.
 
-    ``wire_dtype="bf16" | "f16"`` (default ``None`` = full precision,
-    bit-identical to the historical behavior) packs the exchanged
-    payload down to the reduced wire format immediately before the
-    collective and restores it immediately after, inside the same
-    traced program (``parallel/wire.py``): the wire moves half the
-    bytes (f32/c64; a quarter for f64/c128) while all surrounding math
-    stays full precision.  Complex payloads split-complex pack."""
+    ``wire_dtype="bf16" | "f16" | "fp8_e4m3" | "fp8_e5m2"`` (default
+    ``None`` = full precision, bit-identical to the historical
+    behavior) packs the exchanged payload down to the reduced wire
+    format immediately before the collective and restores it
+    immediately after, inside the same traced program
+    (``parallel/wire.py``): a 16-bit wire moves half the bytes
+    (f32/c64; a quarter for f64/c128), an fp8 wire a quarter plus
+    4 bytes of max-abs scale per 256-element tile riding the same
+    exchange, while all surrounding math stays full precision.
+    Complex payloads split-complex pack."""
 
     wire_dtype: Optional[str] = None
 
@@ -543,7 +563,12 @@ def _wire_wrapped_factory(inner_factory, wire_dtype: str):
     the collective, restore immediately after — INSIDE the exchange
     closure, so a :class:`Pipelined` chunk packs per chunk (the chunked
     program stays chunk-local; no full-array cast materializes to kill
-    the overlap win) and Ring rounds move packed tiles."""
+    the overlap win) and Ring rounds move packed tiles.  The exchange
+    axes ``(a, b)`` and the pre-pack shape thread through to
+    pack/unpack — the fp8 formats lay their per-tile scale windows
+    along an axis the exchange leaves untouched and re-derive the tile
+    geometry on arrival (:func:`~pencilarrays_tpu.parallel.wire
+    .fp8_tile_axis`)."""
     from . import wire as _wire
 
     def factory(axis, P, a, b):
@@ -551,10 +576,11 @@ def _wire_wrapped_factory(inner_factory, wire_dtype: str):
 
         def exchange(x):
             with jax.named_scope("wire_pack"):
-                packed = _wire.pack(x, wire_dtype)
+                packed = _wire.pack(x, wire_dtype, axes=(a, b))
             moved = inner(packed)
             with jax.named_scope("wire_unpack"):
-                return _wire.unpack(moved, x.dtype, wire_dtype)
+                return _wire.unpack(moved, x.dtype, wire_dtype,
+                                    axes=(a, b), orig_shape=x.shape)
 
         return exchange
 
@@ -627,8 +653,8 @@ def _exchange_operand_extents(pin: Pencil, pout: Pencil, R: int
 
 
 def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
-                   dtype=None, method: AbstractTransposeMethod = AllToAll()
-                   ) -> dict:
+                   dtype=None, method: AbstractTransposeMethod = AllToAll(),
+                   *, chunk=None) -> dict:
     """Predicted per-chip collective cost of one transpose hop, in the
     same ``{op: {"count", "bytes"}}`` schema ``utils.hlo.collective_stats``
     measures from compiled HLO — so prediction and measurement are
@@ -657,14 +683,27 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
 
     Precision dimension: a method carrying ``wire_dtype`` is priced at
     the wire format's per-element bytes (``parallel/wire.py``'s
-    :func:`~pencilarrays_tpu.parallel.wire.wire_itemsize` — 2 bytes per
-    real component, so f32/c64 payloads halve) — and the compiled HLO's
-    collective shapes genuinely ARE the wire dtype, so the prediction
-    stays pinned EQUAL to measurement with the wire on.
-    """
-    import numpy as np
+    :func:`~pencilarrays_tpu.parallel.wire.wire_bytes` — 2 bytes per
+    real component on bf16/f16, 1 on fp8 plus the exactly-priced
+    per-tile scale side payload) — and the compiled HLO's collective
+    shapes genuinely ARE the wire dtype, so the prediction stays
+    pinned EQUAL to measurement with the wire on.
 
-    from .wire import wire_bytes, wire_itemsize
+    fp8 exception to the Pipelined rule: pack runs per chunk, so each
+    chunk ships its OWN scale tensor — when the chunk axis is also the
+    tile axis, chunking multiplies the number of scale windows, and
+    total bytes genuinely grow with the chunk count.  The fp8 branch
+    therefore prices per-chunk operands and SUMS them (honest
+    accounting, still HLO-pinned) instead of assuming byte invariance.
+
+    ``chunk=(chunk_dim, bounds)`` prices an explicit AllToAll/Ring
+    exchange whose caller owns the chunking (the FFT planner's fused
+    ``ft`` hops — their program slices the operand itself): the
+    collective count multiplies by ``len(bounds)``, bytes stay whole
+    on 16-bit wires and sum per chunk on fp8 — the SAME rule the
+    :class:`Pipelined` branch applies to its own chunk choice.
+    """
+    from .wire import FP8_WIRE_DTYPES, wire_bytes
 
     R = assert_compatible(pin, pout)
     if isinstance(method, Auto):
@@ -683,40 +722,63 @@ def transpose_cost(pin: Pencil, pout: Pencil, extra_dims: Tuple[int, ...] = (),
     a = pin.decomposition[R]
     b = pout.decomposition[R]
     ext = _exchange_operand_extents(pin, pout, R)
-    elems = int(np.prod(ext, dtype=np.int64))
-    for e in extra_dims:
-        elems *= int(e)
-    isize = wire_itemsize(dtype, _method_wire(method))
-    if isinstance(method, AllToAll):
+    shape = tuple(ext) + tuple(extra_dims)
+    wire = _method_wire(method)
+
+    def _operand_bytes(s):
         # wire_bytes is the ONE per-operand byte definition shared with
-        # collective_costs (via this function) and routing.py
-        return {"all-to-all": {
-            "count": 1,
-            "bytes": wire_bytes(dtype, _method_wire(method),
-                                ext + tuple(extra_dims))}}
-    if isinstance(method, Ring):
-        n_a = pin.size_global()[a]
-        n_b = pin.size_global()[b]
-        a_blk = pin.padded_global_shape[a] // P
-        b_blk = pout.padded_global_shape[b] // P
-        G = max(-(-n_a // a_blk), -(-n_b // b_blk))
-        tile = elems // P
-        if G <= 1:
-            return {}
-        return {"collective-permute":
-                {"count": G - 1, "bytes": (G - 1) * tile * isize}}
+        # collective_costs (via this function) and routing.py; the
+        # exchange axes make the fp8 scale overhead exactly priceable
+        return wire_bytes(dtype, wire, s, axes=(a, b))
+
+    def _base_cost(m, s):
+        """Cost of one explicit base exchange of operand shape ``s``."""
+        if isinstance(m, AllToAll):
+            return {"all-to-all": {"count": 1, "bytes": _operand_bytes(s)}}
+        if isinstance(m, Ring):
+            n_a = pin.size_global()[a]
+            n_b = pin.size_global()[b]
+            a_blk = pin.padded_global_shape[a] // P
+            b_blk = pout.padded_global_shape[b] // P
+            G = max(-(-n_a // a_blk), -(-n_b // b_blk))
+            if G <= 1:
+                return {}
+            # each round moves one b-block tile of the packed operand
+            ts = s[:b] + (b_blk,) + s[b + 1:]
+            return {"collective-permute":
+                    {"count": G - 1, "bytes": (G - 1) * _operand_bytes(ts)}}
+        raise ValueError(f"no analytic cost model for method {m!r}")
+
+    def _chunked_cost(m, c, bounds):
+        k_eff = len(bounds)
+        if wire not in FP8_WIRE_DTYPES or k_eff == 1:
+            # chunking multiplies the collective COUNT and leaves total
+            # wire bytes unchanged (ceil chunks partition the block
+            # exactly) — the schema prediction stays equal to
+            # compiled-HLO measurement
+            base = transpose_cost(pin, pout, extra_dims, dtype, m)
+            return {op: {"count": v["count"] * k_eff, "bytes": v["bytes"]}
+                    for op, v in base.items()}
+        # fp8: pack runs per chunk — sum each chunk's exact packed bytes
+        out: dict = {}
+        for s0, s1 in bounds:
+            cs = shape[:c] + (s1 - s0,) + shape[c + 1:]
+            for op, v in _base_cost(m, cs).items():
+                e = out.setdefault(op, {"count": 0, "bytes": 0})
+                e["count"] += v["count"]
+                e["bytes"] += v["bytes"]
+        return out
+
     if isinstance(method, Pipelined):
-        # chunking multiplies the collective COUNT and leaves total wire
-        # bytes unchanged (ceil chunks partition the block exactly) — the
-        # schema prediction stays equal to compiled-HLO measurement
-        base = transpose_cost(pin, pout, extra_dims, dtype, method.base)
-        shape = tuple(ext) + tuple(extra_dims)
         c = _pipeline_chunk_axis(shape, a, b)
-        k_eff = (len(_chunk_bounds(shape[c], method.chunks))
-                 if c is not None else 1)
-        return {op: {"count": v["count"] * k_eff, "bytes": v["bytes"]}
-                for op, v in base.items()}
-    raise ValueError(f"no analytic cost model for method {method!r}")
+        if c is None:
+            return transpose_cost(pin, pout, extra_dims, dtype,
+                                  method.base)
+        return _chunked_cost(method.base,
+                             c, _chunk_bounds(shape[c], method.chunks))
+    if chunk is not None and len(chunk[1]) > 1:
+        return _chunked_cost(method, chunk[0], tuple(chunk[1]))
+    return _base_cost(method, shape)
 
 
 # ---------------------------------------------------------------------------

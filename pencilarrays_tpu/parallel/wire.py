@@ -39,18 +39,46 @@ Three contracts, all enforced here so no caller can drift:
   a typed :class:`~pencilarrays_tpu.guard.errors.WirePrecisionError`,
   never a silent wrong answer.  Override:
   ``PENCILARRAYS_TPU_GUARD_WIRE_RTOL``.
+
+PR 19 finishes the precision ladder with the fp8 formats
+(``wire_dtype="fp8_e4m3" | "fp8_e5m2"``, ÷4 bytes on f32/c64 payloads)
+using PER-TILE SCALING: fp8 has 3-4 significand bits and a few hundred
+representable magnitudes, so a raw elementwise cast would flush or
+saturate any payload whose dynamic range spans more than the format —
+instead :func:`pack` tiles the shard along its largest FREE axis (one
+not being split or concatenated by the exchange —
+:func:`fp8_tile_axis`), computes a finite-masked max-abs per
+:data:`FP8_TILE`-element window inside the same traced program, maps
+each window onto the format's full range (``amax -> FP8_FMAX``),
+quantizes, and ships the u8 BIT PATTERN with the f32 scale tensor
+riding the SAME collective as a tiny side payload: the scales are
+bitcast to u8 and concatenated onto the payload along the tile axis,
+so one exchange moves both and no backend can widen either.  Because
+the tile axis is untouched by the exchange (``AllToAll`` splits ``b``
+/ concats ``a``; ``Ring`` slices ``b`` and merges into ``a``), every
+payload slice travels WITH its scales and :func:`unpack` re-derives
+the tile geometry from the pre-pack shape alone.  e4m3 is the
+finite-only ``fn`` variant (max 448, NO inf — overflow and inf both
+land on NaN, still nonfinite, so the guard's finite-tap census is
+preserved); e5m2 trades two significand bits for f16's exponent range
+(max 57344, keeps inf).  ``wire_bytes`` prices the scale overhead
+exactly (``+4`` bytes per tile along the tile axis), so the HLO-pinned
+prediction==measurement equality holds for fp8 too.
 """
 
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
 __all__ = [
     "WIRE_DTYPES",
+    "FP8_WIRE_DTYPES",
+    "FP8_TILE",
     "canonical_wire_dtype",
+    "fp8_tile_axis",
     "pack",
     "unpack",
     "wire_itemsize",
@@ -61,13 +89,36 @@ __all__ = [
 
 # canonical name -> numpy-compatible dtype constructor.  bf16 keeps the
 # f32 exponent range (safe default for spectra spanning decades); f16
-# carries 3 more mantissa bits but overflows beyond ~65504.
-WIRE_DTYPES = ("bf16", "f16")
+# carries 3 more mantissa bits but overflows beyond ~65504.  The fp8
+# pair quarters the wire instead of halving it: e4m3 carries 3
+# significand bits over a finite-only ±448 range (per-tile scaling
+# supplies the dynamic range the format lacks), e5m2 keeps two fewer
+# bits but f16's exponent span — pick e5m2 only when single tiles
+# legitimately span >2^8 of dynamic range.
+WIRE_DTYPES = ("bf16", "f16", "fp8_e4m3", "fp8_e5m2")
+FP8_WIRE_DTYPES = ("fp8_e4m3", "fp8_e5m2")
 
 # machine epsilon of each wire format (2^-mantissa_bits): the per-element
 # relative quantization error of one downcast is at most eps/2 (round to
 # nearest even), and the guard's content-sum tolerance scales it.
-_WIRE_EPS = {"bf16": 2.0 ** -8, "f16": 2.0 ** -11}
+_WIRE_EPS = {"bf16": 2.0 ** -8, "f16": 2.0 ** -11,
+             "fp8_e4m3": 2.0 ** -3, "fp8_e5m2": 2.0 ** -2}
+
+# fp8 format constants, hardcoded rather than derived: np.finfo rejects
+# the ml_dtypes extension classes on this container's numpy, and the
+# values are fixed by the OCP FP8 spec (e4m3fn: 1-4-3, max finite
+# 0b0.1111.110 = 448, no inf; e5m2: 1-5-2, max finite 57344).
+_FP8_FMAX = {"fp8_e4m3": 448.0, "fp8_e5m2": 57344.0}
+# smallest positive subnormal (2^(1-bias-mantissa)): values below
+# ~scale*sub/2 flush to zero on the wire — priced by wire_rtol's
+# scale-granularity term.
+_FP8_SUB = {"fp8_e4m3": 2.0 ** -9, "fp8_e5m2": 2.0 ** -16}
+
+# per-tile scaling window (elements along the tile axis sharing one f32
+# scale).  256 keeps the side payload at 4/256 = 1.6% of the wire while
+# staying tight enough that one outlier only costs its own window's
+# resolution.
+FP8_TILE = 256
 
 # Casts are HBM traffic, not ICI traffic: pack reads full + writes wire,
 # unpack reads wire + writes full, and HBM bandwidth is roughly an order
@@ -78,34 +129,184 @@ _WIRE_EPS = {"bf16": 2.0 ** -8, "f16": 2.0 ** -11}
 CAST_BYTES_WEIGHT = 0.125
 
 
+_WIRE_ALIASES = {
+    "bfloat16": "bf16", "float16": "f16", "half": "f16",
+    "e4m3": "fp8_e4m3", "float8_e4m3": "fp8_e4m3",
+    "float8_e4m3fn": "fp8_e4m3", "fp8-e4m3": "fp8_e4m3",
+    "e5m2": "fp8_e5m2", "float8_e5m2": "fp8_e5m2",
+    "fp8-e5m2": "fp8_e5m2",
+}
+
+
 def canonical_wire_dtype(wire_dtype) -> Optional[str]:
-    """Normalize a ``wire_dtype`` spelling to ``"bf16"``/``"f16"``/
-    ``None``.  Accepts the canonical strings, ``"bfloat16"``/
-    ``"float16"``, and jnp/np dtype objects; anything else is a typed
-    ``ValueError`` (an unsupported wire format must fail at
-    construction, not dispatch)."""
+    """Normalize a ``wire_dtype`` spelling to one of
+    :data:`WIRE_DTYPES` or ``None``.  Accepts the canonical strings,
+    ``"bfloat16"``/``"float16"``, the fp8 spellings
+    (``"e4m3"``/``"float8_e4m3fn"``/...), and jnp/np dtype objects;
+    anything else is a typed ``ValueError`` (an unsupported wire format
+    must fail at construction, not dispatch).  An fp8 spelling also
+    resolves the element type eagerly
+    (:func:`~pencilarrays_tpu.utils.jaxcompat.wire_fp8_dtype`), so a
+    jax build without fp8 fails HERE with a typed ``WireDtypeError``
+    naming the missing class."""
     if wire_dtype is None:
         return None
     if isinstance(wire_dtype, str):
         name = wire_dtype.strip().lower()
     else:
         name = np.dtype(wire_dtype).name  # jnp.bfloat16 has an np dtype
-    name = {"bfloat16": "bf16", "float16": "f16", "half": "f16"}.get(
-        name, name)
+    name = _WIRE_ALIASES.get(name, name)
     if name not in WIRE_DTYPES:
         raise ValueError(
-            f"wire_dtype must be None, 'bf16' or 'f16', got "
+            f"wire_dtype must be None or one of {WIRE_DTYPES}, got "
             f"{wire_dtype!r}")
+    if name in FP8_WIRE_DTYPES:
+        from ..utils.jaxcompat import wire_fp8_dtype
+
+        wire_fp8_dtype(name)  # fail at construction if the build lacks it
     return name
 
 
 def _jnp_wire(wire: str):
     import jax.numpy as jnp
 
+    if wire in FP8_WIRE_DTYPES:
+        from ..utils.jaxcompat import wire_fp8_dtype
+
+        return wire_fp8_dtype(wire)
     return jnp.bfloat16 if wire == "bf16" else jnp.float16
 
 
-def pack(x, wire_dtype: str):
+def fp8_tile_axis(shape: Sequence[int], a: int, b: int) -> int:
+    """THE tile-axis rule pack, unpack and ``wire_bytes`` share: the
+    largest-extent axis of the pre-pack payload shape that is NOT one
+    of the exchange axes (``a`` = concat dim, ``b`` = split dim), ties
+    to the lowest index.  The exchange leaves this axis untouched on
+    every method (AllToAll tiles over ``b``/``a``; Ring slices ``b``
+    and merges into ``a``), so the scale windows laid along it travel
+    intact with their payload elements and the receiver can re-derive
+    the tile geometry from the pre-pack shape alone.  A payload with no
+    free axis (pure 2-D ``(a, b)`` operand) cannot carry per-tile
+    scales and raises — the planner must fall back to a 16-bit wire."""
+    best, best_n = -1, -1
+    for i, n in enumerate(shape):
+        if i == a or i == b:
+            continue
+        if int(n) > best_n:
+            best, best_n = i, int(n)
+    if best < 0:
+        raise ValueError(
+            f"fp8 wire needs a tile axis outside the exchange axes "
+            f"(a={a}, b={b}), but shape {tuple(shape)} has no free "
+            f"axis — use a 16-bit wire for 2-D exchange operands")
+    return best
+
+
+def _fp8_geometry(shape: Sequence[int], a: int, b: int) -> Tuple[int, int, int]:
+    """(tile_axis, n_t, ntiles) of one pre-pack payload shape."""
+    t = fp8_tile_axis(shape, a, b)
+    n_t = int(shape[t])
+    return t, n_t, -(-n_t // FP8_TILE)
+
+
+def _split_complex(x):
+    """(parts, was_complex): re/im stacked along a NEW trailing axis
+    for complex payloads, the payload itself otherwise.  Exact dtypes
+    raise — the caller opted into a float wire for float data, not
+    into corrupting indices."""
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1), True
+    if not jnp.issubdtype(x.dtype, jnp.inexact):
+        raise TypeError(
+            f"a reduced-precision wire needs an inexact payload dtype; "
+            f"got {x.dtype} (exact dtypes have no lossy wire form)")
+    return x, False
+
+
+def _fp8_pack(x, wire: str, a: int, b: int):
+    """Per-tile-scaled fp8 quantization (traced) — see module doc.
+
+    The finite mask does double duty: nonfinite taps are excluded from
+    the max-abs (one Inf must not zero out its whole window) AND pass
+    through the quantizer unclipped, so Inf/NaN arrive nonfinite on
+    the far side (under e4m3fn, Inf converts to NaN — still nonfinite,
+    so the guard's finite-tap census is preserved; NaN==NaN passes the
+    content compare).  All-zero (or all-nonfinite) windows take
+    scale=1 so zero stays exactly zero.  The clip guards the one-ULP
+    f32 rounding edge where ``amax/scale`` lands a hair above FMAX
+    and would otherwise overflow the finite-only e4m3."""
+    import jax
+    import jax.numpy as jnp
+
+    fdt = _jnp_wire(wire)
+    fmax = _FP8_FMAX[wire]
+    parts, _ = _split_complex(x)
+    t, n_t, ntiles = _fp8_geometry(x.shape, a, b)
+
+    finite = jnp.isfinite(parts)
+    absx = jnp.where(finite, jnp.abs(parts), 0)
+    pad = ntiles * FP8_TILE - n_t
+    if pad:
+        widths = [(0, 0)] * parts.ndim
+        widths[t] = (0, pad)
+        absx = jnp.pad(absx, widths)  # zeros never win a max-abs
+    tiled = absx.reshape(
+        parts.shape[:t] + (ntiles, FP8_TILE) + parts.shape[t + 1:])
+    amax = tiled.max(axis=t + 1)
+    scale = jnp.where(amax > 0, amax / fmax, 1).astype(jnp.float32)
+    # per-element scale: repeat each window's scale and trim the tail
+    # (cheaper than padding the payload itself through the quantizer)
+    per = jax.lax.slice_in_dim(
+        jnp.repeat(scale.astype(parts.dtype), FP8_TILE, axis=t),
+        0, n_t, axis=t)
+    scaled = parts / per
+    q = jnp.where(finite, jnp.clip(scaled, -fmax, fmax),
+                  scaled).astype(fdt)
+    payload = jax.lax.bitcast_convert_type(q, jnp.uint8)
+    su8 = jax.lax.bitcast_convert_type(scale, jnp.uint8)  # +trailing (4,)
+    su8 = jnp.moveaxis(su8, -1, t + 1).reshape(
+        scale.shape[:t] + (4 * ntiles,) + scale.shape[t + 1:])
+    # ONE u8 array, ONE collective: scales ride the exchange folded
+    # onto the tile axis — untouched by split/concat, so each payload
+    # slice travels with exactly its own windows' scales.
+    return jnp.concatenate([payload, su8], axis=t)
+
+
+def _fp8_unpack(y, orig_dtype, wire: str, a: int, b: int,
+                orig_shape: Sequence[int]):
+    """Inverse of :func:`_fp8_pack`: re-derive the tile geometry from
+    the PRE-PACK shape (the tile axis and its extent survive every
+    exchange), split payload from scales, reverse both bitcasts, and
+    rescale.  Non-tile extents are read off the received array — the
+    exchange has resized ``a``/``b`` by then."""
+    import jax
+    import jax.numpy as jnp
+
+    orig = jnp.dtype(orig_dtype)
+    is_c = jnp.issubdtype(orig, jnp.complexfloating)
+    # host-side dtype math only (c64 -> f32, c128 -> f64)
+    real_dt = np.empty(0, np.dtype(orig)).real.dtype if is_c else orig
+    t, n_t, ntiles = _fp8_geometry(orig_shape, a, b)
+
+    payload = jax.lax.slice_in_dim(y, 0, n_t, axis=t)
+    su8 = jax.lax.slice_in_dim(y, n_t, n_t + 4 * ntiles, axis=t)
+    su8 = jnp.moveaxis(
+        su8.reshape(su8.shape[:t] + (ntiles, 4) + su8.shape[t + 1:]),
+        t + 1, -1)
+    scale = jax.lax.bitcast_convert_type(su8, jnp.float32)
+    per = jax.lax.slice_in_dim(
+        jnp.repeat(scale, FP8_TILE, axis=t), 0, n_t, axis=t)
+    vals = jax.lax.bitcast_convert_type(payload, _jnp_wire(wire))
+    parts = jnp.asarray(vals, real_dt) * jnp.asarray(per, real_dt)
+    if is_c:
+        return jnp.asarray(
+            jax.lax.complex(parts[..., 0], parts[..., 1]), orig)
+    return jnp.asarray(parts, orig)
+
+
+def pack(x, wire_dtype: str, *, axes: Optional[Tuple[int, int]] = None):
     """Cast one exchange payload down to its wire format (traced).
 
     Real inexact payloads cast elementwise; complex payloads split into
@@ -114,39 +315,55 @@ def pack(x, wire_dtype: str):
     lossless narrow wire form and raise — the caller opted into a
     float wire for float data, not into corrupting indices.
 
-    The payload ships as the wire format's raw 16-BIT PATTERN
-    (``bitcast_convert_type`` to ``uint16`` — a free reinterpret, no
-    value change): backends without native bf16 collective support
-    (XLA:CPU — the virtual test mesh) would otherwise WIDEN a bf16
-    collective back to f32 through the float-normalization pass,
-    silently unhalving the wire, while an integer collective moves
-    exactly 2 bytes per component on every backend.  :func:`unpack`
-    bitcasts back before the restoring upcast."""
+    The payload ships as the wire format's raw BIT PATTERN
+    (``bitcast_convert_type`` to ``uint16``/``uint8`` — a free
+    reinterpret, no value change): backends without native bf16/fp8
+    collective support (XLA:CPU — the virtual test mesh) would
+    otherwise WIDEN the collective back to f32 through the
+    float-normalization pass, silently un-narrowing the wire, while an
+    integer collective moves exactly the wire bytes on every backend.
+    :func:`unpack` bitcasts back before the restoring upcast.
+
+    The fp8 formats additionally need ``axes=(a, b)`` — the exchange's
+    concat/split dims — to lay their per-tile scale windows along an
+    axis the exchange will not touch (:func:`fp8_tile_axis`)."""
     import jax
     import jax.numpy as jnp
 
-    wt = _jnp_wire(wire_dtype)
-    if jnp.issubdtype(x.dtype, jnp.complexfloating):
-        parts = jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
-        return jax.lax.bitcast_convert_type(jnp.asarray(parts, wt),
-                                            jnp.uint16)
-    if not jnp.issubdtype(x.dtype, jnp.inexact):
-        raise TypeError(
-            f"wire_dtype={wire_dtype!r} needs an inexact payload dtype; "
-            f"got {x.dtype} (exact dtypes have no lossy wire form)")
-    return jax.lax.bitcast_convert_type(jnp.asarray(x, wt), jnp.uint16)
+    wire = canonical_wire_dtype(wire_dtype)
+    if wire in FP8_WIRE_DTYPES:
+        if axes is None:
+            raise ValueError(
+                f"wire_dtype={wire!r} needs axes=(a, b) to derive its "
+                f"tile axis — fp8 pack is exchange-geometry aware")
+        return _fp8_pack(x, wire, int(axes[0]), int(axes[1]))
+    parts, _ = _split_complex(x)
+    return jax.lax.bitcast_convert_type(
+        jnp.asarray(parts, _jnp_wire(wire)), jnp.uint16)
 
 
-def unpack(y, orig_dtype, wire_dtype: str):
+def unpack(y, orig_dtype, wire_dtype: str, *,
+           axes: Optional[Tuple[int, int]] = None,
+           orig_shape: Optional[Sequence[int]] = None):
     """Restore a packed payload to its original dtype (traced): the
     exact inverse of :func:`pack`'s bitcast + shape change — values
     carry the wire format's quantization, which the guard's tolerance
-    model prices (:func:`wire_rtol`)."""
+    model prices (:func:`wire_rtol`).  The fp8 formats need the SAME
+    ``axes`` pack used plus the pre-pack ``orig_shape`` to re-derive
+    the tile geometry (both survive the exchange by construction)."""
     import jax
     import jax.numpy as jnp
 
+    wire = canonical_wire_dtype(wire_dtype)
+    if wire in FP8_WIRE_DTYPES:
+        if axes is None or orig_shape is None:
+            raise ValueError(
+                f"wire_dtype={wire!r} unpack needs axes=(a, b) and the "
+                f"pre-pack orig_shape to re-derive its tile geometry")
+        return _fp8_unpack(y, orig_dtype, wire, int(axes[0]),
+                           int(axes[1]), orig_shape)
     orig = jnp.dtype(orig_dtype)
-    w = jax.lax.bitcast_convert_type(y, _jnp_wire(wire_dtype))
+    w = jax.lax.bitcast_convert_type(y, _jnp_wire(wire))
     if jnp.issubdtype(orig, jnp.complexfloating):
         # host-side dtype math only (c64 -> f32, c128 -> f64)
         real_dt = np.empty(0, np.dtype(orig)).real.dtype
@@ -157,29 +374,53 @@ def unpack(y, orig_dtype, wire_dtype: str):
 
 
 def wire_itemsize(dtype, wire_dtype) -> int:
-    """Per-element wire bytes of one exchanged payload element: the
-    dtype's own itemsize at full precision, 2 bytes per real component
-    on a bf16/f16 wire (so c64/c128 split-complex packs carry 4)."""
+    """PAYLOAD wire bytes per exchanged logical element: the dtype's
+    own itemsize at full precision, 2 bytes per real component on a
+    bf16/f16 wire (so c64/c128 split-complex packs carry 4), 1 byte
+    per real component on an fp8 wire (2 for complex).  fp8 totals
+    additionally carry the per-tile scale side payload —
+    :func:`wire_bytes` is the authoritative total; this is only the
+    per-element factor."""
     dt = np.dtype(dtype if dtype is not None else np.float32)
     if wire_dtype is None:
         return dt.itemsize
-    canonical_wire_dtype(wire_dtype)  # validate spelling
+    wire = canonical_wire_dtype(wire_dtype)  # validate spelling
     if dt.kind not in "fc":
         raise TypeError(
             f"wire_dtype={wire_dtype!r} needs an inexact payload dtype; "
             f"got {dt} (exact dtypes have no lossy wire form)")
-    return 4 if dt.kind == "c" else 2
+    per = 1 if wire in FP8_WIRE_DTYPES else 2
+    return 2 * per if dt.kind == "c" else per
 
 
-def wire_bytes(dtype, wire_dtype, shape: Sequence[int]) -> int:
+def wire_bytes(dtype, wire_dtype, shape: Sequence[int], *,
+               axes: Optional[Tuple[int, int]] = None) -> int:
     """Wire bytes of one exchanged operand of logical ``shape`` — the
     ONE byte-accounting definition ``transpose_cost``,
     ``collective_costs`` and ``routing.py`` share (they must never
-    re-derive ``itemsize`` independently)."""
+    re-derive ``itemsize`` independently).
+
+    On an fp8 wire the total is EXACT, scale side payload included:
+    the packed operand's tile axis carries ``n_t + 4*ceil(n_t/TILE)``
+    bytes per component per row (payload + f32 scales), so callers
+    must pass the exchange ``axes=(a, b)`` — the same geometry
+    :func:`pack` uses — or the accounting could not know which axis
+    the windows lie along."""
     elems = 1
     for n in shape:
         elems *= int(n)
-    return elems * wire_itemsize(dtype, wire_dtype)
+    w = wire_itemsize(dtype, wire_dtype)
+    wire = canonical_wire_dtype(wire_dtype)
+    if wire not in FP8_WIRE_DTYPES:
+        return elems * w
+    if axes is None:
+        raise ValueError(
+            f"wire_bytes on wire_dtype={wire!r} needs the exchange "
+            f"axes=(a, b) to derive the tile axis — fp8 byte "
+            f"accounting is exchange-geometry aware")
+    t, n_t, ntiles = _fp8_geometry(shape, int(axes[0]), int(axes[1]))
+    rows = elems // max(1, n_t)  # product of every non-tile extent
+    return rows * (n_t + 4 * ntiles) * w
 
 
 def cast_score_bytes(wire_nbytes: int, dtype, wire_dtype) -> int:
@@ -193,6 +434,9 @@ def cast_score_bytes(wire_nbytes: int, dtype, wire_dtype) -> int:
         return 0
     w = wire_itemsize(dtype, wire_dtype)
     full = np.dtype(dtype if dtype is not None else np.float32).itemsize
+    # on an fp8 wire this slightly overcounts elements (the scale side
+    # payload is ~1.6% of wire_nbytes) — acceptable for a weighted
+    # score term; wire_bytes stays the exact accounting.
     elems = wire_nbytes // max(1, w)
     return int(2 * elems * (full + w) * CAST_BYTES_WEIGHT)
 
@@ -205,8 +449,16 @@ def wire_rtol(wire_dtype, count: int) -> float:
     bound is ``eps/2`` (worst case all same-signed) with a small
     reduction-depth safety margin, NOT ``eps * count`` (the errors are
     already measured against ``abs_sum``, which scales with count).
-    Override: ``PENCILARRAYS_TPU_GUARD_WIRE_RTOL`` (see
-    ``engine/config.py``)."""
+    The fp8 formats add a SCALE-GRANULARITY term: per-tile scaling
+    fixes each window's absolute quantization grid at
+    ``amax * sub / FMAX`` (the scaled subnormal spacing), so elements
+    far below their window's max-abs flush toward zero with an
+    absolute error the eps model does not see.  Worst case the window
+    sum carries ``TILE`` such flushes against an abs-sum of order
+    ``amax``, bounding the extra relative error by
+    ``TILE * sub / (2 * FMAX)`` — e4m3 @ TILE=256 adds ~5.6e-4 on top
+    of its eps/2 = 6.25e-2.  Override:
+    ``PENCILARRAYS_TPU_GUARD_WIRE_RTOL`` (see ``engine/config.py``)."""
     if wire_dtype is None:
         return 0.0
     from ..engine import config as _rtc
@@ -214,5 +466,8 @@ def wire_rtol(wire_dtype, count: int) -> float:
     override = _rtc.current().guard_wire_rtol
     if override is not None:
         return override
-    eps = _WIRE_EPS[canonical_wire_dtype(wire_dtype)]
-    return 0.5 * eps * (1.0 + 0.25 * math.log2(max(2, count)))
+    wire = canonical_wire_dtype(wire_dtype)
+    base = 0.5 * _WIRE_EPS[wire]
+    if wire in FP8_WIRE_DTYPES:
+        base += FP8_TILE * _FP8_SUB[wire] / (2.0 * _FP8_FMAX[wire])
+    return base * (1.0 + 0.25 * math.log2(max(2, count)))

@@ -13,11 +13,14 @@ logical tenants, executed on one resident mesh.
 * :class:`AdmissionQueue` / :class:`TenantQuota` / :class:`Ticket` —
   the scheduling core and the client-side future;
 * the overload-survival plane: :class:`SLO` (per-tenant deadlines +
-  shed priorities, enforced at admission/take/completion),
-  :class:`PressurePolicy` + the hysteretic load-shedding gate
-  (``serve/shed.py``), and the :class:`Autoscaler` closing the
-  serve↔elastic loop (grow/shrink the mesh from the queue's own load
-  projection — ``serve/autoscale.py``);
+  shed priorities + the PR-19 ``max_rel_l2`` accuracy budget, enforced
+  at admission/take/completion), :class:`PressurePolicy` + the
+  hysteretic load-shedding gate (``serve/shed.py``) with its
+  precision-downgrade rung (``serve/precision.py``: sheddable traffic
+  served on a cheaper wire — full -> bf16 -> fp8 — inside each
+  tenant's calibrated error envelope, instead of shed), and the
+  :class:`Autoscaler` closing the serve↔elastic loop (grow/shrink the
+  mesh from the queue's own load projection — ``serve/autoscale.py``);
 * typed errors: :class:`ServeError`, :class:`AdmissionError`,
   :class:`DeadlineError`, :class:`StaleRequestError`,
   :class:`ServiceClosedError`.
@@ -34,6 +37,11 @@ from .errors import (  # noqa: F401
     ServeError,
     ServiceClosedError,
     StaleRequestError,
+)
+from .precision import (  # noqa: F401
+    PRECISION_LADDER,
+    select_rung,
+    wire_error_envelope,
 )
 from .queue import AdmissionQueue, Batch, TenantQuota, Ticket  # noqa: F401
 from .registry import PlanRegistry  # noqa: F401
@@ -52,6 +60,9 @@ __all__ = [
     "LoadTracker",
     "PressurePolicy",
     "PressureGate",
+    "PRECISION_LADDER",
+    "select_rung",
+    "wire_error_envelope",
     "Autoscaler",
     "AutoscalePolicy",
     "ScaleDecision",

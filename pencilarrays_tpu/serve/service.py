@@ -158,8 +158,13 @@ class PlanService:
     pressure:
         A :class:`~pencilarrays_tpu.serve.shed.PressurePolicy` arming
         the load-shedding gate (water marks on the projected queue
-        drain time).  ``None`` (default): no shedding, PR-10 admission
-        semantics.
+        drain time).  With ``degrade_water_s`` set, the gate's first
+        rung serves sheddable traffic on a cheaper wire precision
+        (full -> bf16 -> fp8) inside each tenant's declared
+        ``SLO.max_rel_l2`` envelope instead of shedding it
+        (``serve/precision.py``; every applied downgrade journals a
+        fsync-critical ``serve.precision`` record, schema v7).
+        ``None`` (default): no shedding, PR-10 admission semantics.
     burn:
         A :class:`~pencilarrays_tpu.serve.slo.BurnRateMonitor` for
         per-tenant SLO error-budget burn tracking (default: one with
@@ -562,23 +567,33 @@ class PlanService:
     # -- SLO / pressure enforcement ----------------------------------------
     def _enforce_slo(self, entry: _Entry) -> None:
         """The admission enforcement point (raises typed): feed the
-        pressure gate, evict under its second rung, shed sheddable
-        priorities, and reject requests whose projected wait already
-        busts their deadline.  A no-SLO no-pressure service returns on
-        the first line — the disabled path does no pricing at all."""
+        pressure gate, downgrade wire precision under its first rung
+        (PR 19 — a sheddable tenant with an ``SLO.max_rel_l2`` budget
+        is SERVED on a cheaper wire instead of rejected), evict under
+        its last rung, shed sheddable priorities, and reject requests
+        whose projected wait already busts their deadline.  A no-SLO
+        no-pressure service returns on the first line — the disabled
+        path does no pricing at all."""
         if not self._slo_armed:
             return
         t = entry.ticket.tenant
-        entry.cost_bytes = self.queue.entry_cost(entry)
-        load = self.queue.load
         if self._gate is not None:
             self._feed_gate()
-            if self._gate.sheds(entry.shed_priority, self._protected):
+            degraded = (
+                self._gate.degrades(entry.shed_priority, self._protected)
+                and self._maybe_degrade(entry))
+            if not degraded and self._gate.sheds(
+                    entry.shed_priority, self._protected):
                 raise AdmissionError(
                     f"tenant {t!r}: shed under load (priority "
                     f"{entry.shed_priority} below the protected tier "
                     f"{self._protected}, gate {self._gate.state!r})",
                     tenant=t, reason="shed")
+        # priced AFTER any downgrade: the projection must charge the
+        # wire the request will actually move, or the autoscaler and
+        # the gate would keep seeing the full-precision queue
+        entry.cost_bytes = self.queue.entry_cost(entry)
+        load = self.queue.load
         if entry.deadline is not None:
             projected = load.projected_wait_s()
             budget = entry.deadline - entry.ticket.t_submit
@@ -592,6 +607,56 @@ class PlanService:
                     f"admission, not answered late", tenant=t,
                     reason="projected", deadline_s=budget,
                     projected_s=projected)
+
+    def _maybe_degrade(self, entry: _Entry) -> bool:
+        """The precision-downgrade rung (PR 19): swap a sheddable fft
+        entry onto the deepest wire-precision plan variant whose
+        CALIBRATED error envelope (``serve/precision.py``,
+        ``BENCH_WIRE.json``) fits under the tenant's declared
+        ``SLO.max_rel_l2``.  Returns True when a downgrade was applied
+        — the caller then skips the shed rung: served degraded beats
+        shed.
+
+        The swap happens BEFORE the entry is priced or queued: the
+        coalesce key is rebuilt from the variant's ``plan_key()`` (wire
+        dtype is part of schedule identity, so full/bf16/fp8 traffic
+        can never coalesce into one batch), the registry holds the
+        variant's own compiled executable, and the load projection
+        charges the cheaper wire.  Tenants with no ``max_rel_l2`` —
+        and reshard traffic, which has no per-precision plan variants —
+        fall through untouched to the shed rung.  (An elastic
+        reformation re-binds named-plan entries to the rebuilt FULL
+        plan: a degraded-then-reformed request is served at better
+        precision than promised, never worse.)"""
+        from .. import obs
+        from .precision import select_rung
+
+        if entry.plan is None or entry.ticket.kind != "fft":
+            return False
+        t = entry.ticket.tenant
+        slo = self._slos.get(t)
+        if slo is None or slo.max_rel_l2 is None:
+            return False
+        rung = select_rung(slo.max_rel_l2, entry.plan.wire_dtype)
+        if rung is None:
+            return False
+        wire, envelope = rung
+        wire_from = entry.plan.wire_dtype or "full"
+        plan = self.registry.register(entry.plan.with_wire_dtype(wire))
+        entry.plan = plan
+        entry.ticket.key = f"fft:{plan.plan_key()}:{entry.direction}"
+        if obs.enabled():
+            obs.counter("serve.degraded", tenant=t, wire=wire).inc()
+            # fsync-critical: a precision decision changes the answer a
+            # client receives — it must survive a crash, like the shed
+            # and burn-alert records it sits between
+            obs.record_event(
+                "serve.precision", _fsync=True, tenant=t,
+                req=entry.ticket.id, key=entry.ticket.key,
+                trace=entry.trace, wire_from=wire_from, wire_to=wire,
+                envelope=envelope, max_rel_l2=slo.max_rel_l2,
+                gate=self._gate.state)
+        return True
 
     def _slo_maintenance(self) -> None:
         """The take-side enforcement: re-feed the gate (pressure can

@@ -9,6 +9,12 @@ The pressure gate makes the sacrifice explicit, ordered, and journaled:
 * the gate watches the ONE load projection
   (:class:`~pencilarrays_tpu.serve.slo.LoadTracker`): the projected
   **queue drain time** in the router's bytes-equivalent currency;
+* one rung BEFORE shedding (``degrade_water_s``, PR 19, opt-in): the
+  gate enters ``degrade`` — sheddable-tier requests from tenants that
+  declared an accuracy budget (:class:`~pencilarrays_tpu.serve.slo.
+  SLO.max_rel_l2`) are still served, on a cheaper wire precision
+  (full -> bf16 -> fp8) within that budget; served degraded beats
+  shed, and tenants without a budget fall through untouched;
 * when drain crosses ``high_water_s`` the gate enters ``shed``:
   requests from tenants below the protected priority tier (the highest
   ``shed_priority`` among registered SLOs) are rejected typed at
@@ -49,11 +55,16 @@ class PressurePolicy:
     """The gate's water marks (seconds of projected queue drain).
 
     ``low_water_s < high_water_s <= evict_water_s`` is enforced;
-    ``evict_water_s=None`` defaults to ``2 x high_water_s``."""
+    ``evict_water_s=None`` defaults to ``2 x high_water_s``.
+    ``degrade_water_s`` (PR 19, optional) arms the precision-downgrade
+    rung strictly between the hysteresis band's low mark and the shed
+    mark: ``low_water_s < degrade_water_s < high_water_s``.  ``None``
+    (default) keeps the PR-15 three-state machine bit-for-bit."""
 
     high_water_s: float = 1.0
     low_water_s: float = 0.5
     evict_water_s: Optional[float] = None
+    degrade_water_s: Optional[float] = None
 
     def __post_init__(self):
         if self.high_water_s <= 0:
@@ -68,6 +79,14 @@ class PressurePolicy:
             raise ValueError(
                 f"evict_water_s ({evict}) below high_water_s "
                 f"({self.high_water_s}): the evict rung is an escalation")
+        deg = self.degrade_water_s
+        if deg is not None and not (
+                self.low_water_s < deg < self.high_water_s):
+            raise ValueError(
+                f"degrade_water_s ({deg}) must sit strictly inside the "
+                f"hysteresis band (low_water_s={self.low_water_s}, "
+                f"high_water_s={self.high_water_s}): the downgrade rung "
+                f"fires BEFORE shedding and recovers with it")
 
     @property
     def evict_at(self) -> float:
@@ -78,12 +97,13 @@ class PressurePolicy:
 class PressureGate:
     """The hysteretic overload state machine (module docstring).
 
-    States: ``ok`` -> ``shed`` (reject sheddable at submit) ->
+    States: ``ok`` -> ``degrade`` (serve sheddable on a cheaper wire
+    precision, when armed) -> ``shed`` (reject sheddable at submit) ->
     ``evict`` (also evict queued sheddable); back to ``ok`` only below
     the low water mark.  Thread-safe; :meth:`update` is called with a
     fresh drain projection on every admission and every take."""
 
-    STATES = ("ok", "shed", "evict")
+    STATES = ("ok", "degrade", "shed", "evict")
 
     def __init__(self, policy: Optional[PressurePolicy] = None):
         self.policy = policy or PressurePolicy()
@@ -123,6 +143,15 @@ class PressureGate:
                 # shed happens here too (the evict rung fired, queued
                 # sheddable work is gone, drain fell between the marks)
                 nxt = "shed"
+            elif (p.degrade_water_s is not None
+                  and drain_s >= p.degrade_water_s):
+                # the downgrade rung: an open gate escalates to
+                # "degrade"; a gate already shedding HOLDS (shed
+                # recovers through the full hysteresis at low water,
+                # not at the degrade mark — no shed/degrade flap) and
+                # evict de-escalates one rung (drain provably < high)
+                nxt = ("degrade" if prev == "ok"
+                       else "shed" if prev == "evict" else prev)
             elif drain_s <= p.low_water_s:
                 # at-or-below low water recovers: a fully-drained queue
                 # projects EXACTLY 0.0, which must reopen a gate even
@@ -162,7 +191,20 @@ class PressureGate:
         """Would the gate reject a request of ``shed_priority`` right
         now?  Sheddable = strictly below the protected tier (the
         highest registered priority — with one uniform tier nothing is
-        ever shed)."""
+        ever shed).  The ``degrade`` state does NOT shed: its whole
+        point is serving sheddable traffic (cheaper) instead."""
+        if shed_priority >= protected_priority:
+            return False
+        return self.state in ("shed", "evict")
+
+    def degrades(self, shed_priority: int,
+                 protected_priority: int) -> bool:
+        """Would the gate downgrade a request of ``shed_priority`` to a
+        cheaper wire precision right now?  Same sheddability rule as
+        :meth:`sheds`; true in EVERY pressure state — under ``shed`` /
+        ``evict`` the downgrade rung is what keeps a budget-declaring
+        tenant (:class:`~pencilarrays_tpu.serve.slo.SLO.max_rel_l2`)
+        served where a budget-less one is rejected."""
         if shed_priority >= protected_priority:
             return False
         return self.state != "ok"

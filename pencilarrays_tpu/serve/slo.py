@@ -94,11 +94,22 @@ class SLO:
         priority are never shed (see
         :class:`~pencilarrays_tpu.serve.shed.PressureGate`).  Default 0
         — an SLO-less tenant is maximally sheddable.
+    max_rel_l2:
+        Accuracy floor for the precision-downgrade rung (PR 19): the
+        worst relative l2 error this tenant tolerates on a served
+        result.  Under ``degrade`` pressure the service may swap a
+        sheddable tenant's plan to a cheaper wire precision, but only
+        onto rungs whose *calibrated* error envelope
+        (``BENCH_WIRE.json``) fits under this bound — served degraded
+        beats shed, but never silently out of tolerance.  ``None``
+        (default): the tenant opted out; its requests are never
+        downgraded (and so reach the shed rung first under pressure).
     """
 
     deadline_s: Optional[float] = None
     p99_budget_s: Optional[float] = None
     shed_priority: int = 0
+    max_rel_l2: Optional[float] = None
 
     def __post_init__(self):
         if self.deadline_s is not None and self.deadline_s <= 0:
@@ -107,6 +118,9 @@ class SLO:
         if self.p99_budget_s is not None and self.p99_budget_s <= 0:
             raise ValueError(
                 f"p99_budget_s must be positive, got {self.p99_budget_s}")
+        if self.max_rel_l2 is not None and self.max_rel_l2 <= 0:
+            raise ValueError(
+                f"max_rel_l2 must be positive, got {self.max_rel_l2}")
 
 
 class LoadTracker:

@@ -13,6 +13,15 @@ jaxlib builds (this container ships 0.4.37) where:
 Import :func:`shard_map` / :data:`AxisType` from here instead of from
 ``jax`` so every call site stays version-agnostic.  The shims resolve at
 import time — zero per-call overhead.
+
+PR 19 adds :func:`wire_fp8_dtype` — gated resolution of the fp8 wire
+element types (``float8_e4m3fn`` / ``float8_e5m2``).  The pinned jax
+ships them on ``jax.numpy``; older builds fall back to ``ml_dtypes``
+(jaxlib's own dtype-extension dependency, so present wherever jaxlib
+is); a build with neither raises a typed :class:`WireDtypeError`
+naming the missing dtype AT PLAN CONSTRUCTION — an fp8 wire the
+backend cannot represent must fail before any collective is traced,
+not mid-dispatch.
 """
 
 from __future__ import annotations
@@ -22,7 +31,7 @@ import os
 import jax
 
 __all__ = ["shard_map", "AxisType", "configure_compilation_cache",
-           "COMPILE_CACHE_VAR"]
+           "COMPILE_CACHE_VAR", "wire_fp8_dtype", "WireDtypeError"]
 
 COMPILE_CACHE_VAR = "PENCILARRAYS_TPU_COMPILE_CACHE"
 
@@ -50,6 +59,60 @@ def configure_compilation_cache(env_var: str = COMPILE_CACHE_VAR):
         except Exception:
             pass  # threshold knobs vary by version; the dir is what matters
     return os.path.abspath(d)
+
+class WireDtypeError(TypeError):
+    """A requested wire element type does not exist on this jax build.
+
+    Raised by :func:`wire_fp8_dtype` when neither ``jax.numpy`` nor
+    ``ml_dtypes`` provides the fp8 class — typed so plan construction
+    can fail fast and name exactly what is missing."""
+
+    def __init__(self, message: str, *, dtype_name: str):
+        super().__init__(message)
+        self.dtype_name = dtype_name
+
+
+# canonical wire spelling -> the class name both jax.numpy and ml_dtypes
+# use for it.  e4m3 is the "fn" (finite-only) variant everywhere that
+# matters: it has NO inf — overflow and inf both land on NaN — which the
+# pack path's finite-masked amax is designed around (parallel/wire.py).
+_FP8_CLASS_NAMES = {
+    "fp8_e4m3": "float8_e4m3fn",
+    "fp8_e5m2": "float8_e5m2",
+}
+
+
+def wire_fp8_dtype(name: str):
+    """Resolve a canonical fp8 wire spelling (``"fp8_e4m3"`` /
+    ``"fp8_e5m2"``) to its element type class, preferring ``jax.numpy``
+    (the pinned 0.4.37 ships both) and falling back to ``ml_dtypes``.
+    Raises :class:`WireDtypeError` naming the missing class when
+    neither has it, so ``canonical_wire_dtype`` accepts fp8 spellings
+    portably across jax builds without an unconditional import."""
+    cls = _FP8_CLASS_NAMES.get(name)
+    if cls is None:
+        raise ValueError(
+            f"not an fp8 wire dtype: {name!r} "
+            f"(expected one of {tuple(_FP8_CLASS_NAMES)})")
+    import jax.numpy as jnp
+
+    dt = getattr(jnp, cls, None)
+    if dt is not None:
+        return dt
+    try:  # jaxlib depends on ml_dtypes, so this is the natural fallback
+        import ml_dtypes
+
+        dt = getattr(ml_dtypes, cls, None)
+    except ImportError:
+        dt = None
+    if dt is None:
+        raise WireDtypeError(
+            f"wire_dtype={name!r} needs the {cls!r} element type, but "
+            f"neither jax.numpy nor ml_dtypes provides it on this build "
+            f"— upgrade jax/ml_dtypes or drop to a 16-bit wire",
+            dtype_name=cls)
+    return dt
+
 
 try:  # modern surface: jax.sharding.AxisType (Auto/Explicit/Manual)
     from jax.sharding import AxisType  # type: ignore

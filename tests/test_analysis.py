@@ -667,6 +667,70 @@ def test_lint_trace_ctx_dispatch_meta_key(tmp_path):
     assert [f for f in lint_tree(root2) if f.check == "trace-ctx"] == []
 
 
+def test_lint_fp8_wire_casts_package_wide(tmp_path):
+    """The fp8/u8 family rule (PR 19) is package-WIDE, not confined to
+    the transpose modules: ``bitcast_convert_type`` (attribute or bare
+    name) and fp8/u8-targeted ``.astype`` anywhere outside
+    ``parallel/wire.py`` are findings; wire.py itself is exempt, and a
+    vanilla f32 ``.astype`` elsewhere is not the fp8 rule's business."""
+    rogue = """
+        import jax
+        import jax.numpy as jnp
+        from jax.lax import bitcast_convert_type
+
+        def homebrew_pack(x):
+            q = x.astype(jnp.float8_e4m3fn)
+            return jax.lax.bitcast_convert_type(q, jnp.uint8)
+
+        def homebrew_scales(s):
+            return bitcast_convert_type(s, jnp.uint8)
+
+        def string_spelling(x):
+            return x.astype("float8_e5m2")
+        """
+    sanctioned = """
+        import jax
+        import jax.numpy as jnp
+
+        def _pack_fp8(x):
+            q = x.astype(jnp.float8_e4m3fn)
+            return jax.lax.bitcast_convert_type(q, jnp.uint8)
+        """
+    benign = """
+        import jax.numpy as jnp
+
+        def widen(x):
+            return x.astype(jnp.float32)
+        """
+    root = _fixture_repo(tmp_path, [
+        ("pencilarrays_tpu/ops/quant.py", rogue),
+        ("pencilarrays_tpu/parallel/wire.py", sanctioned),
+        ("pencilarrays_tpu/io/benign.py", benign)])
+    found = sorted(f.ident for f in lint_tree(root)
+                   if f.check == "wire-cast")
+    # homebrew_pack fires TWICE (the astype and the bitcast), each
+    # line a separate finding; wire.py and the f32 cast are silent
+    assert found == ["ops.quant.homebrew_pack",
+                     "ops.quant.homebrew_pack",
+                     "ops.quant.homebrew_scales",
+                     "ops.quant.string_spelling"]
+
+    # the grandfather allowlist is empty ON PURPOSE — no site in the
+    # package needs it, and this assertion keeps it that way
+    from pencilarrays_tpu.analysis.lint import WIRE_CAST_ALLOWLIST
+    assert WIRE_CAST_ALLOWLIST == ()
+
+    # the standard justified-allowlist machinery still applies for a
+    # downstream fork mid-migration
+    allow = _write(root, "pa-lint.allow", """
+        wire-cast ops.quant.homebrew_pack  # migration, tracked
+        wire-cast ops.quant.homebrew_scales  # migration, tracked
+        wire-cast ops.quant.string_spelling  # migration, tracked
+        """)
+    findings, _ = run_lint(root, Allowlist.load(allow))
+    assert [f for f in findings if f.check == "wire-cast"] == []
+
+
 def test_allowlist_roundtrip(tmp_path):
     """Allowlist round-trip: a justified entry suppresses its finding,
     stale entries are reported unused, unjustified/malformed lines are

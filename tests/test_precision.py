@@ -1,0 +1,309 @@
+"""Precision as a runtime serving lever (ISSUE 19): the pressure
+gate's degrade rung, calibrated-envelope rung selection, admission-time
+plan-variant swapping, and the ``serve.precision`` journal contract.
+
+Boundary contracts under test:
+
+* the four-state gate ladder is hysteretic and flap-free: shed never
+  de-escalates at the degrade mark, recovery is only at low water;
+* ``degrades()`` is true in every pressure state — under ``shed`` the
+  rung is what keeps a ``max_rel_l2`` tenant SERVED where a budget-less
+  one is rejected typed;
+* rung selection is envelope-driven: a budget below every calibrated
+  envelope downgrades nothing, a generous one lands on fp8, and a plan
+  already at its floor is left alone;
+* degraded traffic NEVER coalesces with full-precision traffic (the
+  coalesce key is rebuilt from the variant's ``plan_key``) and the
+  registry holds per-precision compiled executables;
+* every applied downgrade journals one fsync-critical
+  ``serve.precision`` record (schema v7) carrying the promised
+  envelope and the budget it fit under;
+* with no ``max_rel_l2`` declared (or no ``degrade_water_s`` armed),
+  behavior is the PR-18 gate bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+import pencilarrays_tpu as pa
+from pencilarrays_tpu import obs
+from pencilarrays_tpu.obs import events as obs_events
+from pencilarrays_tpu.obs import metrics as obs_metrics
+from pencilarrays_tpu.ops.fft import PencilFFTPlan
+from pencilarrays_tpu.serve import (
+    SLO,
+    AdmissionError,
+    PlanService,
+    PressurePolicy,
+    select_rung,
+    wire_error_envelope,
+)
+from pencilarrays_tpu.serve.shed import PressureGate
+
+pytestmark = pytest.mark.usefixtures("devices")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(obs.ENV_VAR, raising=False)
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    yield
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+
+
+def _host(rng, shape):
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape)).astype(np.complex64)
+
+
+# ---------------------------------------------------------------------------
+# policy + gate ladder
+# ---------------------------------------------------------------------------
+
+
+def test_degrade_policy_validation():
+    PressurePolicy(high_water_s=1.0, low_water_s=0.1, degrade_water_s=0.5)
+    with pytest.raises(ValueError):        # at/above high water
+        PressurePolicy(high_water_s=1.0, low_water_s=0.1,
+                       degrade_water_s=1.0)
+    with pytest.raises(ValueError):        # at/below low water
+        PressurePolicy(high_water_s=1.0, low_water_s=0.5,
+                       degrade_water_s=0.5)
+
+
+def test_gate_four_state_ladder_hysteresis():
+    g = PressureGate(PressurePolicy(high_water_s=1.0, low_water_s=0.1,
+                                    degrade_water_s=0.5))
+    assert g.state == "ok"
+    assert g.update(0.3) == "ok"           # below degrade: still open
+    assert g.update(0.6) == "degrade"      # degrade mark crossed
+    assert g.update(0.3) == "degrade"      # hysteresis band holds
+    assert g.update(1.5) == "shed"
+    assert g.update(0.7) == "shed"         # shed HOLDS at the degrade
+    assert g.update(0.3) == "shed"         # band — no shed/degrade flap
+    assert g.update(0.05) == "ok"          # recovery only at low water
+    assert g.update(2.5) == "evict"
+    assert g.update(0.7) == "shed"         # evict de-escalates one rung
+    assert g.update(0.05) == "ok"
+    # escalation straight from ok to evict is immediate
+    assert g.update(9.9) == "evict"
+
+
+def test_gate_without_degrade_mark_is_three_state():
+    """degrade_water_s=None keeps the PR-15 machine bit-for-bit."""
+    g = PressureGate(PressurePolicy(high_water_s=1.0, low_water_s=0.5))
+    assert g.update(0.9) == "ok"           # the whole band holds open
+    assert g.update(1.2) == "shed"
+    assert g.update(0.9) == "shed"
+    assert g.update(0.5) == "ok"
+    assert g.transitions == 2              # storm -> recover, exactly two
+
+
+def test_degrades_vs_sheds_predicates():
+    g = PressureGate(PressurePolicy(high_water_s=1.0, low_water_s=0.1,
+                                    degrade_water_s=0.5))
+    g.update(0.6)                          # -> degrade
+    assert g.degrades(0, 1) and not g.sheds(0, 1)
+    assert not g.degrades(1, 1)            # protected tier: never
+    g.update(1.5)                          # -> shed
+    assert g.degrades(0, 1) and g.sheds(0, 1)
+    g.update(2.5)                          # -> evict
+    assert g.degrades(0, 1) and g.sheds(0, 1) and g.evicting()
+
+
+# ---------------------------------------------------------------------------
+# calibrated envelopes + rung selection
+# ---------------------------------------------------------------------------
+
+
+def test_wire_error_envelope_reads_artifact(tmp_path, monkeypatch):
+    import json
+
+    doc = {"workload_x": {"bf16": {"rel_err_l2": 0.002},
+                          "fp8_e4m3": {"rel_err_l2": 0.03}},
+           "workload_y": {"fp8_e4m3": {"rel_err_l2": 0.02}}}
+    p = tmp_path / "BENCH_WIRE.json"
+    p.write_text(json.dumps(doc))
+    monkeypatch.setenv("PENCILARRAYS_TPU_BENCH_WIRE_PATH", str(p))
+    # 2x the worst rel_err_l2 recorded anywhere for the format
+    assert wire_error_envelope("fp8_e4m3") == pytest.approx(0.06)
+    assert wire_error_envelope("bf16") == pytest.approx(0.004)
+    # a format the artifact has no numbers for: conservative fallback
+    assert wire_error_envelope("fp8_e5m2") == pytest.approx(0.16)
+
+
+def test_select_rung_is_envelope_driven(tmp_path, monkeypatch):
+    import json
+
+    p = tmp_path / "BENCH_WIRE.json"
+    p.write_text(json.dumps({
+        "w": {"bf16": {"rel_err_l2": 0.002},
+              "fp8_e4m3": {"rel_err_l2": 0.03}}}))
+    monkeypatch.setenv("PENCILARRAYS_TPU_BENCH_WIRE_PATH", str(p))
+    assert select_rung(1e-5) is None                   # too tight
+    assert select_rung(0.01) == ("bf16", pytest.approx(0.004))
+    assert select_rung(0.5) == ("fp8_e4m3", pytest.approx(0.06))
+    # deepest-admissible from a 16-bit floor; None at the fp8 floor
+    assert select_rung(0.5, "bf16")[0] == "fp8_e4m3"
+    assert select_rung(0.01, "bf16") is None
+    assert select_rung(0.5, "fp8_e4m3") is None
+
+
+# ---------------------------------------------------------------------------
+# the serving lever end to end
+# ---------------------------------------------------------------------------
+
+
+def _degrade_service(plan, **slos):
+    svc = PlanService(
+        max_batch=4, max_wait_s=60.0, slos=dict(slos),
+        pressure=PressurePolicy(high_water_s=1.0, low_water_s=0.1,
+                                degrade_water_s=0.5))
+    # pin the forced gate state: the live drain projection of a test
+    # queue would recover to "ok" between submissions
+    svc._gate.update = lambda *a, **k: svc._gate._state
+    return svc
+
+
+def test_degrade_rung_serves_within_budget(devices, tmp_path):
+    obs.enable(str(tmp_path))
+    topo = pa.Topology((2, 4))
+    plan = PencilFFTPlan(topo, (16, 12, 20), dtype=np.complex64)
+    svc = _degrade_service(
+        plan,
+        gold=SLO(shed_priority=2),
+        flex=SLO(shed_priority=0, max_rel_l2=0.5),
+        rigid=SLO(shed_priority=0))
+    svc._gate._state = "degrade"
+    rng = np.random.default_rng(0)
+    u = _host(rng, (16, 12, 20))
+    t_gold = svc.submit("gold", u, plan=plan)
+    t_flex = svc.submit("flex", u, plan=plan)
+    t_rigid = svc.submit("rigid", u, plan=plan)
+    # protected + no-budget tenants keep the full-precision key; the
+    # budget tenant moved to its own (never-coalescing) variant key
+    assert t_gold.key == f"fft:{plan.plan_key()}:forward"
+    assert t_rigid.key == t_gold.key
+    assert t_flex.key != t_gold.key
+    svc.drain()
+    ref = np.fft.fftn(u)
+    r_gold = np.asarray(t_gold.result(30).logical())
+    r_flex = np.asarray(t_flex.result(30).logical())
+    rel_flex = np.linalg.norm(r_flex - ref) / np.linalg.norm(ref)
+    rel_gold = np.linalg.norm(r_gold - ref) / np.linalg.norm(ref)
+    assert rel_gold < 1e-5                 # full precision untouched
+    assert 1e-4 < rel_flex < 0.5           # degraded, inside budget
+    # the registry holds BOTH compiled variants, keyed apart
+    keys = svc.registry.keys()
+    assert t_gold.key.split(":")[1] in keys
+    assert t_flex.key.split(":")[1] in keys
+    # journal: one fsync-critical serve.precision record, schema v7
+    svc.close()
+    obs.disable()
+    evs = obs_events.read_journal(str(tmp_path))
+    prec = [e for e in evs if e["ev"] == "serve.precision"]
+    assert len(prec) == 1
+    rec = prec[0]
+    assert rec["v"] >= 7
+    assert rec["tenant"] == "flex"
+    assert rec["wire_from"] == "full"
+    assert rec["wire_to"] in ("bf16", "fp8_e4m3")
+    assert rec["envelope"] <= rec["max_rel_l2"] == 0.5
+    assert rec["trace"] and rec["gate"] == "degrade"
+    from pencilarrays_tpu.obs.schema import lint_journal
+    assert lint_journal(evs) == []
+    # the request-flow join: the degraded request's trace reaches its
+    # serve.request record too (pa-obs request reconstructs the path)
+    reqs = [e for e in evs if e["ev"] == "serve.request"
+            and e.get("trace") == rec["trace"]]
+    assert len(reqs) == 1 and reqs[0]["tenant"] == "flex"
+
+
+def test_shed_state_serves_budget_tenant_sheds_rest(devices):
+    topo = pa.Topology((2, 4))
+    plan = PencilFFTPlan(topo, (16, 12, 20), dtype=np.complex64)
+    svc = _degrade_service(
+        plan,
+        gold=SLO(shed_priority=2),
+        flex=SLO(shed_priority=0, max_rel_l2=0.5),
+        rigid=SLO(shed_priority=0))
+    svc._gate._state = "shed"
+    rng = np.random.default_rng(1)
+    u = _host(rng, (16, 12, 20))
+    t_gold = svc.submit("gold", u, plan=plan)      # protected: served
+    t_flex = svc.submit("flex", u, plan=plan)      # degraded: served
+    with pytest.raises(AdmissionError) as ei:      # budget-less: shed
+        svc.submit("rigid", u, plan=plan)
+    assert ei.value.reason == "shed"
+    svc.drain()
+    assert t_gold.result(30) is not None
+    assert t_flex.result(30) is not None
+    svc.close()
+
+
+def test_degraded_traffic_never_coalesces_with_full(devices):
+    """Two same-plan requests, one degraded: they must form TWO
+    batches (precisions never mix inside one dispatch)."""
+    topo = pa.Topology((2, 4))
+    plan = PencilFFTPlan(topo, (16, 12, 20), dtype=np.complex64)
+    svc = _degrade_service(
+        plan,
+        gold=SLO(shed_priority=2),
+        flex=SLO(shed_priority=0, max_rel_l2=0.5))
+    rng = np.random.default_rng(2)
+    svc._gate._state = "ok"
+    t_a = svc.submit("gold", _host(rng, (16, 12, 20)), plan=plan)
+    svc._gate._state = "degrade"
+    t_b = svc.submit("flex", _host(rng, (16, 12, 20)), plan=plan)
+    assert t_a.key != t_b.key
+    batches = svc.queue.take_ready(flush=True)
+    assert svc.queue.take_ready(flush=True) == []
+    for b in batches:
+        svc._dispatch(b)
+    assert len(batches) == 2
+    assert {b.key for b in batches} == {t_a.key, t_b.key}
+    assert all(len(b.entries) == 1 for b in batches)
+    svc.close()
+
+
+def test_no_budget_no_degrade_is_pr18_behavior(devices):
+    """Without max_rel_l2 (or under an unarmed gate) nothing changes:
+    same keys, bit-identical results to a no-pressure service."""
+    topo = pa.Topology((2, 4))
+    plan = PencilFFTPlan(topo, (16, 12, 20), dtype=np.complex64)
+    rng = np.random.default_rng(3)
+    u = _host(rng, (16, 12, 20))
+    base = PlanService(max_batch=4, max_wait_s=60.0)
+    t0 = base.submit("t", u, plan=plan)
+    base.drain()
+    r0 = np.asarray(t0.result(30).logical())
+    base.close()
+    svc = _degrade_service(plan, t=SLO(shed_priority=0),
+                           gold=SLO(shed_priority=2))
+    svc._gate._state = "degrade"
+    t1 = svc.submit("t", u, plan=plan)
+    assert t1.key == t0.key
+    svc.drain()
+    r1 = np.asarray(t1.result(30).logical())
+    svc.close()
+    np.testing.assert_array_equal(r0, r1)
+
+
+def test_registry_compiled_variants_keyed_apart(devices):
+    topo = pa.Topology((2, 4))
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                         dtype=np.float32)
+    from pencilarrays_tpu.serve import PlanRegistry
+
+    reg = PlanRegistry()
+    reg.register(plan)
+    v = plan.with_wire_dtype("fp8_e4m3")
+    reg.register(v)
+    c_full = reg.compiled(plan, ())
+    c_fp8 = reg.compiled(v, ())
+    assert c_full is not c_fp8
+    # resolving again hits the SAME executables — per-precision caching
+    assert reg.compiled(plan, ()) is c_full
+    assert reg.compiled(v, ()) is c_fp8

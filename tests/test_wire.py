@@ -464,3 +464,274 @@ def test_measure_auto_downgrade_keeps_wire(topo):
     by_dims = {tuple(c["dims"]): c["predicted_bytes"] for c in f_score}
     for c in w_score:
         assert c["predicted_bytes"] * 2 == by_dims[tuple(c["dims"])]
+
+
+# ---------------------------------------------------------------------------
+# fp8 wire (ISSUE 19): per-tile scaling, quartered bytes, typed envelope
+# ---------------------------------------------------------------------------
+
+
+def test_canonical_fp8_spellings():
+    for spelling in ("fp8_e4m3", "e4m3", "float8_e4m3", "float8_e4m3fn",
+                     "fp8-e4m3"):
+        assert wire.canonical_wire_dtype(spelling) == "fp8_e4m3"
+    for spelling in ("fp8_e5m2", "e5m2", "float8_e5m2", "fp8-e5m2"):
+        assert wire.canonical_wire_dtype(spelling) == "fp8_e5m2"
+    # bare "fp8" stays ambiguous on purpose: the two formats trade
+    # mantissa for range and the caller must pick
+    with pytest.raises(ValueError):
+        wire.canonical_wire_dtype("fp8")
+    # the method spelling canonicalizes too (cache-key equality)
+    assert AllToAll(wire_dtype="e4m3") == AllToAll(wire_dtype="fp8_e4m3")
+
+
+def test_fp8_tile_axis_rule():
+    # largest extent NOT an exchange axis; ties break to lowest index
+    assert wire.fp8_tile_axis((16, 12, 20), 0, 1) == 2
+    assert wire.fp8_tile_axis((16, 12, 20), 1, 2) == 0
+    assert wire.fp8_tile_axis((16, 12, 20), 0, 2) == 1
+    assert wire.fp8_tile_axis((16, 12, 20, 7), 1, 2) == 0   # extra dim loses
+    assert wire.fp8_tile_axis((16, 12, 20, 64), 1, 2) == 3  # ...until bigger
+    assert wire.fp8_tile_axis((8, 8, 8), 0, 1) == 2
+    # a 2-D exchange operand has no free axis to tile along
+    with pytest.raises(ValueError, match="16-bit wire"):
+        wire.fp8_tile_axis((16, 12), 0, 1)
+
+
+@pytest.mark.parametrize("w", ["fp8_e4m3", "fp8_e5m2"])
+@pytest.mark.parametrize("shape,axes", [
+    ((16, 12, 20), (0, 1)),          # tile axis 2, one partial tile
+    ((4, 3, 300), (0, 1)),           # tile axis 2, 300 = 256 + 44
+    ((512, 3, 5), (1, 2)),           # tile axis 0, exactly 2 tiles
+    ((7, 5, 9, 6), (0, 2)),          # 4-D, tile axis 3? no: axis 3=6 < 9?
+])
+def test_fp8_pack_unpack_roundtrip_bound(w, shape, axes):
+    rng = np.random.default_rng(hash((w, shape)) % 2 ** 31)
+    # mixed magnitudes per tile stress the per-tile (not per-array)
+    # scaling: columns spanning 6 orders of magnitude still come back
+    # within the format's relative bound of their own tile max
+    x = (rng.standard_normal(shape)
+         * 10.0 ** rng.integers(-3, 3, size=shape)).astype(np.float32)
+    xj = jnp.asarray(x)
+    p = wire.pack(xj, w, axes=axes)
+    assert p.dtype == jnp.uint8
+    back = np.asarray(wire.unpack(p, xj.dtype, w, axes=axes,
+                                  orig_shape=shape))
+    t = wire.fp8_tile_axis(shape, *axes)
+    # per-tile relative bound: |err| <= eps/2 * tile_amax
+    eps = {"fp8_e4m3": 2.0 ** -3, "fp8_e5m2": 2.0 ** -2}[w]
+    amax = np.max(np.abs(np.moveaxis(x, t, -1)), axis=-1, keepdims=True)
+    err = np.max(np.abs(np.moveaxis(back - x, t, -1))
+                 / np.maximum(amax, 1e-30))
+    assert err <= 0.5 * eps * 1.001
+    assert err > 0  # it really quantized
+
+
+def test_fp8_pack_complex_roundtrip():
+    shape = (16, 12, 20)
+    rng = np.random.default_rng(11)
+    z = (rng.standard_normal(shape)
+         + 1j * rng.standard_normal(shape)).astype(np.complex64)
+    p = wire.pack(jnp.asarray(z), "fp8_e4m3", axes=(0, 1))
+    assert p.dtype == jnp.uint8
+    back = np.asarray(wire.unpack(p, jnp.complex64, "fp8_e4m3",
+                                  axes=(0, 1), orig_shape=shape))
+    assert back.dtype == np.complex64
+    rel = np.linalg.norm(back - z) / np.linalg.norm(z)
+    assert 0 < rel <= 0.5 * 2.0 ** -3
+
+
+def test_fp8_denormal_and_overflow_edges():
+    # values far below the tile max vanish (per-tile scale trades small
+    # values for range — the documented contract), but a tile made ONLY
+    # of tiny values gets its own scale and keeps them
+    shape = (1, 1, 256)
+    tiny = np.full(shape, 1e-30, dtype=np.float32)
+    back = np.asarray(wire.unpack(
+        wire.pack(jnp.asarray(tiny), "fp8_e4m3", axes=(0, 1)),
+        jnp.float32, "fp8_e4m3", axes=(0, 1), orig_shape=shape))
+    np.testing.assert_allclose(back, tiny, rtol=0.5 * 2.0 ** -3)
+    # huge finite values scale down and back up without overflow
+    huge = np.full(shape, 3e38, dtype=np.float32)
+    back = np.asarray(wire.unpack(
+        wire.pack(jnp.asarray(huge), "fp8_e4m3", axes=(0, 1)),
+        jnp.float32, "fp8_e4m3", axes=(0, 1), orig_shape=shape))
+    assert np.all(np.isfinite(back))
+    np.testing.assert_allclose(back, huge, rtol=0.5 * 2.0 ** -3)
+    # an all-zero tile keeps scale 1 and decodes to exact zeros
+    zero = np.zeros(shape, dtype=np.float32)
+    back = np.asarray(wire.unpack(
+        wire.pack(jnp.asarray(zero), "fp8_e4m3", axes=(0, 1)),
+        jnp.float32, "fp8_e4m3", axes=(0, 1), orig_shape=shape))
+    np.testing.assert_array_equal(back, zero)
+
+
+def test_fp8_nan_passthrough():
+    shape = (2, 1, 300)
+    x = np.random.default_rng(12).standard_normal(shape).astype(np.float32)
+    x[0, 0, 7] = np.nan
+    x[1, 0, 299] = np.nan
+    back = np.asarray(wire.unpack(
+        wire.pack(jnp.asarray(x), "fp8_e4m3", axes=(0, 1)),
+        jnp.float32, "fp8_e4m3", axes=(0, 1), orig_shape=shape))
+    assert np.isnan(back[0, 0, 7]) and np.isnan(back[1, 0, 299])
+    # the poisoned taps do NOT poison their tiles' scales: every other
+    # element still meets the quantization bound
+    finite = np.isfinite(x)
+    assert np.all(np.isfinite(back[finite]))
+    rel = np.max(np.abs((back - x)[finite]) / np.max(np.abs(x[finite])))
+    assert rel <= 0.5 * 2.0 ** -3
+
+
+def test_fp8_requires_axes():
+    x = jnp.ones((4, 4, 4))
+    with pytest.raises(ValueError):
+        wire.pack(x, "fp8_e4m3")
+    with pytest.raises(ValueError):
+        wire.wire_bytes(np.float32, "fp8_e4m3", (4, 4, 4))
+
+
+def test_fp8_wire_bytes_accounting():
+    # payload n_t bytes + 4 bytes of f32 scale per 256-tile, per row
+    assert wire.wire_itemsize(np.float32, "fp8_e4m3") == 1
+    assert wire.wire_itemsize(np.complex64, "fp8_e4m3") == 2
+    # (16, 12, 20) exchanged on (0, 1): tile axis 2 (n_t=20, 1 tile)
+    assert wire.wire_bytes(np.float32, "fp8_e4m3", (16, 12, 20),
+                           axes=(0, 1)) == 16 * 12 * (20 + 4)
+    # 300-long tile axis: 2 tiles -> 8 scale bytes per row
+    assert wire.wire_bytes(np.float32, "fp8_e5m2", (4, 3, 300),
+                           axes=(0, 1)) == 4 * 3 * (300 + 8)
+    # complex doubles both payload and scale planes
+    assert wire.wire_bytes(np.complex64, "fp8_e4m3", (16, 12, 20),
+                           axes=(0, 1)) == 2 * 16 * 12 * (20 + 4)
+    # asymptotically /4 vs f32: overhead is 4/256 of payload
+    big = wire.wire_bytes(np.float32, "fp8_e4m3", (8, 8, 4096),
+                          axes=(0, 1))
+    full = 8 * 8 * 4096 * 4
+    assert full / big == pytest.approx(4.0, rel=0.02)
+
+
+@pytest.mark.parametrize("method_fp8", [
+    AllToAll(wire_dtype="fp8_e4m3"), Ring(wire_dtype="fp8_e4m3"),
+    Pipelined(chunks=2, base=AllToAll(wire_dtype="fp8_e5m2"))])
+def test_fp8_priced_equals_measured_bytes(hop, method_fp8):
+    """THE fp8 acceptance pin: the compiled HLO's collective bytes
+    equal the prediction exactly — scales ride the SAME exchange."""
+    pin, pout = hop
+    for dt in (jnp.float32, jnp.complex64):
+        c = transpose_cost(pin, pout, (), dt, method_fp8)
+        measured = spmd.trace_transpose(pin, pout, (), dt,
+                                        method_fp8).stats()
+        assert measured == c
+
+
+def test_fp8_transpose_numerics_and_identity(hop):
+    pin, pout = hop
+    u = np.random.default_rng(13).standard_normal(
+        (16, 12, 20)).astype(np.float32)
+    x = PencilArray.from_global(pin, u)
+    for w, eps in (("fp8_e4m3", 2.0 ** -3), ("fp8_e5m2", 2.0 ** -2)):
+        got = np.asarray(gather(transpose(
+            x, pout, method=AllToAll(wire_dtype=w))))
+        assert 0 < np.max(np.abs(got - u)) <= 0.5 * eps * np.max(
+            np.abs(u)) * 1.001
+
+
+def test_fp8_plan_verifies_and_fingerprints(topo):
+    ref = PencilFFTPlan(topo, (16, 12, 10), real=True, dtype=jnp.float32)
+    w = PencilFFTPlan(topo, (16, 12, 10), real=True, dtype=jnp.float32,
+                      wire_dtype="fp8_e4m3")
+    assert w.wire_dtype == "fp8_e4m3"
+    spmd.verify_plan(w)
+    spmd.verify_plan(w, direction="backward")
+    assert w.plan_key() != ref.plan_key()
+    bf = PencilFFTPlan(topo, (16, 12, 10), real=True, dtype=jnp.float32,
+                       wire_dtype="bf16")
+    assert w.plan_key() != bf.plan_key()
+    # roundtrip accuracy within the fp8 tile-scaled model
+    host = np.random.default_rng(14).standard_normal(
+        (16, 12, 10)).astype(np.float32)
+    x = PencilArray.from_global(w.input_pencil, host)
+    back = np.asarray(gather(w.backward(w.forward(x))))
+    rel = np.linalg.norm(back - host) / np.linalg.norm(host)
+    assert 0 < rel <= 0.08
+
+
+def test_fp8_pipelined_plan_chunked_bytes_verify(topo):
+    """fp8 breaks chunk-count byte invariance (each chunk ships its own
+    scale plane): the pricer charges per-chunk honestly and the HLO pin
+    must still hold on the fused pipelined schedule."""
+    plan = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                         dtype=jnp.float32, wire_dtype="fp8_e4m3",
+                         pipeline=2)
+    spmd.verify_plan(plan)
+    spmd.verify_plan(plan, direction="backward")
+    k1 = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                       dtype=jnp.float32, wire_dtype="fp8_e4m3")
+    b2 = sum(v["bytes"] for v in plan.collective_costs().values())
+    b1 = sum(v["bytes"] for v in k1.collective_costs().values())
+    assert b2 > b1  # more chunks -> more scale planes, priced honestly
+
+
+def test_fp8_guard_envelope_and_typed_exceedance(hop, tmp_path):
+    pin, pout = hop
+    u = np.random.default_rng(15).standard_normal(
+        (16, 12, 20)).astype(np.float32)
+    x = PencilArray.from_global(pin, u)
+    with guard._forced("on", str(tmp_path)):
+        y = transpose(x, pout, method=AllToAll(wire_dtype="fp8_e4m3"))
+        np.testing.assert_allclose(np.asarray(gather(y)), u, atol=0.25)
+    # drift beyond the fp8 envelope raises typed WirePrecisionError
+    # (wire_rtol("fp8_e4m3", 1000) ~ 0.22 of the 1100 abs-sum ~ 242)
+    pre = np.array([100.0, 0.0, 1000.0, 0.0])
+    drift = np.array([400.0, 0.0, 1000.0, 0.0])
+    ok, kind = probes_match(pre, drift, 1000, np.float32,
+                            wire_dtype="fp8_e4m3")
+    assert (ok, kind) == (False, "wire")
+    with pytest.raises(WirePrecisionError) as ei:
+        check_hop_probes("hop", pre, drift, 1000, np.float32,
+                         wire_dtype="fp8_e4m3")
+    assert ei.value.wire_dtype == "fp8_e4m3"
+    # drift INSIDE the fp8 envelope (but outside bf16's ~7.5) passes
+    small = np.array([130.0, 0.0, 1000.0, 0.0])
+    assert probes_match(pre, small, 1000, np.float32,
+                        wire_dtype="fp8_e4m3")[0] is True
+    assert probes_match(pre, small, 1000, np.float32,
+                        wire_dtype="bf16")[0] is False
+
+
+def test_fp8_routed_reshard_verifies(topo):
+    from pencilarrays_tpu.parallel.routing import plan_reshard_route
+
+    pin = Pencil(topo, (16, 12, 20), (1, 2))
+    dest = Pencil(topo, (16, 12, 20), (0, 1))
+    route = plan_reshard_route(pin, dest, (), np.float32,
+                               method=AllToAll(wire_dtype="fp8_e4m3"))
+    assert route.hops
+    assert all(h.method.wire_dtype == "fp8_e4m3" for h in route.hops)
+    spmd.verify_route(route, (), np.float32)
+    u = np.random.default_rng(16).standard_normal(
+        (16, 12, 20)).astype(np.float32)
+    x = PencilArray.from_global(pin, u)
+    out = np.asarray(gather(reshard(
+        x, dest, method=AllToAll(wire_dtype="fp8_e4m3"))))
+    assert np.max(np.abs(out - u)) <= 0.5 * 2.0 ** -3 * np.max(
+        np.abs(u)) * 1.001
+
+
+def test_plan_with_wire_dtype_variants(topo):
+    full = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                         dtype=jnp.float32)
+    v = full.with_wire_dtype("fp8_e4m3")
+    direct = PencilFFTPlan(topo, (16, 12, 10), real=True,
+                           dtype=jnp.float32, wire_dtype="fp8_e4m3")
+    assert v.wire_dtype == "fp8_e4m3"
+    assert v.plan_key() == direct.plan_key()
+    assert v.plan_key() != full.plan_key()
+    # variant cache: same object back, and no-op for the current wire
+    assert full.with_wire_dtype("fp8_e4m3") is v
+    assert full.with_wire_dtype(None) is full
+    assert v.with_wire_dtype("e4m3") is v
+    # downgrading a bf16 plan reaches fp8, not a bf16-of-bf16
+    bf = full.with_wire_dtype("bf16")
+    assert bf.with_wire_dtype("fp8_e4m3").plan_key() == direct.plan_key()
