@@ -23,7 +23,17 @@ The ISSUE 17 measured-verdict artifact, three arms:
   shed must come back as a typed ``AdmissionError(reason="shed")``
   that crossed the KV wire and re-raised on the router side.  Reports
   shed precision/recall against the priority tiers and the protected
-  tenants' end-to-end fleet latency.
+  tenants' end-to-end fleet latency;
+* ``partition`` — the ISSUE 20 recovery pipeline decomposed: a
+  3-rank partition drill split into **detect** (the victim's lease
+  aging past ttl), **quorum round** (both survivors' quorum-gated
+  membership consensus to an agreed 2-rank generation), **fence
+  advance** (the new rank 0's CAS) and **fenced reject** (a zombie
+  write bouncing off the fence); the minority side's typed
+  ``QuorumLossError`` exit latency (bounded by the configured round
+  timeout, never a hang); and the router WAL priced both ways — the
+  fsync'd per-admission submit tax, and cold ``recover()`` replay
+  throughput over a storm's worth of committed records.
 
 CPU-mesh caveat: every arm exercises *coordination* mechanics —
 placement scoring, FileKV polling, lease expiry, wire codecs — which
@@ -50,12 +60,12 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 CPU_MESH_CAPTION = (
-    "CPU-hosted meshes over FileKV: routing/failover/shed numbers "
-    "price the fleet layer's coordination mechanics (placement "
-    "scoring, KV polling, lease expiry, wire codecs), not TPU "
-    "compute; on a real deployment the per-key cost is a jax "
-    "coordinator RPC instead of a filesystem op, and detect_s is "
-    "still ~ttl by construction.")
+    "CPU-hosted meshes over FileKV: routing/failover/shed/partition "
+    "numbers price the fleet layer's coordination mechanics "
+    "(placement scoring, KV polling, lease expiry, quorum rounds, "
+    "fence CAS, WAL fsyncs, wire codecs), not TPU compute; on a real "
+    "deployment the per-key cost is a jax coordinator RPC instead of "
+    "a filesystem op, and detect_s is still ~ttl by construction.")
 
 
 def _percentiles(lat_s: Sequence[float]) -> Dict[str, float]:
@@ -324,6 +334,209 @@ def run_shed_arm(devs, workdir: str, *, n_protected: int = 12,
 
 
 # ---------------------------------------------------------------------------
+# arm 4: partition-drill MTTR breakdown (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def _partition_drill(workdir: str, tag: str, *, ttl: float) -> dict:
+    """One majority-side partition drill over a fresh FileKV
+    namespace, the clock split at the recovery pipeline's stage
+    boundaries: detect -> quorum round -> fence advance -> fenced
+    reject."""
+    import threading
+
+    from pencilarrays_tpu.cluster import elastic
+    from pencilarrays_tpu.cluster.consensus import Coordinator
+    from pencilarrays_tpu.cluster.errors import FencedWriteError
+    from pencilarrays_tpu.cluster.kv import FencedKV, FileKV
+
+    kv = FileKV(os.path.join(workdir, f"part-kv-{tag}"))
+    coords = {r: Coordinator(kv, r, 3, lease_ttl=ttl,
+                             verdict_timeout=60)
+              for r in range(3)}
+    out = {}
+    try:
+        # detect: rank 2's renewals stop — the same evidence a
+        # write-cut partition presents (its lease silently goes stale)
+        coords[2].shutdown()
+        t_kill = time.perf_counter()
+        while 2 in coords[0].leases.live_ranks():
+            time.sleep(0.005)
+        out["detect_s"] = time.perf_counter() - t_kill
+
+        # quorum round: both survivors run the quorum-gated membership
+        # consensus — a strict-majority pass over the stale lease
+        res = [None, None]
+
+        def _agree(i):
+            res[i] = elastic.agree_membership(coords[i], timeout=30,
+                                              reason="partition")
+
+        t0 = time.perf_counter()
+        ths = [threading.Thread(target=_agree, args=(i,))
+               for i in (0, 1)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        out["quorum_round_s"] = time.perf_counter() - t0
+        m = res[0]
+        assert m is not None and m.members == [0, 1], res
+
+        # fence advance: the new generation's rank 0's FIRST
+        # post-reform write (one CAS on an uncontended key)
+        fenced = FencedKV(kv, namespace="pa", generation=m.gen,
+                          epoch=m.epoch)
+        t0 = time.perf_counter()
+        fenced.advance(m.gen, m.epoch)
+        out["fence_advance_s"] = time.perf_counter() - t0
+
+        # fenced reject: the zombie's write bounces in one fence read
+        zombie = FencedKV(kv, namespace="pa", generation=0, epoch=0)
+        t0 = time.perf_counter()
+        try:
+            zombie.set("pa/poison/bench", "stale")
+        except FencedWriteError:
+            out["fenced_reject_s"] = time.perf_counter() - t0
+        else:
+            raise AssertionError("zombie write landed behind the fence")
+        out["mttr_s"] = (out["detect_s"] + out["quorum_round_s"]
+                         + out["fence_advance_s"])
+    finally:
+        for c in coords.values():
+            c.shutdown()
+    return out
+
+
+def _minority_exit_drill(workdir: str, tag: str, *,
+                         round_timeout: float = 0.3) -> float:
+    """Time the minority side's typed exit: peers alive and
+    heartbeating (no evidence they left) but silent — the membership
+    round assembles 1 voter of 3 and must raise ``QuorumLossError``
+    within the round budget, never hang."""
+    from pencilarrays_tpu.cluster import elastic
+    from pencilarrays_tpu.cluster.consensus import Coordinator
+    from pencilarrays_tpu.cluster.errors import QuorumLossError
+    from pencilarrays_tpu.cluster.kv import FileKV
+
+    kv = FileKV(os.path.join(workdir, f"minority-kv-{tag}"))
+    coords = {r: Coordinator(kv, r, 3, lease_ttl=10.0,
+                             verdict_timeout=60)
+              for r in range(3)}
+    try:
+        t0 = time.perf_counter()
+        try:
+            elastic.agree_membership(coords[0], timeout=round_timeout,
+                                     max_rounds=2)
+        except QuorumLossError:
+            return time.perf_counter() - t0
+        raise AssertionError("minority side formed a rival mesh")
+    finally:
+        for c in coords.values():
+            c.shutdown()
+
+
+def _wal_replay_drill(workdir: str, *, n_requests: int = 64) -> dict:
+    """Price the router WAL both ways: the fsync'd per-admission
+    submit tax (the same storm with and without a ``wal_dir``), and
+    cold ``recover()`` replay throughput over the committed records
+    the crashed incarnation left behind."""
+    from pencilarrays_tpu.cluster.kv import FileKV
+    from pencilarrays_tpu.fleet import FleetRouter, wire
+    from pencilarrays_tpu.fleet.health import MeshLease
+
+    rng = np.random.default_rng(7)
+    u = _payload(rng)
+
+    def synthetic_mesh(kv, router):
+        MeshLease(kv, 1, ttl=600.0).renew()
+        kv.set(wire.load_key("pa", 1), json.dumps({
+            "t": time.time(), "mesh": 1, "tier": "colo",
+            "projection": {"queued_cost_bytes": 0,
+                           "inflight_cost_bytes": 0},
+            "plans": {"fft": "fp-0"}, "warm": ["fp-0"]}))
+        router.register_mesh(1, tier="colo")
+
+    def timed_storm(router):
+        lat = []
+        for _ in range(n_requests):
+            t0 = time.perf_counter()
+            router.submit("bench", u, name="fft")
+            lat.append(time.perf_counter() - t0)
+        return lat
+
+    kv0 = FileKV(os.path.join(workdir, "wal-kv-base"))
+    r0 = FleetRouter(kv0, ttl=600.0, load_max_age_s=0.25)
+    synthetic_mesh(kv0, r0)
+    base_s = timed_storm(r0)
+    r0.close()
+
+    kv1 = FileKV(os.path.join(workdir, "wal-kv"))
+    waldir = os.path.join(workdir, "wal-log")
+    r1 = FleetRouter(kv1, ttl=600.0, load_max_age_s=0.25,
+                     wal_dir=waldir)
+    synthetic_mesh(kv1, r1)
+    wal_s = timed_storm(r1)
+    r1.close()      # the crash: in-memory state dropped, WAL survives
+
+    r2 = FleetRouter(kv1, ttl=600.0, load_max_age_s=0.25,
+                     wal_dir=waldir)
+    synthetic_mesh(kv1, r2)
+    t0 = time.perf_counter()
+    rep = r2.recover()
+    replay_s = time.perf_counter() - t0
+    r2.close()
+    assert rep["outcome"] == "clean", rep
+    assert rep["reparked"] == n_requests, rep
+    return {
+        "n_requests": n_requests,
+        "submit_no_wal": _percentiles(base_s),
+        "submit_with_wal": _percentiles(wal_s),
+        "wal_submit_overhead_p50_ms": (
+            _percentiles(wal_s)["p50_ms"]
+            - _percentiles(base_s)["p50_ms"]),
+        "records_replayed": rep["replayed"],
+        "recover_s": replay_s,
+        "replay_records_per_s": rep["replayed"] / replay_s,
+    }
+
+
+def run_partition_arm(workdir: str, *, ttl: float = 0.5,
+                      repeats: int = 3,
+                      minority_round_timeout: float = 0.3) -> dict:
+    _partition_drill(workdir, "warmup", ttl=ttl)   # import/trace tax
+    runs = [_partition_drill(workdir, str(i), ttl=ttl)
+            for i in range(repeats)]
+    minority_s = [_minority_exit_drill(
+        workdir, str(i), round_timeout=minority_round_timeout)
+        for i in range(repeats)]
+    det = [r["detect_s"] for r in runs]
+    return {
+        "ttl_s": ttl,
+        "repeats": runs,
+        "detect_s_median": float(np.median(det)),
+        # detection is lease-bounded on the partition drill too
+        "detect_within_lease_bound": all(
+            d < ttl + max(0.05, ttl / 3.0) + 1.0 for d in det),
+        "quorum_round_s_median": float(np.median(
+            [r["quorum_round_s"] for r in runs])),
+        "fence_advance_s_median": float(np.median(
+            [r["fence_advance_s"] for r in runs])),
+        "fenced_reject_s_median": float(np.median(
+            [r["fenced_reject_s"] for r in runs])),
+        "mttr_s_median": float(np.median([r["mttr_s"] for r in runs])),
+        "minority_exit": {
+            "round_timeout_s": minority_round_timeout,
+            "typed_exit_s_median": float(np.median(minority_s)),
+            # typed, within the round budget — never a hang
+            "bounded": all(s < 2 * minority_round_timeout + 5.0
+                           for s in minority_s),
+        },
+        "router_wal": _wal_replay_drill(
+            os.path.join(workdir, "walarm")),
+    }
+
+
+# ---------------------------------------------------------------------------
 
 
 def run_fleet_suite(devs, *, workdir: str = ".") -> dict:
@@ -331,6 +544,7 @@ def run_fleet_suite(devs, *, workdir: str = ".") -> dict:
         "routing": run_routing_arm(workdir),
         "mttr": run_mttr_arm(devs, workdir),
         "shed": run_shed_arm(devs, workdir),
+        "partition": run_partition_arm(workdir),
         "caption": CPU_MESH_CAPTION,
     }
 

@@ -759,10 +759,12 @@ def main():
                              "decision latency over N live mesh "
                              "exports, the failover MTTR breakdown "
                              "(detect/rebind/resolve with exactly-"
-                             "once asserted), and shed precision/"
+                             "once asserted), shed precision/"
                              "recall with typed AdmissionError "
-                             "crossing the KV wire; writes "
-                             "BENCH_FLEET.json")
+                             "crossing the KV wire, and the "
+                             "partition-drill breakdown (quorum "
+                             "round, fence advance, router WAL "
+                             "replay); writes BENCH_FLEET.json")
     parser.add_argument("--fleet-only", action="store_true",
                         help="run ONLY the --fleet arm (used to "
                              "commit the BENCH_FLEET.json artifact)")

@@ -66,7 +66,15 @@ can enforce at runtime:
     ADOPTS the inbound trace instead of minting a fresh one), and the
     serve dispatch-meta builder carries the ``"trace"`` key so
     engine-side records join the request's timeline (empty
-    allowlist).
+    allowlist);
+``kv-fenced``
+    every KV write (``.set(`` / ``.set_if(`` / ``.delete(``) inside
+    the recovery-path packages (``cluster/``, ``fleet/``) either goes
+    through :class:`~pencilarrays_tpu.cluster.kv.FencedKV` — so a
+    zombie rank that slept through a reformation is rejected typed —
+    or carries an inline ``# kv-unfenced: <reason>`` opt-out at the
+    call site; the allowlist stays empty so every excuse lives next
+    to the write it excuses.
 
 Everything is parsed from source with :mod:`ast` — the linter never
 imports the modules it checks, so it runs in milliseconds, cannot be
@@ -113,7 +121,7 @@ _MUTATING_METHODS = frozenset({
 
 CHECKS = ("journal-event", "fleet-event", "env-knob", "plan-cache",
           "fault-point", "unlocked-state", "thread-spawn", "wire-cast",
-          "hop-peak", "trace-ctx")
+          "hop-peak", "trace-ctx", "kv-fenced")
 
 # the exchange-program sources the wire-cast check audits: whole
 # modules whose traced bodies build exchange programs, plus named
@@ -154,6 +162,18 @@ TRACE_MINT_MODULES = ("obs/requestflow.py", "fleet/router.py",
 TRACE_WORKER_MODULE = "fleet/worker.py"
 TRACE_META_MODULE = "serve/service.py"
 TRACE_META_FUNCTION = "_dispatch_meta"
+
+# kv-fenced check (PR 20): the packages whose KV writes run on
+# recovery/reformation paths, where a zombie — a rank that slept
+# through a reformation — can corrupt the new generation's state.
+# Every ``<kv-ish receiver>.set/set_if/delete(`` call there either
+# goes through ``FencedKV`` (receiver named ``fenced*``) or carries an
+# inline ``# kv-unfenced: <why this write cannot be a zombie's>``
+# opt-out at the call site.  The allowlist is empty ON
+# PURPOSE: the justification lives next to the write it excuses.
+KV_FENCED_PACKAGES = ("cluster", "fleet")
+KV_WRITE_METHODS = frozenset({"set", "set_if", "delete"})
+KV_FENCED_OPTOUT = "# kv-unfenced:"
 
 
 @dataclass(frozen=True)
@@ -974,6 +994,81 @@ def _check_trace_ctx(root: str, trees: Dict[str, ast.Module],
                     f"with no request attribution"))
 
 
+def _check_kv_fenced(root: str, trees: Dict[str, ast.Module],
+                     findings: List[Finding]) -> None:
+    """Every KV write in the recovery-path packages (``cluster/``,
+    ``fleet/``) is either fenced or explicitly, inline-justified
+    unfenced (module docstring).  A write call is in scope when its
+    receiver expression mentions ``kv`` (``self.kv.set(...)``,
+    ``kv.delete(...)``, ``coord.kv.set_if(...)``); a receiver
+    mentioning ``fenced`` IS the sanctioned path.  The opt-out is a
+    ``# kv-unfenced: <reason>`` comment on the call's first or last
+    source line, or in the comment block directly above the call —
+    the reason is required (an empty one is still a finding), and it
+    lives next to the write so a reviewer reads the excuse and the
+    excused code together.  The ident is
+    ``<dotted module>.<enclosing function>`` (the thread-spawn
+    convention)."""
+    prefixes = tuple(os.path.join(root, PACKAGE, p) + os.sep
+                     for p in KV_FENCED_PACKAGES)
+    for path, tree in trees.items():
+        if not path.startswith(prefixes):
+            continue
+        dotted = _module_dotted(root, path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                src_lines = f.read().splitlines()
+        except OSError:
+            src_lines = []
+
+        def _has_marker(line: str) -> bool:
+            i = line.find(KV_FENCED_OPTOUT)
+            return (i >= 0
+                    and bool(line[i + len(KV_FENCED_OPTOUT):].strip()))
+
+        def _opted_out(call: ast.Call) -> bool:
+            # the marker rides the call's own line(s), or a contiguous
+            # comment block directly above it (multi-line excuses)
+            for n in {call.lineno, getattr(call, "end_lineno",
+                                           call.lineno)}:
+                if n is not None and n <= len(src_lines) \
+                        and _has_marker(src_lines[n - 1]):
+                    return True
+            n = call.lineno - 1
+            while n >= 1 and src_lines[n - 1].lstrip().startswith("#"):
+                if _has_marker(src_lines[n - 1]):
+                    return True
+                n -= 1
+            return False
+
+        def visit(node: ast.AST, scope: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                inner = scope
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    inner = child.name
+                if (isinstance(child, ast.Call)
+                        and isinstance(child.func, ast.Attribute)
+                        and child.func.attr in KV_WRITE_METHODS):
+                    try:
+                        recv = ast.unparse(child.func.value).lower()
+                    except Exception:   # pragma: no cover - exotic AST
+                        recv = ""
+                    if ("kv" in recv and "fenced" not in recv
+                            and not _opted_out(child)):
+                        findings.append(Finding(
+                            "kv-fenced", _rel(root, path),
+                            child.lineno, f"{dotted}.{scope}",
+                            f"raw KV .{child.func.attr}( in {dotted}."
+                            f"{scope} — recovery-path writes go "
+                            f"through FencedKV (zombie fencing) or "
+                            f"carry an inline '{KV_FENCED_OPTOUT} "
+                            f"<reason>' opt-out"))
+                visit(child, inner)
+
+        visit(tree, "<module>")
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -1006,6 +1101,7 @@ def lint_tree(root: str) -> List[Finding]:
     _check_wire_cast(root, trees, findings)
     _check_hop_peak(root, trees, findings)
     _check_trace_ctx(root, trees, findings)
+    _check_kv_fenced(root, trees, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.check, f.ident))
     return findings
 

@@ -59,8 +59,10 @@ from .errors import (  # noqa: F401
     ClusterAbortError,
     ClusterError,
     ConsensusTimeoutError,
+    FencedWriteError,
     PeerFailureError,
     PeerLeftError,
+    QuorumLossError,
     ReformError,
 )
 
@@ -78,6 +80,8 @@ __all__ = [
     "ClusterAbortError",
     "ConsensusTimeoutError",
     "ReformError",
+    "QuorumLossError",
+    "FencedWriteError",
     "enabled",
     "enable",
     "disable",

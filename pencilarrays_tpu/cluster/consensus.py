@@ -134,6 +134,8 @@ class Coordinator:
         self._round += 1
         prefix = f"{self.ns}/round/{self._round:06d}/{tag}"
         own = f"{prefix}/r{self.rank}"
+        # kv-unfenced: own per-round key in this generation's ns — a
+        # zombie's round lives in a namespace no survivor reads
         self.kv.set(own, json.dumps(payload))
         out: List[dict] = []
         for rank in range(self.world):
@@ -156,7 +158,7 @@ class Coordinator:
         # globally dead.  Our round-R key may still be mid-read by a
         # slower peer — it is deleted at the END of round R+1.
         if self._prev_key is not None:
-            self.kv.delete(self._prev_key)
+            self.kv.delete(self._prev_key)  # kv-unfenced: GC of own key
         self._prev_key = own
         return out
 
@@ -211,6 +213,9 @@ class Coordinator:
         key = (f"{self.ns}/round/{self._round:06d}/"
                f"verdict.{_keyify(label)}/r{self.rank}")
         try:
+            # kv-unfenced: the dying rank's last words — fencing the
+            # abort broadcast would silence exactly the failure report
+            # the survivors' verdict round is waiting on
             self.kv.set(key, json.dumps({
                 "status": "fatal", "error": error,
                 "can_retry": False, "can_restore": False}))
@@ -218,6 +223,7 @@ class Coordinator:
             pass            # original error must still propagate
         if self._prev_key is not None:
             try:
+                # kv-unfenced: GC of own key on the dying path
                 self.kv.delete(self._prev_key)
             except Exception:   # pragma: no cover
                 pass

@@ -50,6 +50,30 @@ NEXT reformation) or split a straggler off (it gets a typed
 timeouts and surfaces typed errors, never a silent stall — reformation
 itself runs under the hang watchdog.
 
+**The quorum gate (split-brain protection, ISSUE 20)**: before a rank
+may act on any membership round it must assemble a strict majority of
+the *last-agreed* membership (the current coordinator's world).  The
+voters are ranks whose view blobs were actually **read** this round;
+the denominator excludes only ranks with *fresh-read* evidence of
+departure — a readable ``cluster.leave`` record, or a readable lease
+whose own timestamp is stale beyond ttl.  Absence of information is
+never evidence: a partitioned rank reads nothing, so it can neither
+collect voters nor shrink the denominator, and it exits with typed
+:class:`~pencilarrays_tpu.cluster.errors.QuorumLossError` instead of
+forming a rival mesh.  (A missing lease key counts as gone only when
+this rank just proved the store answers in both directions — its own
+lease reads back fresh — so "authoritative absence" can admit a
+never-booted rank's eviction without ever helping a partitioned
+minority.)  ``PENCILARRAYS_TPU_ELASTIC_QUORUM=off`` is the documented
+escape hatch for an intentional shrink below majority: the gate is
+evaluated, journaled with ``verdict="bypass"`` and warned about, but
+never raises.  The gate advances the **write fence** too: the agreed
+new generation's rank 0 publishes ``(gen, epoch)`` at
+``<base>/fence`` (:class:`~pencilarrays_tpu.cluster.kv.FencedKV`), so
+a zombie rank that slept through the reformation gets a typed
+:class:`~pencilarrays_tpu.cluster.errors.FencedWriteError` on its
+next recovery-path write instead of corrupting the live namespace.
+
 Everything is **off by default**: ``PENCILARRAYS_TPU_ELASTIC`` unset
 means :func:`~pencilarrays_tpu.guard.recover.elastic_step` degrades to
 ``guarded_step`` exactly (test-pinned) and nothing here ever runs.
@@ -66,6 +90,9 @@ Environment knobs:
                                                     below this world
 ``PENCILARRAYS_TPU_ELASTIC_JOIN_TIMEOUT``  600      ``request_join``
                                                     wait (s)
+``PENCILARRAYS_TPU_ELASTIC_QUORUM``        on       ``off`` disables the
+                                                    split-brain quorum
+                                                    gate (loud bypass)
 =========================================  =======  ====================
 """
 
@@ -75,10 +102,11 @@ import json
 import os
 import threading
 import time
+import warnings
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
-from .errors import ConsensusTimeoutError, ReformError
+from .errors import ConsensusTimeoutError, QuorumLossError, ReformError
 
 __all__ = [
     "ENV_VAR",
@@ -86,6 +114,7 @@ __all__ = [
     "ROUNDS_VAR",
     "MIN_WORLD_VAR",
     "JOIN_TIMEOUT_VAR",
+    "QUORUM_VAR",
     "Membership",
     "ReformContext",
     "Reformation",
@@ -108,6 +137,7 @@ TIMEOUT_VAR = "PENCILARRAYS_TPU_ELASTIC_TIMEOUT"
 ROUNDS_VAR = "PENCILARRAYS_TPU_ELASTIC_ROUNDS"
 MIN_WORLD_VAR = "PENCILARRAYS_TPU_ELASTIC_MIN_WORLD"
 JOIN_TIMEOUT_VAR = "PENCILARRAYS_TPU_ELASTIC_JOIN_TIMEOUT"
+QUORUM_VAR = "PENCILARRAYS_TPU_ELASTIC_QUORUM"
 
 DEFAULT_TIMEOUT = 60.0
 DEFAULT_ROUNDS = 8
@@ -360,6 +390,93 @@ def _journal_reform(stage: str, gen: int, **fields) -> None:
         obs.record_event("cluster.reform", gen=gen, stage=stage, **fields)
 
 
+def _quorum_gone(kv, leases, rank: int, absence_ok: bool) -> bool:
+    """Fresh-read evidence that ``rank`` has durably left the
+    last-agreed membership: a readable ``cluster.leave`` record, a
+    readable lease whose OWN parsed timestamp is stale beyond ttl, or
+    — only when ``absence_ok``, i.e. the caller just proved the store
+    answers from here (see :func:`_check_quorum`) — an authoritative
+    miss on both keys (the rank never published into this namespace at
+    all).  An unreadable store yields NO evidence: under a partition
+    every ``try_get`` comes back ``None``, and a minority that treated
+    that as death would vote its healthy peers out of the denominator
+    and form a rival mesh.  Deliberately does NOT reuse
+    ``LeaseBoard.peer_age``: its ``_last_seen`` fallback *ages locally*
+    without fresh reads — exactly the fabricated evidence the quorum
+    gate exists to refuse."""
+    if kv.try_get(leases._leave_key(rank)) is not None:
+        return True
+    raw = kv.try_get(leases._key(rank))
+    if raw is None:
+        return absence_ok
+    try:
+        t = float(json.loads(raw)["t"])
+    except (ValueError, KeyError, TypeError):
+        return False
+    return (time.time() - t) > leases.ttl
+
+
+def _check_quorum(coord, gen: int, voters, *, reason: str,
+                  cause: Optional[BaseException] = None) -> None:
+    """The split-brain gate (module docstring): the round's voters —
+    ranks whose blobs were actually READ this round, self included —
+    must form a strict majority of the last-agreed membership
+    (``coord.world``) minus confirmed-gone ranks.  Every evaluation is
+    journaled (``cluster.quorum``, fsync-critical); below majority the
+    gate raises typed :class:`QuorumLossError`, unless
+    ``PENCILARRAYS_TPU_ELASTIC_QUORUM=off`` turned it into a loud
+    bypass."""
+    from .. import obs
+    from ..engine import config as _rtconfig
+
+    voters = set(voters) | {coord.rank}
+    # absence-as-evidence needs proof the store answers in BOTH
+    # directions from here: this rank's OWN lease must read back fresh
+    # (its heartbeat wrote it within ~interval).  A partitioned rank
+    # cannot read its lease back (read cut) or keep it fresh (write
+    # cut), so for it a missing peer key stays "no information".
+    self_raw = coord.kv.try_get(coord.leases._key(coord.rank))
+    absence_ok = False
+    if self_raw is not None:
+        try:
+            t = float(json.loads(self_raw)["t"])
+            absence_ok = (time.time() - t) <= coord.leases.ttl
+        except (ValueError, KeyError, TypeError):
+            pass
+    gone: Set[int] = {
+        r for r in range(coord.world)
+        if r not in voters
+        and _quorum_gone(coord.kv, coord.leases, r, absence_ok)}
+    of = sorted(set(range(coord.world)) - gone)
+    need = len(of) // 2 + 1
+    have = sorted(voters)
+    ok = len(have) >= need
+    gate_on = _rtconfig.current().elastic_quorum
+    verdict = "pass" if ok else ("fail" if gate_on else "bypass")
+    if obs.enabled():
+        obs.record_event("cluster.quorum", gen=gen, rank=coord.rank,
+                         verdict=verdict, have=have, need=need, of=of,
+                         gone=sorted(gone), reason=reason)
+    if ok:
+        return
+    if not gate_on:
+        warnings.warn(
+            f"{QUORUM_VAR}=off: acting on membership round g{gen} with "
+            f"only {len(have)} voter(s) {have} of {len(of)} (strict "
+            f"majority needs {need}) — split-brain protection is "
+            f"DISABLED; safe only for an intentional shrink below "
+            f"majority", RuntimeWarning, stacklevel=3)
+        return
+    raise QuorumLossError(
+        f"quorum lost at membership round g{gen}: only {len(have)} "
+        f"voter(s) {have} of last-agreed membership {of} (strict "
+        f"majority needs {need}) — this rank is on the minority side "
+        f"of a partition and must NOT form a rival mesh; exit and "
+        f"rejoin via request_join(), or set {QUORUM_VAR}=off for an "
+        f"intentional shrink below majority",
+        gen=gen, have=have, need=need, of=of) from cause
+
+
 def agree_membership(coord, *, reason: str = "reform",
                      timeout: Optional[float] = None,
                      max_rounds: Optional[int] = None) -> Membership:
@@ -385,7 +502,21 @@ def agree_membership(coord, *, reason: str = "reform",
         view = {"rank": coord.rank, "live": sorted(live),
                 "joiners": my_joiners, "epoch": _epoch.current(),
                 "reason": reason}
-        kv.set(f"{prefix}/view/r{coord.rank}", json.dumps(view))
+        try:
+            # kv-unfenced: pre-agreement — gen N+1's fence does not
+            # exist yet; the quorum gate below is THE guard here
+            kv.set(f"{prefix}/view/r{coord.rank}", json.dumps(view))
+        except ConsensusTimeoutError as e:
+            # the store is unreachable for writes from this rank: it
+            # cannot even cast its vote.  Run the quorum gate over the
+            # one view it holds (its own) so the wire-level timeout
+            # surfaces as a typed QuorumLossError instead of burning
+            # the round budget against a dead wire.
+            _check_quorum(coord, gen, {coord.rank}, reason=reason,
+                          cause=e)
+            last_err = str(e)
+            live = set(leases.live_ranks())
+            continue
         _journal_reform("view", gen, rank=coord.rank, live=sorted(live),
                         joiners=my_joiners, reason=reason)
         deadline = time.monotonic() + timeout
@@ -403,9 +534,16 @@ def agree_membership(coord, *, reason: str = "reform",
                     # below removes it from the member set
                     dead.add(e.rank)
         except ConsensusTimeoutError as e:
+            _check_quorum(coord, gen, set(views), reason=reason,
+                          cause=e)
             last_err = str(e)
             live = set(leases.live_ranks())
             continue
+        # the gate: the views actually read this round are the voters
+        # (a _MemberDied exclusion is NOT a vote — peer_age's local
+        # fallback can age a healthy-but-unreachable peer, and the
+        # denominator only shrinks on _quorum_gone's fresh evidence)
+        _check_quorum(coord, gen, set(views), reason=reason)
         tentative = set(live)
         for v in views.values():
             tentative &= set(v.get("live", []))
@@ -423,10 +561,20 @@ def agree_membership(coord, *, reason: str = "reform",
         confirm = {"members": members, "joiners": sorted(joiners),
                    "epoch": max(int(v.get("epoch", 0))
                                 for v in views.values()) + 1}
-        kv.set(f"{prefix}/confirm/r{coord.rank}", json.dumps(confirm))
-        deadline = time.monotonic() + timeout
         try:
-            confirms = {coord.rank: confirm}
+            # kv-unfenced: still pre-agreement (the confirm IS the
+            # agreement); quorum-gated on timeout below
+            kv.set(f"{prefix}/confirm/r{coord.rank}", json.dumps(confirm))
+        except ConsensusTimeoutError as e:
+            # partition onset between the view and confirm publishes
+            _check_quorum(coord, gen, {coord.rank}, reason=reason,
+                          cause=e)
+            last_err = str(e)
+            live = set(leases.live_ranks())
+            continue
+        deadline = time.monotonic() + timeout
+        confirms = {coord.rank: confirm}
+        try:
             for r in members:
                 if r == coord.rank:
                     continue
@@ -437,6 +585,8 @@ def agree_membership(coord, *, reason: str = "reform",
             last_err = f"rank {e.rank} died during the confirm round"
             continue
         except ConsensusTimeoutError as e:
+            _check_quorum(coord, gen, set(confirms), reason=reason,
+                          cause=e)
             last_err = str(e)
             live = set(leases.live_ranks())
             continue
@@ -584,11 +734,25 @@ def reform(coordinator=None, *, reason: str = "reform",
                 verdict_timeout=coord.verdict_timeout,
                 namespace=m.namespace)
             if m.new_rank == 0:
+                # the agreed new generation's rank 0 advances the
+                # write fence FIRST: from here on, any writer still
+                # holding a pre-reform (gen, epoch) token is a zombie
+                # and its recovery-path writes are rejected typed
+                from .kv import FencedKV
+
+                fenced = FencedKV(coord.kv, namespace=m.base_ns,
+                                  generation=m.gen, epoch=m.epoch)
+                fence = fenced.advance(m.gen, m.epoch)
+                _journal_reform("fence", m.gen, rank=m.new_rank,
+                                fence_gen=fence[0],
+                                fence_epoch=fence[1])
                 # the single deterministic writer publishes each
                 # accepted joiner's assignment (rank/world/namespace)
-                # and consumes the request keys
+                # and consumes the request keys — through the fence,
+                # so a zombie rank 0 of a dead generation can never
+                # hand out assignments into the live namespace
                 for i, slot in enumerate(m.joiners):
-                    coord.kv.set(
+                    fenced.set(
                         f"{m.base_ns}/reform/assign/s{slot}",
                         json.dumps({
                             "gen": m.gen, "slot": slot,
@@ -598,7 +762,7 @@ def reform(coordinator=None, *, reason: str = "reform",
                             "joiners": m.joiners,
                             "lease_ttl": coord.leases.ttl,
                             "verdict_timeout": coord.verdict_timeout}))
-                    coord.kv.delete(f"{m.base_ns}/join/s{slot}")
+                    fenced.delete(f"{m.base_ns}/join/s{slot}")
             timings["mesh_s"] = time.monotonic() - t0
             _journal_reform("mesh", m.gen, rank=m.new_rank,
                             namespace=m.namespace)
@@ -726,19 +890,23 @@ def request_join(kv, slot: str, *, namespace: str = "pa",
     # first, so the assignment we read below was provably published in
     # response to THIS request (joining a dead generation's namespace
     # would heartbeat into a world that no longer exists)
+    # kv-unfenced: the joiner holds no fencing token by definition —
+    # it is not a member of ANY generation yet; rank 0 answers through
+    # FencedKV, so a dead generation's survivor cannot assign it
     kv.delete(f"{base}/reform/assign/s{slot}")
-    kv.set(f"{base}/join/s{slot}", json.dumps(
+    kv.set(f"{base}/join/s{slot}", json.dumps(   # kv-unfenced: no token yet
         {"slot": slot, "pid": os.getpid(), "t": time.time()}))
     _journal_reform("join-request", _gen, slot=slot)
     try:
         raw = kv.get(f"{base}/reform/assign/s{slot}", timeout)
     except ConsensusTimeoutError as e:
-        kv.delete(f"{base}/join/s{slot}")
+        kv.delete(f"{base}/join/s{slot}")  # kv-unfenced: retract own bid
         raise ReformError(
             f"join request {slot!r} was not assigned within "
             f"{timeout:.0f}s (no reformation boundary reached, or the "
             f"mesh is gone)", stage="join") from e
     a = json.loads(raw)
+    # kv-unfenced: consuming the assignment addressed to this joiner
     kv.delete(f"{base}/reform/assign/s{slot}")
     from . import enable as _install_coord
     from . import epoch as _epoch

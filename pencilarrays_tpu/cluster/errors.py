@@ -17,6 +17,8 @@ __all__ = [
     "ClusterAbortError",
     "ConsensusTimeoutError",
     "ReformError",
+    "QuorumLossError",
+    "FencedWriteError",
 ]
 
 
@@ -67,6 +69,48 @@ class ReformError(ClusterError):
         super().__init__(message)
         self.stage = stage
         self.gen = gen
+
+
+class QuorumLossError(ReformError):
+    """This rank sits on the MINORITY side of a partitioned mesh: the
+    membership consensus could not assemble a strict majority of the
+    *last-agreed* membership, so forming generation N+1 here would
+    create a rival mesh (split brain) — two generations both believing
+    they own the namespace, double-executing work and double-writing
+    checkpoints.  The only safe action on this side is a typed exit;
+    the majority side (if one exists) reforms without this rank.
+    ``have`` is the voter set this side could assemble, ``need`` the
+    strict-majority threshold, ``of`` the last-agreed membership it is
+    computed over.  ``ELASTIC_QUORUM=off``
+    (``PENCILARRAYS_TPU_ELASTIC_QUORUM``) disables the gate for an
+    intentional shrink below majority — see ``docs/Cluster.md``."""
+
+    def __init__(self, message: str, *, gen: Optional[int] = None,
+                 have: Sequence[int] = (), need: Optional[int] = None,
+                 of: Sequence[int] = ()):
+        super().__init__(message, stage="quorum", gen=gen)
+        self.have = tuple(have)
+        self.need = need
+        self.of = tuple(of)
+
+
+class FencedWriteError(ClusterError):
+    """A recovery-path KV write carried a stale fencing token: the
+    writer's ``(generation, epoch)`` is behind the namespace's
+    published fence, i.e. the mesh reformed (or recovered) past this
+    writer — a zombie rank waking up after eviction.  The write was
+    rejected *before* touching the store; the correct reaction is to
+    stop, never to retry (the fence only ever moves further away).
+    ``token`` is the writer's stale token, ``fence`` the published
+    one."""
+
+    def __init__(self, message: str, *, key: Optional[str] = None,
+                 token: Optional[tuple] = None,
+                 fence: Optional[tuple] = None):
+        super().__init__(message)
+        self.key = key
+        self.token = token
+        self.fence = fence
 
 
 class ClusterAbortError(ClusterError):

@@ -88,6 +88,10 @@ class LeaseBoard:
         from . import epoch
 
         self._renewals += 1
+        # kv-unfenced: the lease IS the liveness evidence the quorum
+        # gate reads — fencing it would blind the majority to exactly
+        # the rank it must evict; a zombie's heartbeat only keeps its
+        # own per-rank key fresh, it cannot overwrite anyone's state
         self.kv.set(self._key(self.rank), json.dumps({
             "t": time.time(), "pid": os.getpid(),
             "epoch": epoch.current(), "n": self._renewals}))
@@ -133,6 +137,8 @@ class LeaseBoard:
         from . import epoch
         from .. import obs
 
+        # kv-unfenced: own departure record — gone-evidence for the
+        # quorum gate, written exactly when membership is being shed
         self.kv.set(self._leave_key(self.rank), json.dumps({
             "t": time.time(), "pid": os.getpid(),
             "epoch": epoch.current()}))

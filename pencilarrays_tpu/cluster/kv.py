@@ -14,26 +14,61 @@ that:
   ``jax.distributed`` mesh (whose CPU-backend collectives may not even
   exist), and in-process tests can run two ranks on two threads.
 
-Both expose the same four operations; ``get`` is a *bounded* wait that
+Both expose the same operations; ``get`` is a *bounded* wait that
 invokes an ``on_wait`` callback between polls — the hook the lease
 checker uses so a wait on a *dead* peer's key turns into a typed
 :class:`~pencilarrays_tpu.cluster.errors.PeerFailureError` instead of
-running out the full verdict timeout.
+running out the full verdict timeout.  Two additions for the
+partition-tolerant control plane (ISSUE 20):
+
+* ``set_if(key, value, expected)`` — compare-and-set.  FileKV
+  serializes racing writers through a lock file and publishes
+  atomically, so the swap is genuinely atomic on one filesystem;
+  JaxKV has **no server-side CAS** and degrades to a documented
+  best-effort read-verify-write (good enough for the fence-advance
+  race it guards, whose writers are already serialized by the
+  reformation protocol).
+* :class:`FencedKV` — a write-fencing wrapper: every write carries
+  the wrapper's ``(generation, epoch)`` token and is rejected with
+  typed :class:`~pencilarrays_tpu.cluster.errors.FencedWriteError`
+  when the token is behind the namespace's published fence — a zombie
+  rank returning after eviction can no longer corrupt the live
+  namespace (see ``docs/Cluster.md``).
+
+Every wire operation consults the ``kv.get``/``kv.set`` fault points
+(``docs/Resilience.md``), so any drill can be re-run under ``drop``
+(silently lost operations) or ``partition`` (an unreachable store)
+without monkeypatching either backend.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import time
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
-from ..resilience.fsutil import atomic_write_text
-from .errors import ConsensusTimeoutError
+from ..resilience.fsutil import atomic_write_text, fsync_dir
+from .errors import ConsensusTimeoutError, FencedWriteError
 
-__all__ = ["FileKV", "JaxKV", "resolve_kv"]
+__all__ = ["FileKV", "JaxKV", "FencedKV", "resolve_kv"]
 
 _SEGMENT_RE = re.compile(r"^[A-Za-z0-9._=-]+$")
+
+
+def _fire_kv(point: str, key: str, backend: str) -> Optional[str]:
+    """The KV wire's fault tap — one consult per wire operation (each
+    ``try_get``/blocking-``get`` poll fires ``kv.get``, each
+    ``set``/``set_if``/``delete`` fires ``kv.set``).  ``drop`` and
+    ``partition`` come back as cooperative mode strings the caller
+    honors; the ``armed`` probe keeps the no-faults path at one cheap
+    check per op."""
+    from ..resilience import faults
+
+    if not faults.armed(point):
+        return None
+    return faults.fire(point, key=key, backend=backend)
 
 
 class FileKV:
@@ -41,11 +76,22 @@ class FileKV:
 
     Keys are ``/``-separated paths of ``[A-Za-z0-9._=-]`` segments,
     mapped to files under ``root``.  Writes use the resilience layer's
-    atomic publish (tmp + fsync + ``os.replace``), so a reader never
-    sees a torn value — the same durability discipline as every other
-    metadata commit point in the tree.  Each rank writes only its own
-    keys (rank-suffixed), so concurrent publishes never collide.
+    atomic publish (tmp + fsync + ``os.replace`` + parent-directory
+    fsync), so a reader never sees a torn value — the same durability
+    discipline as every other metadata commit point in the tree.  A
+    key's *ancestor directories* are fsync'd in their own parents as
+    they are created (see :meth:`_ensure_dir`): without that, a host
+    crash after the atomic publish could lose the freshly created
+    directory chain and with it the published-looking key.  Each rank
+    writes only its own keys (rank-suffixed), so plain ``set`` calls
+    never collide; the one multi-writer key (the fence) goes through
+    :meth:`set_if`.
     """
+
+    # how long racing CAS writers wait on the per-key lock file before
+    # concluding its holder died mid-swap (the lock critical section is
+    # a few syscalls — seconds of wait means a crashed holder)
+    CAS_LOCK_TIMEOUT_S = 5.0
 
     def __init__(self, root: str):
         self.root = os.fspath(root)
@@ -58,13 +104,106 @@ class FileKV:
                 raise ValueError(f"bad KV key segment {p!r} in {key!r}")
         return os.path.join(self.root, *parts)
 
+    def _ensure_dir(self, d: str) -> None:
+        """``makedirs`` + fsync of every newly created ancestor's
+        parent.  The atomic publish fsyncs the *file's* directory
+        entry, but a brand-new directory's own entry in *its* parent
+        was never ordered — a crash could unlink the whole chain and
+        take the key with it."""
+        if not d or os.path.isdir(d):
+            return
+        missing = []
+        cur = d
+        while cur and not os.path.isdir(cur):
+            missing.append(cur)
+            parent = os.path.dirname(cur)
+            if parent == cur:
+                break
+            cur = parent
+        os.makedirs(d, exist_ok=True)
+        for m in reversed(missing):          # top-down: parents first
+            fsync_dir(os.path.dirname(m) or ".")
+
     def set(self, key: str, value: str) -> None:
         path = self._path(key)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+        act = _fire_kv("kv.set", key, "file")
+        if act == "partition":
+            raise ConsensusTimeoutError(
+                f"KV wire partitioned: set of {key!r} unreachable",
+                key=key)
+        if act == "drop":
+            return          # the lost write: acked locally, never stored
+        self._ensure_dir(os.path.dirname(path))
+        if act == "torn":
+            # a torn publish: a value prefix lands NON-atomically (the
+            # reader-facing breach the atomic publish exists to prevent),
+            # then the process dies — consumers must surface their typed
+            # unparseable-payload paths, never garbage semantics
+            with open(path, "w") as f:
+                f.write(value[: max(1, len(value) // 2)])
+                f.flush()
+                os.fsync(f.fileno())
+            from ..resilience.faults import kill_now
+
+            kill_now()
         atomic_write_text(path, value)
 
-    def try_get(self, key: str) -> Optional[str]:
+    def set_if(self, key: str, value: str,
+               expected: Optional[str]) -> bool:
+        """Compare-and-set: publish ``value`` iff the key's current
+        value is ``expected`` (``None`` = the key must not exist yet).
+        Racing writers serialize through a sibling ``<key>.lock`` file
+        (``O_CREAT|O_EXCL`` — atomic on one filesystem), the publish
+        itself stays atomic, so exactly one of N concurrent swappers
+        wins.  Returns True iff this call's value was published.  A
+        lock held past :data:`CAS_LOCK_TIMEOUT_S` (a writer crashed
+        inside the critical section) is broken and the swap retried."""
+        path = self._path(key)
+        act = _fire_kv("kv.set", key, "file")
+        if act == "partition":
+            raise ConsensusTimeoutError(
+                f"KV wire partitioned: set_if of {key!r} unreachable",
+                key=key)
+        if act == "drop":
+            return True     # the lost write: reported swapped, never stored
+        self._ensure_dir(os.path.dirname(path))
+        lock = path + ".lock"
+        deadline = time.monotonic() + self.CAS_LOCK_TIMEOUT_S
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                break
+            except FileExistsError:
+                if time.monotonic() >= deadline:
+                    # the holder died mid-swap: break the lock (the
+                    # publish underneath is atomic either way)
+                    try:
+                        os.unlink(lock)
+                    except FileNotFoundError:
+                        pass
+                    deadline = time.monotonic() + self.CAS_LOCK_TIMEOUT_S
+                time.sleep(0.002)
         try:
+            try:
+                with open(path) as f:
+                    current: Optional[str] = f.read()
+            except FileNotFoundError:
+                current = None
+            if current != expected:
+                return False
+            atomic_write_text(path, value)
+            return True
+        finally:
+            try:
+                os.unlink(lock)
+            except FileNotFoundError:   # pragma: no cover - lock broken
+                pass
+
+    def try_get(self, key: str) -> Optional[str]:
+        if _fire_kv("kv.get", key, "file") in ("drop", "partition"):
+            return None     # a dropped read misses; a partitioned one
+        try:                # cannot see the store at all
             with open(self._path(key)) as f:
                 return f.read()
         except FileNotFoundError:
@@ -74,7 +213,10 @@ class FileKV:
             poll: float = 0.05,
             on_wait: Optional[Callable[[], None]] = None) -> str:
         """Blocking read with deadline; ``on_wait()`` runs between polls
-        (and may raise — e.g. the peer-lease check)."""
+        (and may raise — e.g. the peer-lease check).  Under an armed
+        ``kv.get:partition`` every poll misses, so the wait runs out
+        into the same typed :class:`ConsensusTimeoutError` a real
+        partition produces."""
         deadline = time.monotonic() + timeout
         while True:
             v = self.try_get(key)
@@ -89,6 +231,13 @@ class FileKV:
             time.sleep(min(poll, max(0.0, deadline - time.monotonic())))
 
     def delete(self, key: str) -> None:
+        act = _fire_kv("kv.set", key, "file")
+        if act == "partition":
+            raise ConsensusTimeoutError(
+                f"KV wire partitioned: delete of {key!r} unreachable",
+                key=key)
+        if act == "drop":
+            return
         try:
             os.unlink(self._path(key))
         except FileNotFoundError:
@@ -107,8 +256,9 @@ class FileKV:
         except OSError:
             return out
         for name in names:
-            if not _SEGMENT_RE.match(name):
-                continue
+            if not _SEGMENT_RE.match(name) or name.endswith(
+                    (".tmp", ".lock")):
+                continue    # in-flight publish / CAS scaffolding
             v = self.try_get(f"{prefix}/{name}")
             if v is not None:
                 out[f"{prefix}/{name}"] = v
@@ -143,6 +293,13 @@ class JaxKV:
         return cls(client)
 
     def set(self, key: str, value: str) -> None:
+        act = _fire_kv("kv.set", key, "jax")
+        if act == "partition":
+            raise ConsensusTimeoutError(
+                f"KV wire partitioned: set of {key!r} unreachable",
+                key=key)
+        if act == "drop":
+            return
         try:
             self._client.key_value_set(key, value, allow_overwrite=True)
         except TypeError:   # older jaxlib: no allow_overwrite kwarg
@@ -152,7 +309,37 @@ class JaxKV:
                 pass
             self._client.key_value_set(key, value)
 
-    def try_get(self, key: str) -> Optional[str]:
+    def set_if(self, key: str, value: str,
+               expected: Optional[str]) -> bool:
+        """Best-effort compare-and-set — the jax coordinator exposes no
+        server-side CAS, so this is read-verify-write with a window
+        between the read and the write.  Documented as such: the one
+        multi-writer key this guards (the fence) is *also* protected by
+        the reformation protocol (only the agreed new generation's rank
+        0 advances it), so the CAS here is belt-and-braces, not the
+        sole line of defense.  FileKV drills exercise the genuinely
+        atomic path."""
+        act = _fire_kv("kv.set", key, "jax")
+        if act == "partition":
+            raise ConsensusTimeoutError(
+                f"KV wire partitioned: set_if of {key!r} unreachable",
+                key=key)
+        if act == "drop":
+            return True
+        current = self._raw_try_get(key)
+        if current != expected:
+            return False
+        try:
+            self._client.key_value_set(key, value, allow_overwrite=True)
+        except TypeError:   # pragma: no cover - older jaxlib
+            try:
+                self._client.key_value_delete(key)
+            except Exception:
+                pass
+            self._client.key_value_set(key, value)
+        return True
+
+    def _raw_try_get(self, key: str) -> Optional[str]:
         get = getattr(self._client, "key_value_try_get", None)
         if get is not None:
             try:
@@ -163,6 +350,11 @@ class JaxKV:
             return self._client.blocking_key_value_get(key, 1)
         except Exception:
             return None
+
+    def try_get(self, key: str) -> Optional[str]:
+        if _fire_kv("kv.get", key, "jax") in ("drop", "partition"):
+            return None
+        return self._raw_try_get(key)
 
     def get(self, key: str, timeout: float, *,
             poll: float = 0.05,
@@ -176,6 +368,14 @@ class JaxKV:
                     key=key, timeout_s=timeout)
             slice_s = min(self.SLICE_S, remaining)
             t0 = time.monotonic()
+            if _fire_kv("kv.get", key, "jax") in ("drop", "partition"):
+                # the wire is down for this slice: pace like a missed
+                # read so the deadline (and the lease check) still runs
+                if on_wait is not None:
+                    on_wait()
+                time.sleep(min(poll, max(0.0,
+                                         deadline - time.monotonic())))
+                continue
             try:
                 return self._client.blocking_key_value_get(
                     key, max(1, int(slice_s * 1000)))
@@ -191,6 +391,13 @@ class JaxKV:
                                              deadline - time.monotonic())))
 
     def delete(self, key: str) -> None:
+        act = _fire_kv("kv.set", key, "jax")
+        if act == "partition":
+            raise ConsensusTimeoutError(
+                f"KV wire partitioned: delete of {key!r} unreachable",
+                key=key)
+        if act == "drop":
+            return
         try:
             self._client.key_value_delete(key)
         except Exception:
@@ -208,6 +415,140 @@ class JaxKV:
             return {k: v for k, v in get(prefix)}
         except Exception:
             return {}
+
+
+class FencedKV:
+    """Write-fencing wrapper over either backend — the zombie guard.
+
+    The live mesh publishes a **fence** — the JSON pair
+    ``{"gen": G, "epoch": E}`` under ``<namespace>/fence`` in the
+    *base* namespace (so it spans generation-suffixed sub-namespaces) —
+    advanced by the agreed new generation's rank 0 at every
+    reformation (:meth:`advance`, CAS-guarded, monotonic).  Every
+    write through this wrapper compares its own ``(generation,
+    epoch)`` token against the published fence first: a token strictly
+    behind the fence is a **zombie** — a rank that was evicted, slept
+    through the reformation, and woke up still believing it is a
+    member — and its write is rejected with typed
+    :class:`FencedWriteError` *before* touching the store, journaled
+    fsync-critically (``cluster.fence``) and counted
+    (``cluster.fenced_writes``).
+
+    Honesty note: check-then-write is not atomic — a write racing the
+    fence advance itself can slip through for one advance window.
+    That window is harmless by construction: the racing writer was a
+    *member* until this very advance, so its value is at worst one
+    reformation stale, exactly as stale as any value it published a
+    millisecond before the advance.  What the fence kills is the
+    unbounded case — arbitrarily late zombie writes into a namespace
+    that reformed generations ago.
+
+    Reads pass through unchecked (a zombie reading stale state harms
+    nobody; it is the *writes* that corrupt)."""
+
+    FENCE_SEGMENT = "fence"
+
+    def __init__(self, kv, *, namespace: str = "pa",
+                 generation: int = 0, epoch: int = 0):
+        self.kv = kv
+        self.ns = namespace
+        self.generation = int(generation)
+        self.epoch = int(epoch)
+
+    # -- the fence itself ---------------------------------------------------
+    @property
+    def fence_key(self) -> str:
+        return f"{self.ns}/{self.FENCE_SEGMENT}"
+
+    def token(self) -> Tuple[int, int]:
+        """This writer's fencing token — compared lexicographically
+        (generation outranks epoch: a reformation is a bigger event
+        than an in-generation recovery)."""
+        return (self.generation, self.epoch)
+
+    def fence(self) -> Optional[Tuple[int, int]]:
+        """The published fence, or ``None`` (nobody has fenced this
+        namespace yet — every token passes, the pre-fencing default)."""
+        raw = self.kv.try_get(self.fence_key)
+        return _parse_fence(raw)
+
+    def advance(self, generation: int, epoch: int) -> Tuple[int, int]:
+        """Publish a new fence — monotonic and CAS-guarded: concurrent
+        advances serialize on the swap, and the fence never moves
+        backwards (an advance that lost the race to a *higher* fence
+        adopts it instead of regressing it).  The caller's own token is
+        updated to the published fence — the advancer is by definition
+        a member of the new generation.  Returns the fence now in
+        force."""
+        new = (int(generation), int(epoch))
+        for _ in range(64):
+            raw = self.kv.try_get(self.fence_key)
+            cur = _parse_fence(raw)
+            if cur is not None and cur >= new:
+                self.generation, self.epoch = cur
+                return cur
+            value = json.dumps({"gen": new[0], "epoch": new[1]})
+            # kv-unfenced: this CAS is the fence-advance itself
+            if self.kv.set_if(self.fence_key, value, raw):
+                self.generation, self.epoch = new
+                return new
+        raise ConsensusTimeoutError(          # pragma: no cover - needs a
+            f"fence advance at {self.fence_key!r} lost 64 straight CAS "
+            f"races", key=self.fence_key)     # pathological writer storm
+
+    def _check(self, key: str) -> None:
+        fence = self.fence()
+        if fence is None or self.token() >= fence:
+            return
+        from .. import obs
+
+        if obs.enabled():
+            obs.counter("cluster.fenced_writes").inc()
+            obs.record_event("cluster.fence", key=key,
+                             gen=self.generation, epoch=self.epoch,
+                             fence_gen=fence[0], fence_epoch=fence[1])
+        raise FencedWriteError(
+            f"fenced write to {key!r} rejected: token "
+            f"(gen={self.generation}, epoch={self.epoch}) is behind the "
+            f"published fence (gen={fence[0]}, epoch={fence[1]}) — this "
+            f"process was evicted and must stop, not retry",
+            key=key, token=self.token(), fence=fence)
+
+    # -- the KV surface (writes checked, reads passed through) ---------------
+    def set(self, key: str, value: str) -> None:
+        self._check(key)
+        self.kv.set(key, value)        # kv-unfenced: the check above IS the fence
+
+    def set_if(self, key: str, value: str,
+               expected: Optional[str]) -> bool:
+        self._check(key)
+        return self.kv.set_if(key, value, expected)  # kv-unfenced: checked above
+
+    def delete(self, key: str) -> None:
+        self._check(key)
+        self.kv.delete(key)            # kv-unfenced: the check above IS the fence
+
+    def try_get(self, key: str) -> Optional[str]:
+        return self.kv.try_get(key)
+
+    def get(self, key: str, timeout: float, **kwargs) -> str:
+        return self.kv.get(key, timeout, **kwargs)
+
+    def list_dir(self, prefix: str) -> dict:
+        return self.kv.list_dir(prefix)
+
+
+def _parse_fence(raw: Optional[str]) -> Optional[Tuple[int, int]]:
+    """An unparseable fence reads as no fence (fail-open for readers;
+    the advance CAS still serializes on the raw value, so wreckage
+    cannot wedge the namespace)."""
+    if raw is None:
+        return None
+    try:
+        blob = json.loads(raw)
+        return (int(blob["gen"]), int(blob["epoch"]))
+    except (ValueError, KeyError, TypeError):
+        return None
 
 
 def resolve_kv(env_value: str):

@@ -85,6 +85,9 @@ WATCHED_VARS: Tuple[str, ...] = (
     "PENCILARRAYS_TPU_ELASTIC_ROUNDS",
     "PENCILARRAYS_TPU_ELASTIC_MIN_WORLD",
     "PENCILARRAYS_TPU_ELASTIC_JOIN_TIMEOUT",
+    "PENCILARRAYS_TPU_ELASTIC_QUORUM",
+    # fleet/
+    "PENCILARRAYS_TPU_FLEET_WAL_MAX_MB",
     # engine/
     ENGINE_WORKERS_VAR,
     ENGINE_QUIESCE_VAR,
@@ -184,6 +187,13 @@ class RuntimeConfig:
     elastic_rounds: int = 8
     elastic_min_world: int = 1
     elastic_join_timeout: float = 600.0
+    # the split-brain gate — default ON; "0"/"off"/"false" disables the
+    # strict-majority requirement (the documented escape hatch for an
+    # intentional shrink below majority — every bypassed round is
+    # journaled loud, see docs/Cluster.md)
+    elastic_quorum: bool = True
+    # fleet/ — router WAL segment rotation threshold (None = no cap)
+    fleet_wal_max_bytes: Optional[int] = None
     # engine/
     engine_workers: int = 2
     engine_quiesce_s: float = 30.0
@@ -209,6 +219,7 @@ class RuntimeConfig:
         cluster_env = g("PENCILARRAYS_TPU_CLUSTER", "")
 
         max_mb = _opt_float(g("PENCILARRAYS_TPU_OBS_MAX_MB"))
+        wal_mb = _opt_float(g("PENCILARRAYS_TPU_FLEET_WAL_MAX_MB"))
         rounds = _opt_int(g("PENCILARRAYS_TPU_ELASTIC_ROUNDS"))
         min_world = _opt_int(g("PENCILARRAYS_TPU_ELASTIC_MIN_WORLD"))
         finite = _opt_int(g("PENCILARRAYS_TPU_GUARD_FINITE"))
@@ -252,6 +263,12 @@ class RuntimeConfig:
                 1, min_world if min_world is not None else 1),
             elastic_join_timeout=_float(
                 g("PENCILARRAYS_TPU_ELASTIC_JOIN_TIMEOUT"), 600.0),
+            elastic_quorum=(g("PENCILARRAYS_TPU_ELASTIC_QUORUM", "")
+                            .strip().lower()
+                            not in ("0", "off", "false")),
+            fleet_wal_max_bytes=(int(wal_mb * 1024 * 1024)
+                                 if wal_mb is not None and wal_mb > 0
+                                 else None),
             engine_workers=max(1, workers if workers is not None else 2),
             engine_quiesce_s=_float(g(ENGINE_QUIESCE_VAR), 30.0),
             engine_dag=(g(ENGINE_DAG_VAR, "")

@@ -11,9 +11,14 @@ hierarchy framing).  Per-mesh health leases
 (:mod:`~pencilarrays_tpu.fleet.health`) turn whole-mesh death into a
 typed :class:`~pencilarrays_tpu.fleet.errors.MeshFailureError` in
 ~ttl seconds, and failover re-binds the dead mesh's tickets to a
-sibling — every submitted request still resolves exactly once.  The
-flagged :class:`~pencilarrays_tpu.fleet.scale.FleetSupervisor` turns
-the autoscaler's journaled ``acted=false`` demand signals into
+sibling — every submitted request still resolves exactly once.  A
+router constructed with a ``wal_dir`` write-AHEAD logs every
+admission/placement/completion (:mod:`~pencilarrays_tpu.fleet.wal`)
+so even a router SIGKILL keeps that contract:
+:meth:`~pencilarrays_tpu.fleet.router.FleetRouter.recover` replays
+the log and re-parks every unresolved ticket.  The flagged
+:class:`~pencilarrays_tpu.fleet.scale.FleetSupervisor` turns the
+autoscaler's journaled ``acted=false`` demand signals into
 actually-launched workers.  See ``docs/Fleet.md``.
 """
 
@@ -26,12 +31,13 @@ from .errors import FleetError, MeshFailureError, MeshLeftError
 from .health import MeshBoard, MeshLease
 from .router import FleetRouter
 from .scale import FleetSupervisor
+from .wal import RouterWAL
 from .worker import MeshWorker
 
 __all__ = [
     "FleetCost", "FleetError", "FleetRouter", "FleetSupervisor",
     "MeshBoard", "MeshFailureError", "MeshLease", "MeshLeftError",
-    "MeshWorker", "mesh_id", "MESH_ENV",
+    "MeshWorker", "RouterWAL", "mesh_id", "MESH_ENV",
 ]
 
 # this process's fleet mesh identity, for the faults layer's %mesh<k>

@@ -65,10 +65,13 @@ class MeshLease:
         """Publish beat ``n`` and GC beat ``n-2`` (one-round lag: the
         previous beat stays readable while this one lands)."""
         self._n += 1
+        # kv-unfenced: this mesh's own liveness beat — the evidence
+        # the router's failover detection reads; per-mesh keys only
         self.kv.set(wire.beat_key(self.ns, self.mesh, self._n),
                     json.dumps({"t": time.time(), "pid": os.getpid(),
                                 "n": self._n}))
         if self._n >= 3:
+            # kv-unfenced: GC of this mesh's own stale beat
             self.kv.delete(wire.beat_key(self.ns, self.mesh,
                                          self._n - 2))
 
@@ -114,6 +117,7 @@ class MeshLease:
         tickets re-bind without a failure alarm."""
         from .. import obs
 
+        # kv-unfenced: own departure record (planned scale-down)
         self.kv.set(wire.left_key(self.ns, self.mesh),
                     json.dumps({"t": time.time(), "pid": os.getpid()}))
         if obs.enabled():

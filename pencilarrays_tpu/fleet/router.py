@@ -31,6 +31,17 @@ once — a result, a typed
 loss included.  A mesh that published its result and THEN died
 resolves from the result (checked before every re-bind); duplicate
 results for an already-resolved ticket are ignored, never re-raised.
+
+That contract now survives the router's OWN death: constructed with a
+``wal_dir``, the router write-AHEAD logs every admission, placement
+and completion (:mod:`~pencilarrays_tpu.fleet.wal` — fsync'd,
+CRC-framed, torn-tail tolerant) *before* the matching wire write.  A
+restarted router calls :meth:`FleetRouter.recover`: completions seed
+the dedup set (a mesh re-answering an already-answered ticket is
+counted and dropped), every unresolved ticket is re-parked exactly as
+a dead mesh's tickets are — so the next pump resolves it from a
+published result when one exists and re-binds it otherwise.
+Execution stays at-least-once; *resolution* is exactly-once.
 """
 
 from __future__ import annotations
@@ -42,6 +53,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from . import wal as _walmod
 from . import wire
 from ..obs import requestflow
 from .cost import FleetCost
@@ -80,8 +92,13 @@ class FleetRouter:
                  join_grace: Optional[float] = None,
                  cost: Optional[FleetCost] = None,
                  slos: Optional[dict] = None, max_rebinds: int = 4,
-                 load_max_age_s: float = 0.25):
+                 load_max_age_s: float = 0.25,
+                 wal_dir: Optional[str] = None):
         self.kv = kv
+        # durability is opt-in per router: no wal_dir = the pre-WAL
+        # in-memory router (tests that don't exercise restart)
+        self._wal = (_walmod.RouterWAL(wal_dir)
+                     if wal_dir is not None else None)
         self.ns = namespace
         self.cost = cost if cost is not None else FleetCost.from_env()
         self.board = MeshBoard(kv, ttl=ttl, join_grace=join_grace,
@@ -221,15 +238,27 @@ class FleetRouter:
         p = _Pending(ticket, tid, tenant, name, direction, payload,
                      nbytes, deadline_s, trace)
         p.mesh = mesh
+        req = wire.encode_request(
+            tid, tenant=tenant, name=name, direction=direction,
+            payload=payload, t_submit=p.t_submit,
+            deadline_s=deadline_s, trace=trace)
+        # write-AHEAD: the admission is durable BEFORE the wire sees
+        # the request — a router killed between these two writes
+        # recovers a parked ticket, never a ghost execution; one that
+        # published and then died recovers the same ticket and finds
+        # the mesh's result.  An unappendable WAL fails the admission
+        # (OSError propagates) rather than accepting an un-logged
+        # ticket.
+        if self._wal is not None:
+            self._wal.append({"op": "admit", "tid": tid, "req": req})
+            self._wal.append({"op": "place", "tid": tid, "mesh": mesh,
+                              "rebinds": 0})
         with self._lock:
             self._pending[tid] = p
             self._stats["submitted"] += 1
-        self.kv.set(wire.req_key(self.ns, mesh, tid),
-                    wire.encode_request(
-                        tid, tenant=tenant, name=name,
-                        direction=direction, payload=payload,
-                        t_submit=p.t_submit, deadline_s=deadline_s,
-                        trace=trace))
+        # kv-unfenced: ticket-unique wire key; the WAL append above is
+        # the durability gate, and duplicate results dedup in _resolve
+        self.kv.set(wire.req_key(self.ns, mesh, tid), req)
         self._journal_route(tid, tenant, mesh, "placed", score, trace)
         return ticket
 
@@ -259,14 +288,23 @@ class FleetRouter:
             self._resolved.add(tid)
             p = self._pending.pop(tid, None)
             self._stats["completed" if error is None else "failed"] += 1
+        if self._wal is not None:
+            # after the dedup gate: exactly one complete per ticket
+            # per router life; replay dedups across lives
+            self._wal.append({
+                "op": "complete", "tid": tid,
+                "outcome": ("ok" if error is None
+                            else type(error).__name__)})
         if p is not None:
             if error is None:
                 p.ticket._fulfill(value)
             else:
                 p.ticket._fail(error)
             if p.mesh is not None:
+                # kv-unfenced: GC of this ticket's own wire keys after
+                # the exactly-once gate above admitted the resolution
                 self.kv.delete(wire.req_key(self.ns, p.mesh, p.tid))
-        self.kv.delete(wire.res_key(self.ns, tid))
+        self.kv.delete(wire.res_key(self.ns, tid))  # kv-unfenced: GC
         return True
 
     def _try_result(self, tid: str) -> bool:
@@ -383,20 +421,102 @@ class FleetRouter:
                 continue
             mesh, score = placed
             p.mesh = mesh
-            self.kv.set(wire.req_key(self.ns, mesh, p.tid),
-                        wire.encode_request(
-                            p.tid, tenant=p.tenant, name=p.name,
-                            direction=p.direction, payload=p.payload,
-                            t_submit=p.t_submit,
-                            deadline_s=p.deadline_s,
-                            rebinds=p.rebinds,
-                            trace=p.trace))
+            req = wire.encode_request(
+                p.tid, tenant=p.tenant, name=p.name,
+                direction=p.direction, payload=p.payload,
+                t_submit=p.t_submit, deadline_s=p.deadline_s,
+                rebinds=p.rebinds, trace=p.trace)
+            if self._wal is not None:
+                # write-AHEAD again: the re-bind is durable before the
+                # sibling mesh can see (and answer) the request
+                self._wal.append({"op": "place", "tid": p.tid,
+                                  "mesh": mesh, "rebinds": p.rebinds})
+            # kv-unfenced: ticket-unique wire key (WAL-logged above);
+            # a double-publish resolves once via the _resolved dedup
+            self.kv.set(wire.req_key(self.ns, mesh, p.tid), req)
             self._journal_route(p.tid, p.tenant, mesh, "rebind", score,
                                 p.trace)
             with self._lock:
                 self._stats["rebound"] += 1
             rebound += 1
         return rebound
+
+    # -- recovery -----------------------------------------------------------
+    def recover(self, wal_dir: Optional[str] = None) -> dict:
+        """Replay a WAL into this (fresh) router: seed the dedup set
+        from every logged completion, re-park every unresolved ticket.
+        Call once, after construction and mesh registration, before
+        the first pump — the pump then resolves each recovered ticket
+        from its mesh's published result when one exists and re-binds
+        it otherwise (exactly-once resolution, at-least-once
+        execution).
+
+        Read-only on the log (replaying a replayed WAL is a no-op for
+        an empty router and skips already-known tickets otherwise).
+        Recovered tickets keep their original ``t_submit`` — a
+        deadline that lapsed while the router sat dead still fails
+        typed, never silently extends — and their logged rebind count,
+        so the ``max_rebinds`` budget spans router lives.  Returns a
+        summary dict; journals ``fleet.wal`` (fsync-critical) and
+        bumps ``fleet.wal_replays{outcome}``.
+        """
+        from .. import obs
+        from ..serve.queue import Ticket
+
+        d = wal_dir if wal_dir is not None else (
+            self._wal.dir if self._wal is not None else None)
+        if d is None:
+            raise ValueError(
+                "recover() needs a WAL: pass wal_dir or construct "
+                "the router with one")
+        records, skipped = _walmod.read_wal(d)
+        state = _walmod.replay(records)
+        with self._lock:
+            self._resolved |= state["resolved"]
+        reparked = undecodable = 0
+        for tid, ent in state["pending"].items():
+            with self._lock:
+                if tid in self._pending or tid in self._resolved:
+                    continue
+            try:
+                req = wire.decode_request(ent["req"])
+                payload = req["payload"]
+                p = _Pending(None, tid, req["tenant"], req["name"],
+                             req["direction"], payload,
+                             int(payload.nbytes), req.get("deadline_s"),
+                             req.get("trace"))
+            except Exception:
+                # a committed admit we cannot decode is forensics, not
+                # a crash loop — count it and keep recovering the rest
+                undecodable += 1
+                continue
+            p.ticket = Ticket(p.tenant, "fleet",
+                              f"fleet:{p.name}:{p.direction}")
+            # the WAL tid is the wire identity; the fresh Ticket's own
+            # id is irrelevant (nobody held the old Ticket object —
+            # its waiter died with the old router)
+            p.t_submit = float(req["t_submit"])
+            p.rebinds = int(ent.get("rebinds") or 0)
+            p.mesh = None       # re-parked: same path as a dead mesh
+            with self._lock:
+                self._pending[tid] = p
+                self._stats["submitted"] += 1
+            reparked += 1
+        outcome = "clean" if skipped == 0 and undecodable == 0 \
+            else "torn-tail"
+        if obs.enabled():
+            obs.counter("fleet.wal_replays", outcome=outcome).inc()
+            obs.record_event(
+                "fleet.wal", dir=d, outcome=outcome,
+                replayed=len(records), resolved=len(state["resolved"]),
+                reparked=reparked, skipped=skipped,
+                undecodable=undecodable,
+                duplicates=state["duplicates"], _fsync=True)
+        return {"outcome": outcome, "replayed": len(records),
+                "resolved": len(state["resolved"]),
+                "reparked": reparked, "skipped": skipped,
+                "undecodable": undecodable,
+                "duplicates": state["duplicates"]}
 
     # -- lifecycle ----------------------------------------------------------
     def drain(self, timeout: float, *, poll_s: float = 0.005) -> int:
@@ -434,6 +554,8 @@ class FleetRouter:
     def close(self) -> None:
         self._closed = True
         self.stop()
+        if self._wal is not None:
+            self._wal.close()
 
     def stats(self) -> dict:
         with self._lock:

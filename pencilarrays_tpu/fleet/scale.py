@@ -149,6 +149,8 @@ class FleetSupervisor:
 
         if self.kv is None:
             raise ValueError("retire() needs the supervisor's kv")
+        # kv-unfenced: idempotent single-writer retire signal — the
+        # supervisor owns the stop keys; re-writing "stop" is harmless
         self.kv.set(wire.stop_key(self.ns, mesh), "stop")
         with self._lock:
             self._retired.append(mesh)

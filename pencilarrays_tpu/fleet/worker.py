@@ -85,6 +85,8 @@ class MeshWorker:
             except Exception:   # pragma: no cover - a broken plan must
                 continue        # not unpublish the healthy ones
         warm = sorted(plans[n] for n in self._warm if n in plans)
+        # kv-unfenced: own-mesh telemetry export, overwrite-latest —
+        # a stale export only mis-scores placement for one cache age
         self.kv.set(wire.load_key(self.ns, self.mesh), json.dumps({
             "t": now, "mesh": self.mesh, "tier": self.tier,
             "projection": self.service.load_projection(),
@@ -137,6 +139,8 @@ class MeshWorker:
             if self.kv.try_get(wire.res_key(self.ns, tid)) is not None:
                 # a predecessor died between publish and req-GC: the
                 # result is authoritative, never re-execute
+                # kv-unfenced: consuming a request addressed to this
+                # mesh whose result already exists
                 self.kv.delete(key)
                 continue
             raw = self.kv.try_get(key)
@@ -180,10 +184,13 @@ class MeshWorker:
         except Exception as e:
             if not isinstance(e, (ServeError, faults.InjectedFault)):
                 raise
+            # kv-unfenced: ticket-unique result key — a duplicate
+            # publication (re-bound ticket, two answering meshes) is
+            # deduped by the router's _resolved set, never re-raised
             self.kv.set(wire.res_key(self.ns, tid),
                         wire.encode_result(tid, error=e,
                                            mesh=self.mesh))
-            self.kv.delete(key)
+            self.kv.delete(key)  # kv-unfenced: consume own request
             return False
         req["_ticket"] = ticket
         req["_t0"] = time.monotonic()
@@ -215,8 +222,9 @@ class MeshWorker:
                                          seconds=seconds,
                                          mesh=self.mesh)
         # result first, THEN req-GC: the result key is the commit point
+        # kv-unfenced: ticket-unique result key, router-side deduped
         self.kv.set(wire.res_key(self.ns, tid), payload)
-        self.kv.delete(key)
+        self.kv.delete(key)  # kv-unfenced: consume own request
 
     def run(self, *, poll_s: float = 0.01,
             max_seconds: Optional[float] = None) -> None:

@@ -99,7 +99,15 @@ DEFAULT_DIR = "pa_obs"
 # (``serve/precision.py``, ``BENCH_WIRE.json``) and the tenant's
 # declared ``max_rel_l2`` budget it fit inside — see obs/schema.py
 # V7_EVENT_FIELDS.  v1-v6 journals again stay lint-clean.
-SCHEMA_VERSION = 7
+# v8 (PR 20): the partition-tolerant control plane — three new
+# fsync-critical event types: ``cluster.quorum`` (one record per
+# quorum-gate evaluation, carrying the voter set / threshold /
+# denominator arithmetic), ``cluster.fence`` (a zombie write rejected
+# by the namespace fence, naming the stale token and the fence that
+# beat it) and ``fleet.wal`` (a router WAL recover/replay summary:
+# re-parked vs already-resolved tickets) — see obs/schema.py
+# V8_EVENT_FIELDS.  v1-v7 journals again stay lint-clean.
+SCHEMA_VERSION = 8
 
 # events whose loss would blind a post-mortem: fsync'd under the default
 # "critical" policy.  High-rate events (per-hop dispatch) only flush.
@@ -118,6 +126,12 @@ CRITICAL_EVENTS = frozenset({
     # elastic reformation: every stage record gates (or attributes) a
     # membership decision, and mid-reform is exactly when writers die
     "cluster.reform", "cluster.member",
+    # the partition-tolerance plane (PR 20): a quorum verdict gates
+    # whether a whole side of a partition lives or exits, a rejected
+    # zombie write is the proof the fence worked, and a WAL replay
+    # summary is the restarted router's reconciliation record — each
+    # is written exactly when its writer is most likely to die next
+    "cluster.quorum", "cluster.fence", "fleet.wal",
     # a flagged straggler gates a scheduling/ops decision and the
     # flagging rank may be about to act on it
     "cluster.straggler",

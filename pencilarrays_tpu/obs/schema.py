@@ -16,7 +16,7 @@ from .events import SCHEMA_VERSION
 
 __all__ = ["COMMON_FIELDS", "EVENT_TYPES", "V4_EVENT_FIELDS",
            "V5_EVENT_FIELDS", "V6_EVENT_FIELDS", "V7_EVENT_FIELDS",
-           "lint_event", "lint_journal"]
+           "V8_EVENT_FIELDS", "lint_event", "lint_journal"]
 
 # fields every record carries (written by events.record_event itself)
 COMMON_FIELDS: Tuple[str, ...] = (
@@ -89,6 +89,22 @@ V7_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
                         "max_rel_l2"),
 }
 
+# per-event fields required since schema v8 (the partition-tolerant
+# control plane, ISSUE 20): a ``cluster.quorum`` record must carry the
+# full gate arithmetic the post-mortem re-checks — the voter set
+# actually read, the strict-majority threshold and the denominator it
+# was computed over (the last-agreed membership minus confirmed-gone
+# ranks); a ``cluster.fence`` record names the stale token and the
+# published fence that rejected it; a ``fleet.wal`` record summarizes
+# a recover/replay pass (how many tickets were re-parked vs already
+# resolved).  v1-v7 journals stay lint-clean, as with every earlier
+# versioned stamp.
+V8_EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
+    "cluster.quorum": ("have", "need", "of"),
+    "cluster.fence": ("fence_gen", "fence_epoch"),
+    "fleet.wal": ("outcome", "replayed", "resolved"),
+}
+
 # ev -> required payload fields (extra fields are allowed; missing ones
 # and unknown event types are lint errors)
 EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
@@ -130,6 +146,13 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     # (leave/left/drop/join)
     "cluster.reform": ("gen", "stage"),
     "cluster.member": ("rank", "change"),
+    # the partition-tolerant control plane (ISSUE 20, schema v8): one
+    # fsync-critical record per quorum-gate evaluation (verdict
+    # pass/fail/bypass — the v8 fields carry the full arithmetic) and
+    # per rejected zombie write (the stale token vs the published
+    # fence)
+    "cluster.quorum": ("gen", "rank", "verdict"),
+    "cluster.fence": ("key", "gen", "epoch"),
     # mesh observability plane (PR 7)
     "cluster.straggler": ("rank", "hop", "excess_s", "baseline_s"),
     "clock.sync": ("ref_rank", "offset_s", "method"),
@@ -178,6 +201,10 @@ EVENT_TYPES: Dict[str, Tuple[str, ...]] = {
     "fleet.lease": ("mesh", "status"),
     "fleet.failover": ("mesh", "tickets", "detect_s"),
     "fleet.scale": ("action", "reason"),
+    # durable router WAL (fleet/wal.py, schema v8): one fsync-critical
+    # record per recover/replay pass — how the restarted router
+    # reconciled its log (re-parked vs already-resolved tickets)
+    "fleet.wal": ("dir",),
     # static analysis (analysis/): one record per certification —
     # ``PlanService.certify()`` registry sweeps, pa-lint SPMD runs and
     # direct ``certify_plan`` calls; non-ok outcomes are fsync-critical
@@ -250,6 +277,12 @@ def lint_event(e: dict) -> List[str]:
                 errors.append(
                     f"v{v} event {ev!r} missing required field {f!r} "
                     f"(precision-downgrade fields, schema v7): {e!r}")
+    if isinstance(v, (int, float)) and v >= 8:
+        for f in V8_EVENT_FIELDS.get(ev, ()):
+            if f not in e:
+                errors.append(
+                    f"v{v} event {ev!r} missing required field {f!r} "
+                    f"(partition-tolerance fields, schema v8): {e!r}")
     return errors
 
 

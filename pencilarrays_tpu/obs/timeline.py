@@ -337,12 +337,14 @@ _TRACK_OF = {
     "cluster.straggler": "cluster", "clock.sync": "cluster",
     "obs.agg": "cluster",
     "cluster.reform": "cluster", "cluster.member": "cluster",
+    "cluster.quorum": "cluster", "cluster.fence": "cluster",
     "serve.request": "serve", "serve.coalesce": "serve",
     "serve.dispatch": "serve", "serve.complete": "serve",
     "serve.slo_violation": "serve", "serve.pressure": "serve",
     "serve.scale": "serve",
     "fleet.route": "fleet", "fleet.lease": "fleet",
     "fleet.failover": "fleet", "fleet.scale": "fleet",
+    "fleet.wal": "fleet",
 }
 
 # events exported as complete ("X") spans: payload field holding the
@@ -394,6 +396,23 @@ def _span_name(e: dict) -> str:
         return f"reform g{e.get('gen', '?')}:{e.get('stage', '?')}"
     if ev == "cluster.member":
         return f"member r{e.get('rank', '?')}:{e.get('change', '?')}"
+    if ev == "cluster.quorum":
+        # the split-brain gate's verdict: a pass is routine, a fail is
+        # THE minority-side story, a bypass is an operator override —
+        # all three name the arithmetic (have/need of the denominator)
+        verdict = str(e.get("verdict", "?")).upper()
+        have = e.get("have")
+        n_have = len(have) if isinstance(have, (list, tuple)) else "?"
+        of = e.get("of")
+        n_of = len(of) if isinstance(of, (list, tuple)) else "?"
+        return (f"QUORUM-{verdict} g{e.get('gen', '?')} "
+                f"{n_have}/{e.get('need', '?')} of {n_of}")
+    if ev == "cluster.fence":
+        # a rejected zombie write: the fence that stopped it, vs the
+        # stale token the writer carried
+        return (f"FENCED g{e.get('gen', '?')}e{e.get('epoch', '?')} "
+                f"(fence g{e.get('fence_gen', '?')}"
+                f"e{e.get('fence_epoch', '?')}) {e.get('key', '?')}")
     if ev == "serve.request":
         return f"serve.req {e.get('tenant', '?')}#{e.get('req', '?')}"
     if ev == "serve.coalesce":
@@ -452,6 +471,11 @@ def _span_name(e: dict) -> str:
         return (f"fleet-scale {e.get('action', '?')} "
                 f"[{e.get('reason', '?')}]"
                 f"{f' m{mesh}' if mesh is not None else ''}{acted}")
+    if ev == "fleet.wal":
+        return (f"WAL-REPLAY [{e.get('outcome', '?')}] "
+                f"replayed={e.get('replayed', '?')} "
+                f"reparked={e.get('reparked', '?')} "
+                f"resolved={e.get('resolved', '?')}")
     return ev
 
 
@@ -517,6 +541,12 @@ def to_trace(tl: MergedTimeline) -> dict:
                     "membership", "complete"):
                 # reformation boundaries are mesh-wide alignment lines,
                 # exactly like epoch advances (which they also cause)
+                rec["s"] = "g"
+            elif (ev == "cluster.quorum"
+                  and e.get("verdict") in ("fail", "bypass")):
+                # a quorum loss (or its operator override) is the
+                # partition boundary itself — the mesh-wide line every
+                # other rank's story hangs off
                 rec["s"] = "g"
             out.append(rec)
     return {"traceEvents": out, "displayTimeUnit": "ms",
@@ -601,7 +631,12 @@ def render(tl: MergedTimeline, *, max_groups: int = 200) -> str:
                           # gate whole meshes: always spelled out
                           # (fleet.route is high-rate and counted)
                           "fleet.lease", "fleet.failover",
-                          "fleet.scale"):
+                          "fleet.scale",
+                          # partition-tolerance verdicts (schema v8):
+                          # quorum math, rejected zombie writes and
+                          # WAL replays ARE the post-mortem — loud
+                          "cluster.quorum", "cluster.fence",
+                          "fleet.wal"):
                     loud.append(_span_name(e))
                 elif (ev == "plan.build"
                       and isinstance(e.get("decomposition"), dict)

@@ -35,6 +35,17 @@ Registered points (see ``docs/Resilience.md``):
                           request — with ``%mesh<k>`` one shared spec
                           kills/delays/errors exactly ONE mesh's
                           admission path (the whole-mesh chaos drill)
+``kv.get``                one KV wire read (each ``try_get`` and each
+                          poll of a blocking ``get``, both backends) —
+                          the ``drop``/``partition`` surface: a
+                          partitioned rank's reads find nothing, so
+                          its waits run out typed
+``kv.set``                one KV wire write (``set``/``set_if``/
+                          ``delete``, both backends) — ``drop``
+                          silently loses the write, ``partition``
+                          raises it unreachable; ``%rank<k>`` on only
+                          one of ``kv.get``/``kv.set`` expresses an
+                          *asymmetric* partition
 ========================  ====================================================
 
 Rules are **counter-based, never random** — the same spec replays the
@@ -54,7 +65,16 @@ same failure.  Spec grammar (comma/semicolon-separated)::
   point, then proceed normally: the deterministic *straggler*, e.g.
   ``hop.exchange:delay%rank1`` makes rank 1 drag every exchange
   without changing any value; guard/cluster semantics are untouched,
-  which is exactly what the straggler-detection drill needs).
+  which is exactly what the straggler-detection drill needs),
+  ``drop`` (cooperative, KV wire only: the addressed operation is
+  *silently lost* — a dropped read misses, a dropped write returns
+  normally having written nothing: the lost-update drill), or
+  ``partition`` (cooperative, KV wire only: the store is unreachable
+  for the addressed process — reads find nothing until their bounded
+  wait runs out typed, writes raise ``ConsensusTimeoutError``
+  immediately.  ``kv.get:partition%rank1,kv.set:partition%rank1``
+  cuts rank 1 off the wire entirely; arming only one direction
+  expresses an asymmetric partition).
 * ``%rank<k>`` — rank-addressed injection: the rule triggers only in
   the process whose mesh rank is ``k`` (``PENCILARRAYS_TPU_CLUSTER_RANK``,
   else the jax-assigned process id, else 0 — the cluster layer's
@@ -124,9 +144,12 @@ POINTS = frozenset({
     "hop.exchange",
     "serve.submit",
     "fleet.route",
+    "kv.get",
+    "kv.set",
 })
 
-MODES = frozenset({"error", "kill", "torn", "corrupt", "delay"})
+MODES = frozenset({"error", "kill", "torn", "corrupt", "delay",
+                   "drop", "partition"})
 
 DELAY_S_VAR = "PENCILARRAYS_TPU_FAULTS_DELAY_S"
 DEFAULT_DELAY_S = 0.25
@@ -144,7 +167,7 @@ def delay_seconds() -> float:
 @dataclass(frozen=True)
 class Rule:
     point: str
-    mode: str                  # "error" | "kill" | "torn" | "corrupt"
+    mode: str                  # one of MODES
     times: Optional[int]       # consecutive triggering hits (None = forever)
     first: int = 1             # 1-based hit index of the first trigger
     rank: Optional[int] = None   # %rank<k> selector (None = every rank)
@@ -214,6 +237,10 @@ _rules: Optional[List[Rule]] = None
 _env_cache: Optional[str] = None
 _env_rules: List[Rule] = []
 _hits: Dict[str, int] = {}
+# (point, mode) pairs already journaled for the high-rate cooperative
+# modes (drop/partition fire once per wire poll: the journal gets the
+# onset, the counter gets the rate)
+_journaled: set = set()
 
 
 def install(spec) -> None:
@@ -233,6 +260,7 @@ def clear() -> None:
 
 def reset_counters() -> None:
     _hits.clear()
+    _journaled.clear()
 
 
 def hit_count(point: str) -> int:
@@ -358,7 +386,7 @@ def fire(point: str, **ctx) -> Optional[str]:
             continue
         if r.mode == "kill":
             kill_now()
-        if r.mode in ("torn", "corrupt"):
+        if r.mode in ("torn", "corrupt", "drop", "partition"):
             return r.mode
         where = f" [{ctx}]" if ctx else ""
         raise InjectedFault(
@@ -379,5 +407,18 @@ def _obs_firing(point: str, mode: str, hit: int, ctx: dict) -> None:
     if not enabled():
         return
     counter("faults.fired", point=point, mode=mode).inc()
+    # drop/partition fire once per KV wire poll and never kill the
+    # process: the journal records the ONSET (first firing) only —
+    # every subsequent firing is visible through the counter — and
+    # skips the per-record fsync a kill/torn firing rightly pays
+    if mode in ("drop", "partition"):
+        if (point, mode) in _journaled:
+            return
+        _journaled.add((point, mode))
+        record_event("fault", point=point, mode=mode, hit=hit,
+                     _fsync=False, **{
+                         k: v for k, v in ctx.items()
+                         if k not in ("point", "mode", "hit")})
+        return
     record_event("fault", point=point, mode=mode, hit=hit, **{
         k: v for k, v in ctx.items() if k not in ("point", "mode", "hit")})

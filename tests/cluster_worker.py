@@ -62,6 +62,17 @@ KV namespace — a KV root is one job incarnation):
   (``join_prewarmed``), admitted by the survivor's overload-driven
   scale-up reformation; a post-join aligned ``guarded_step`` proves
   the re-grown mesh coordinates.
+* ``partition`` — the ISSUE 20 split-brain drill: an asymmetric KV
+  partition cuts the highest rank off the wire (``kv.get:partition,
+  kv.set:partition`` self-armed mid-run — its reads find nothing, its
+  writes raise, its renewals fail).  The minority side must exit its
+  reformation attempt typed ``QuorumLossError`` (1 voter of 3, strict
+  majority needs 2) — NEVER form a rival mesh; the majority reforms
+  around it on fresh evidence (the stale lease) and agrees in the new
+  namespace; and when the partition heals, the evicted rank's writes
+  through :class:`FencedKV` are rejected typed ``FencedWriteError``
+  by the fence the new generation's rank 0 advanced — the zombie can
+  read, never corrupt.
 * ``straggle`` / ``control`` — the PR 7 straggler drill: every rank
   runs the same guarded transpose steps, with rank 1 dragged by the
   deterministic ``hop.exchange:delay%rank1`` fault (``straggle``) or
@@ -470,6 +481,83 @@ def main():
                                      label="post-join",
                                      coordinator=newc)
             assert out == "post-join"
+    elif phase == "partition":
+        from pencilarrays_tpu import cluster
+        from pencilarrays_tpu.cluster import (FencedWriteError,
+                                              QuorumLossError, elastic)
+        from pencilarrays_tpu.cluster.kv import FencedKV
+
+        os.environ["PENCILARRAYS_TPU_ELASTIC"] = "1"
+        coord = cluster.coordinator()
+        assert coord is not None, "cluster layer did not arm"
+        ok = {"status": "ok", "can_retry": True, "can_restore": False}
+        # prove the healthy 3-rank mesh first: one agreed verdict
+        assert coord.agree("pre", ok)["action"] == "ok"
+        victim_rank = world - 1
+        if rank == victim_rank:
+            # the partition: THIS rank loses the KV wire in both
+            # directions — reads find nothing, writes raise, and the
+            # heartbeat's renewals fail (caught in the renew loop), so
+            # from the majority's side this lease simply goes stale
+            os.environ["PENCILARRAYS_TPU_FAULTS"] = (
+                "kv.get:partition,kv.set:partition")
+            t0 = time.monotonic()
+            try:
+                elastic.reform(coord, reason="partition",
+                               install=False, timeout=3.0)
+            except QuorumLossError as e:
+                print(f"MINORITY_TYPED have={len(e.have)} "
+                      f"need={e.need} of={len(e.of)} "
+                      f"detect_s={time.monotonic() - t0:.2f}",
+                      flush=True)
+            else:
+                raise SystemExit(
+                    "minority side formed a rival mesh — split brain")
+            coord.shutdown()   # stop renewing into a mesh we left
+            # the partition heals: the zombie wakes up still holding
+            # its gen-0 token, finds the fence the majority's new
+            # rank 0 advanced, and every write is rejected typed
+            # BEFORE touching the store
+            os.environ["PENCILARRAYS_TPU_FAULTS"] = ""
+            zombie = FencedKV(coord.kv, namespace=coord.ns,
+                              generation=0, epoch=0)
+            t_wait = time.monotonic() + 120
+            while zombie.fence() is None:
+                if time.monotonic() >= t_wait:
+                    raise SystemExit("majority fence never landed")
+                time.sleep(0.1)
+            try:
+                zombie.set(f"{coord.ns}/poison/r{rank}", "stale")
+            except FencedWriteError as e:
+                print(f"ZOMBIE_FENCED token={e.token} "
+                      f"fence={e.fence}", flush=True)
+            else:
+                raise SystemExit(
+                    "zombie write landed in the live namespace")
+            assert coord.kv.try_get(
+                f"{coord.ns}/poison/r{rank}") is None
+        else:
+            # majority: wait for fresh evidence (the victim's lease
+            # aging past ttl), then reform together around it
+            t0 = time.monotonic()
+            while victim_rank in coord.leases.live_ranks():
+                if time.monotonic() - t0 > 60:
+                    raise SystemExit(
+                        "victim lease never went stale")
+                time.sleep(0.1)
+            r = elastic.reform(coord, reason="partition",
+                               install=False,
+                               detect_s=time.monotonic() - t0)
+            m = r.membership
+            assert m.members == list(range(world - 1)), m.members
+            assert m.new_world == world - 1, m.new_world
+            # the reformed majority coordinates in the new namespace
+            post = r.coordinator.agree("post", ok)
+            assert post["action"] == "ok", post
+            print(f"REFORMED gen={m.gen} world={m.new_world} "
+                  f"ns={m.namespace}", flush=True)
+            r.coordinator.shutdown()
+            coord.shutdown()
     elif phase in ("straggle", "control"):
         from pencilarrays_tpu import cluster
 

@@ -1,0 +1,55 @@
+"""Front-end router process for the ISSUE 20 WAL chaos drill.
+
+One OS process = the fleet FRONT-END: a
+:class:`~pencilarrays_tpu.fleet.FleetRouter` with a durable WAL over
+the shared ``FileKV`` wire, submitting a deterministic storm of seeded
+requests against the subprocess meshes of ``fleet_worker.py``.  The
+launcher arms ``fleet.route:kill@<n>`` in THIS process's environment,
+so the router SIGKILLs itself at its n-th admission — the
+un-catchable front-end crash the WAL exists to survive.  The parent
+then replays the WAL into a fresh router and proves the exactly-once
+contract across router incarnations: every admission the log
+committed resolves exactly once, nothing is lost, nothing doubles.
+
+Payloads are derived from the request index (``default_rng(1000+i)``)
+so the parent can regenerate any of them without a side channel.
+
+Usage::
+
+    python router_worker.py <kvroot> <waldir> <nreq> <meshes-csv>
+"""
+
+import os
+import sys
+
+
+def main():
+    kvroot, waldir, nreq, meshes = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), sys.argv[4])
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1")
+    ttl = float(os.environ.get("PA_FLEET_TEST_TTL", "2.0"))
+    import numpy as np
+
+    from pencilarrays_tpu.cluster.kv import FileKV
+    from pencilarrays_tpu.fleet import FleetRouter
+
+    router = FleetRouter(FileKV(kvroot), ttl=ttl, wal_dir=waldir)
+    for m in meshes.split(","):
+        router.register_mesh(int(m))
+    print(f"ROUTER_READY pid={os.getpid()}", flush=True)
+    for i in range(nreq):
+        rng = np.random.default_rng(1000 + i)
+        u = (rng.standard_normal((8, 6, 4))
+             + 1j * rng.standard_normal((8, 6, 4))).astype(np.complex64)
+        router.submit("acme", u, name="minnow")  # armed kill fires here
+        router.pump()
+    left = router.drain(120.0)
+    print(f"ROUTER_DRAINED left={left} "
+          f"completed={router.stats()['completed']}", flush=True)
+    router.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,314 @@
+"""The ISSUE 20 acceptance drills: partition tolerance across real OS
+processes.
+
+Two drills, each over plain subprocesses joined only through a shared
+``FileKV`` directory:
+
+* **Split-brain quorum drill** — three ``cluster_worker.py`` ranks
+  (phase ``partition``): an asymmetric KV partition cuts rank 2 off
+  the wire mid-run.  The minority side must exit its reformation
+  attempt typed ``QuorumLossError`` (1 voter of 3) — never form a
+  rival mesh; the majority reforms to a 2-rank generation on the
+  stale-lease evidence; and when the partition heals, the evicted
+  rank's ``FencedKV`` writes are rejected typed ``FencedWriteError``
+  by the fence the new generation's rank 0 advanced.  The merged
+  journal must tell the whole story (quorum verdicts on every side,
+  the fence advance, the fenced zombie write) and render lint-clean
+  through the real ``pa-obs`` CLI.
+
+* **Router-death WAL drill** — a ``router_worker.py`` front-end
+  SIGKILLs itself at its 7th admission (``fleet.route:kill@7`` armed
+  in ITS environment only) mid-way through a 10-request storm against
+  one ``fleet_worker.py`` mesh; the mesh is dragged
+  (``fleet.route:delay%mesh1``) so a backlog provably survives the
+  crash.  The parent replays the WAL into a fresh router and proves
+  the exactly-once contract across router incarnations: all 6
+  committed admissions resolve exactly once with the bit-correct FFT,
+  nothing doubles, nothing is lost, and the final WAL fold agrees.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from pencilarrays_tpu import obs
+from pencilarrays_tpu.cluster.kv import FileKV
+from pencilarrays_tpu.fleet import FleetRouter, MeshBoard
+from pencilarrays_tpu.fleet import wal as walmod
+from pencilarrays_tpu.obs import events as obs_events
+from pencilarrays_tpu.obs import metrics as obs_metrics
+from pencilarrays_tpu.resilience import faults
+
+TTL = 2.0
+BOOT_S = 90.0
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+    yield
+    faults.clear()
+    obs_events._reset_for_tests()
+    obs_metrics.registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# drill 1: split-brain quorum + zombie fencing across 3 processes
+# ---------------------------------------------------------------------------
+
+def _launch_partition_drill(tmp_path, world):
+    """Run the ``partition`` phase of ``cluster_worker.py`` across
+    ``world`` plain OS processes sharing a FileKV namespace.  Every
+    rank must exit 0 — the partition is a *logical* eviction, not a
+    process death."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "cluster_worker.py")
+    kvroot = os.path.join(str(tmp_path), "kv")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PENCILARRAYS_TPU_FAULTS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(here)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, kvroot, str(world), str(rank),
+             str(tmp_path), "partition"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for rank in range(world)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=480)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        drained = list(outs)
+        for p in procs[len(outs):]:
+            try:
+                out, _ = p.communicate(timeout=10)
+            except Exception:
+                out = ""
+            drained.append(out or "")
+        pytest.fail("partition drill workers timed out (a coordination "
+                    "deadlock — exactly what the quorum gate must "
+                    "prevent); captured output:\n"
+                    + "\n---\n".join(drained))
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"partition drill rank {rank} failed:\n{out[-3000:]}")
+        assert f"CLUSTER_OK phase=partition rank={rank}" in out, \
+            out[-2000:]
+    return outs
+
+
+def test_quorum_partition_across_processes(tmp_path):
+    """The split-brain acceptance drill proper: 3 ranks, rank 2
+    partitioned mid-run — typed minority exit, majority reformation,
+    fenced zombie write, journal lint-clean end to end."""
+    world = 3
+    outs = _launch_partition_drill(tmp_path, world)
+
+    # the minority exited its reformation typed — 1 voter of 3 — and
+    # its post-heal write was rejected by the fence, not by luck
+    assert "MINORITY_TYPED have=1 need=2 of=3" in outs[2], outs[2]
+    assert "ZOMBIE_FENCED token=(0, 0) fence=(1," in outs[2], outs[2]
+    # the majority reformed to a 2-rank generation and agreed in it
+    for rank in (0, 1):
+        assert f"REFORMED gen=1 world=2 ns=pa.g1" in outs[rank], \
+            outs[rank]
+
+    # the merged journal tells the same story, typed on every side
+    obsdir = os.path.join(str(tmp_path), "obs")
+    events = obs_events.read_journal(obsdir)
+    quorum = [e for e in events if e["ev"] == "cluster.quorum"]
+    fails = [e for e in quorum if e["verdict"] == "fail"]
+    assert fails and all(e["proc"] == 2 for e in fails), quorum
+    assert all(e["have"] == [2] and e["need"] == 2 for e in fails)
+    passes = [e for e in quorum if e["verdict"] == "pass"
+              and e["proc"] in (0, 1)]
+    assert passes, quorum
+    # the final (post-gather) checks on the majority judged the victim
+    # gone on the stale-lease evidence and reformed over it
+    assert any(e["of"] == [0, 1] and e["gone"] == [2] for e in passes)
+    assert not any(e["verdict"] == "bypass" for e in quorum)
+    # the new generation's rank 0 advanced the fence first...
+    adv = [e for e in events if e["ev"] == "cluster.reform"
+           and e.get("stage") == "fence"]
+    assert len(adv) == 1 and adv[0]["proc"] == 0, adv
+    assert adv[0]["fence_gen"] == 1
+    # ...and the zombie's write was rejected against exactly that fence
+    fenced = [e for e in events if e["ev"] == "cluster.fence"]
+    assert fenced and all(e["proc"] == 2 for e in fenced), fenced
+    assert all(e["gen"] == 0 and e["fence_gen"] == 1 for e in fenced)
+
+    from pencilarrays_tpu.obs.__main__ import main
+
+    assert main(["lint", obsdir]) == 0
+    assert main(["timeline", obsdir]) == 0
+
+
+# ---------------------------------------------------------------------------
+# drill 2: router SIGKILL mid-storm -> WAL replay, exactly-once
+# ---------------------------------------------------------------------------
+
+def _spawn_mesh(kvroot, mesh, tmpdir, *, fault="", delay_s=None):
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(here),
+        "PA_FLEET_TEST_TTL": str(TTL),
+        "PENCILARRAYS_TPU_FAULTS": fault,
+    })
+    if delay_s is not None:
+        env["PENCILARRAYS_TPU_FAULTS_DELAY_S"] = str(delay_s)
+    env.pop("PENCILARRAYS_TPU_FLEET_MESH", None)
+    env.pop("PENCILARRAYS_TPU_CLUSTER_RANK", None)
+    return subprocess.Popen(
+        [sys.executable, os.path.join(here, "fleet_worker.py"),
+         kvroot, str(mesh), tmpdir, "180"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+def _spawn_router(kvroot, waldir, obsdir, nreq, meshes, *, fault):
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(here),
+        "PA_FLEET_TEST_TTL": str(TTL),
+        "PENCILARRAYS_TPU_FAULTS": fault,
+        "PENCILARRAYS_TPU_OBS": obsdir,
+        # the router's own journal identity — distinct from the parent
+        # (r0) and the mesh (r1) so the timeline merge sees 3 procs
+        "PENCILARRAYS_TPU_CLUSTER_RANK": "3",
+    })
+    env.pop("PENCILARRAYS_TPU_FLEET_MESH", None)
+    return subprocess.Popen(
+        [sys.executable, os.path.join(here, "router_worker.py"),
+         kvroot, waldir, str(nreq), meshes],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+def _await_live(kv, meshes):
+    board = MeshBoard(kv, ttl=TTL)
+    deadline = time.monotonic() + BOOT_S
+    while time.monotonic() < deadline:
+        if board.live_meshes(meshes) == sorted(meshes):
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"meshes {meshes} never all came alive")
+
+
+def test_router_sigkill_mid_storm_wal_replay(tmp_path):
+    """The WAL acceptance drill proper: the front-end router process is
+    SIGKILLed at its 7th admission; a fresh router over the same WAL
+    directory replays the log and every one of the 6 committed
+    admissions resolves exactly once, bit-correct."""
+    kvroot = str(tmp_path / "kv")
+    waldir = str(tmp_path / "wal")
+    obsdir = str(tmp_path / "obs")
+    kv = FileKV(kvroot)
+    # the mesh is dragged 0.3 s per routed take, so most of the storm
+    # is still unexecuted when the router dies — the replay must
+    # re-bind real work, not just harvest finished results
+    worker = _spawn_mesh(kvroot, 1, str(tmp_path),
+                         fault="fleet.route:delay%mesh1", delay_s=0.3)
+    router = None
+    try:
+        _await_live(kv, [1])
+        # incarnation 1: dies by its OWN armed kill at admission #7 —
+        # exactly 6 admits committed to the WAL (the fault fires
+        # before the 7th admit record is appended)
+        rproc = _spawn_router(kvroot, waldir, obsdir, 10, "1",
+                              fault="fleet.route:kill@7")
+        rout, _ = rproc.communicate(timeout=240)
+        assert rproc.returncode == -signal.SIGKILL, (rproc.returncode,
+                                                     rout[-2000:])
+        assert "ROUTER_READY" in rout and "ROUTER_DRAINED" not in rout
+
+        # incarnation 2: fresh router, same WAL directory
+        obs.enable(obsdir)
+        router = FleetRouter(kv, ttl=TTL, wal_dir=waldir)
+        router.register_mesh(1)
+        rep = router.recover()
+        assert rep["outcome"] == "clean" and rep["skipped"] == 0, rep
+        assert rep["resolved"] + rep["reparked"] == 6, rep
+        assert rep["reparked"] >= 1, rep
+
+        # hold the recovered tickets AND their verbatim-logged
+        # payloads: the exactly-once proof is numeric, not just counted
+        with router._lock:
+            held = [(p.ticket, np.asarray(p.payload))
+                    for p in router._pending.values()]
+        assert len(held) == rep["reparked"]
+        assert router.drain(90.0) == 0
+        for t, u in held:
+            np.testing.assert_allclose(np.asarray(t.result(1.0)),
+                                       np.fft.fftn(u),
+                                       rtol=1e-3, atol=1e-3)
+        stats = router.stats()
+        assert stats["completed"] == rep["reparked"]
+        assert stats["failed"] == 0 and stats["duplicates"] == 0
+        assert stats["pending"] == 0
+
+        # replaying a replayed WAL re-parks nothing: recovery is
+        # idempotent across incarnations too
+        rep2 = router.recover()
+        assert rep2["reparked"] == 0 and rep2["resolved"] == 6, rep2
+
+        # the final WAL fold agrees with the wire: all 6 committed
+        # admissions completed ok, exactly once, none pending
+        records, skipped = walmod.read_wal(waldir)
+        assert skipped == 0
+        fold = walmod.replay(records)
+        assert fold["pending"] == {} and fold["duplicates"] == 0
+        assert len(fold["resolved"]) == 6
+        completes = [r for r in records if r["op"] == "complete"]
+        assert len(completes) == 6
+        assert all(r["outcome"] == "ok" for r in completes)
+    finally:
+        if router is not None:
+            router.close()
+        obs.disable()
+        kv.set("pa/fleet/stop/m1", "stop")
+        if worker.poll() is None:
+            try:
+                worker.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                worker.kill()
+        wout, _ = worker.communicate()
+
+    assert "EXITED mesh=1" in wout, wout[-2000:]
+
+    # the replay is journaled fsync-critically and counted — and the
+    # merged 3-proc journal (parent, mesh, dead router) renders clean
+    # through the real pa-obs CLI, torn tail and all
+    events = obs_events.read_journal(obsdir)
+    wal_evs = [e for e in events if e["ev"] == "fleet.wal"]
+    assert any(e["outcome"] == "clean" and e["resolved"]
+               + e["reparked"] == 6 for e in wal_evs), wal_evs
+    snap = obs_metrics.registry.snapshot()["counters"]
+    assert snap.get("fleet.wal_replays{outcome=clean}", 0) >= 1
+    killed = [e for e in events if e["ev"] == "fault"
+              and e.get("point") == "fleet.route"]
+    assert any(e.get("mode") == "kill" for e in killed)
+
+    from pencilarrays_tpu.obs.__main__ import main
+
+    assert main(["lint", obsdir]) == 0
+    assert main(["timeline", obsdir]) == 0
